@@ -1,0 +1,28 @@
+"""garage_tpu — a TPU-native distributed object storage framework.
+
+A brand-new implementation of the capabilities of Garage (reference:
+/root/reference, an S3-compatible leaderless CRDT-reconciled object store
+written in Rust): quorum replication, CRDT metadata tables with Merkle
+anti-entropy, content-addressed blocks, and background scrub/resync/repair
+workers — re-architected TPU-first so the block layer's integrity hashing and
+erasure-coding math runs as batched JAX/Pallas device ops.
+
+Layer map (mirrors reference SURVEY.md §1):
+  utils/     L1 foundation  (ref: src/util)
+  db/        L2 metadata DB (ref: src/db)
+  net/       L3 comm backend (ref: external crate netapp 0.10)
+  rpc/       L3 cluster/RPC (ref: src/rpc)
+  parallel/  L3 replication & sharding strategies + layout optimizer
+             (ref: src/rpc/ring.rs, layout.rs, graph_algo.rs,
+              src/table/replication)
+  table/     L4b replicated CRDT table engine (ref: src/table)
+  block/     L4a content-addressed block store (ref: src/block)
+  ops/       the genuinely new layer: BlockCodec — batched device ops
+             (BLAKE2 hashing, Reed-Solomon GF(2^8) encode/decode-repair,
+              compression) with CPU and TPU (JAX) implementations
+  models/    L5 data model (ref: src/model)
+  api/       L6 HTTP APIs: S3, admin, web (ref: src/api, src/web)
+  cli/       L7 daemon + CLI (ref: src/garage)
+"""
+
+__version__ = "0.1.0"

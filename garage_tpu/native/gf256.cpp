@@ -1,0 +1,83 @@
+// Native GF(2^8) Reed-Solomon kernel — CPU baseline of the BlockCodec.
+//
+// Equivalent role to the reference's native Rust block-codec path
+// (ref src/block/block.rs DataBlock verify/encode run at native speed);
+// the TPU build keeps a native CPU fallback per SURVEY.md §2.11 item 3.
+//
+// Strategy: per (row, col) of the small GF matrix, precompute the 256-entry
+// product table; the inner loop is then a table-lookup-XOR sweep over the
+// shard bytes, parallelized over batch with OpenMP.  Field: poly 0x11D.
+//
+// Build: make -C garage_tpu/native   (produces libgf256.so, loaded by
+// garage_tpu/ops/native.py via ctypes; python falls back to numpy if absent).
+
+#include <cstdint>
+#include <cstring>
+
+static uint8_t GF_EXP[512];
+static int16_t GF_LOG[256];
+
+static void init_tables() {
+  static bool done = false;
+  if (done) return;
+  int x = 1;
+  for (int i = 0; i < 255; i++) {
+    GF_EXP[i] = (uint8_t)x;
+    GF_LOG[x] = (int16_t)i;
+    x <<= 1;
+    if (x & 0x100) x ^= 0x11D;
+  }
+  for (int i = 255; i < 510; i++) GF_EXP[i] = GF_EXP[i - 255];
+  GF_LOG[0] = 0;
+  done = true;
+}
+
+static inline uint8_t gf_mul(uint8_t a, uint8_t b) {
+  if (a == 0 || b == 0) return 0;
+  return GF_EXP[GF_LOG[a] + GF_LOG[b]];
+}
+
+extern "C" {
+
+// out (B, r, S) ^= mat (r, k) * shards (B, k, S) over GF(2^8).
+// `out` must be zero-initialized by the caller.
+void gf_matmul_blocks(const uint8_t* mat, const uint8_t* shards, uint8_t* out,
+                      int64_t batch, int64_t r, int64_t k, int64_t s) {
+  init_tables();
+  // Precompute per-(i,j) multiplication tables: r*k*256 bytes.
+  uint8_t* tables = new uint8_t[r * k * 256];
+  for (int64_t i = 0; i < r; i++) {
+    for (int64_t j = 0; j < k; j++) {
+      uint8_t c = mat[i * k + j];
+      uint8_t* t = tables + (i * k + j) * 256;
+      if (c == 0) {
+        memset(t, 0, 256);
+      } else {
+        int16_t lc = GF_LOG[c];
+        t[0] = 0;
+        for (int v = 1; v < 256; v++) t[v] = GF_EXP[lc + GF_LOG[v]];
+      }
+    }
+  }
+#pragma omp parallel for schedule(static)
+  for (int64_t b = 0; b < batch; b++) {
+    const uint8_t* in_b = shards + b * k * s;
+    uint8_t* out_b = out + b * r * s;
+    for (int64_t i = 0; i < r; i++) {
+      uint8_t* dst = out_b + i * s;
+      for (int64_t j = 0; j < k; j++) {
+        const uint8_t* t = tables + (i * k + j) * 256;
+        const uint8_t* src = in_b + j * s;
+        if (mat[i * k + j] == 0) continue;
+        if (mat[i * k + j] == 1) {
+          for (int64_t v = 0; v < s; v++) dst[v] ^= src[v];
+        } else {
+          for (int64_t v = 0; v < s; v++) dst[v] ^= t[src[v]];
+        }
+      }
+    }
+  }
+  delete[] tables;
+}
+
+}  // extern "C"

@@ -1,0 +1,30 @@
+"""ops — the BlockCodec device-op layer (the genuinely new layer vs reference).
+
+The reference's block layer does integrity hashing and (in our north star)
+Reed-Solomon erasure coding one block at a time on CPU
+(ref src/block/block.rs:66-78 verify, src/block/repair.rs:438-490 scrub).
+Here those become *batch* operations behind the `BlockCodec` interface, with:
+
+  - cpu backend (cpu_codec.py): hashlib + numpy GF(2^8) (+ optional C++
+    native kernel, native/gf256.cpp) — the correctness baseline;
+  - tpu backend (tpu_codec.py): JAX — BLAKE2s as a vectorized uint32 scan
+    (tpu_blake2s.py), RS(k,m) encode/decode as GF(2) bit-matrix matmuls on
+    the MXU (gf256.py bitmatrix construction), shardable over a device mesh.
+
+Both backends are bit-identical; tests/test_codec_equivalence.py enforces it.
+"""
+
+from __future__ import annotations
+
+from .codec import BlockCodec, CodecParams
+
+
+def make_codec(backend: str = "cpu", **kw) -> BlockCodec:
+    """Codec factory — `codec.backend` in config selects this."""
+    if backend == "cpu":
+        from .cpu_codec import CpuCodec
+        return CpuCodec(CodecParams(**kw))
+    if backend == "tpu":
+        from .tpu_codec import TpuCodec
+        return TpuCodec(CodecParams(**kw))
+    raise ValueError(f"unknown codec backend {backend!r}")

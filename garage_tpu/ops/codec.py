@@ -1,0 +1,95 @@
+"""BlockCodec — the batch device-op interface of the block layer.
+
+This is the seam identified in SURVEY.md §2.5 (ref src/block/block.rs:10-115
+`DataBlock`): verify/hash/compress become *batchable* operations so the
+scrub/resync workers (ref block/repair.rs:438-490, block/resync.rs:361-471)
+can stream thousands of blocks per step through one device dispatch instead
+of hashing one block at a time.
+
+Semantics contract (both backends must agree bit-for-bit):
+  - batch_hash(blocks)   == [hash_algo(b) for b in blocks]
+  - batch_verify(b, h)   == elementwise batch_hash(b) == h
+  - rs_encode(data)      : (B, k, S) uint8 → (B, m, S) parity, systematic
+                           Cauchy-RS over GF(2^8)/0x11D (gf256.py)
+  - rs_reconstruct(shards, present): any k of the k+m shards → original data
+  - compress/decompress  : zstd framing with content checksum, mirroring the
+                           reference's `DataBlock::Compressed`
+                           (ref block/block.rs:49-91): compress returns None
+                           when compression does not shrink the block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.data import Hash
+
+
+@dataclasses.dataclass
+class CodecParams:
+    hash_algo: str = "blake2s"
+    rs_data: int = 8          # k
+    rs_parity: int = 4        # m
+    compression_level: Optional[int] = 1
+    batch_blocks: int = 256
+
+
+class BlockCodec:
+    """Batch codec interface; see module docstring for the contract."""
+
+    def __init__(self, params: CodecParams):
+        self.params = params
+
+    # --- hashing ---
+    def batch_hash(self, blocks: Sequence[bytes]) -> List[Hash]:
+        raise NotImplementedError
+
+    def batch_verify(self, blocks: Sequence[bytes], hashes: Sequence[Hash]) -> np.ndarray:
+        if len(blocks) != len(hashes):
+            raise ValueError(f"{len(blocks)} blocks vs {len(hashes)} hashes")
+        got = self.batch_hash(blocks)
+        return np.array([bytes(a) == bytes(b) for a, b in zip(got, hashes)], dtype=bool)
+
+    # --- Reed-Solomon ---
+    def rs_encode(self, data: np.ndarray) -> np.ndarray:
+        """(B, k, S) uint8 → (B, m, S) parity shards."""
+        raise NotImplementedError
+
+    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int]) -> np.ndarray:
+        """shards (B, p, S) = the surviving shards, in the order listed by
+        `present` (indices into the k+m codeword, p ≥ k) → (B, k, S) data."""
+        raise NotImplementedError
+
+    # --- compression (CPU-side on both backends) ---
+    def compress(self, data: bytes) -> Optional[bytes]:
+        if self.params.compression_level is None:
+            return None
+        import zstandard
+        c = zstandard.ZstdCompressor(
+            level=self.params.compression_level,
+            write_checksum=True,   # ref block/block.rs:66-78 verifies via zstd checksum
+            write_content_size=True,
+        )
+        out = c.compress(data)
+        return out if len(out) < len(data) else None
+
+    def decompress(self, data: bytes) -> bytes:
+        import zstandard
+        return zstandard.ZstdDecompressor().decompress(data)
+
+    # --- sharding helpers (shape plumbing, backend-independent) ---
+    def shard_block(self, block: bytes) -> Tuple[np.ndarray, int]:
+        """Split one block into (k, S) zero-padded shards; returns original
+        length for exact reassembly."""
+        k = self.params.rs_data
+        n = len(block)
+        s = (n + k - 1) // k
+        buf = np.zeros(k * s, dtype=np.uint8)
+        buf[:n] = np.frombuffer(block, dtype=np.uint8)
+        return buf.reshape(k, s), n
+
+    def unshard_block(self, data: np.ndarray, length: int) -> bytes:
+        return data.reshape(-1).tobytes()[:length]

@@ -1,0 +1,56 @@
+"""CPU BlockCodec — the correctness and performance baseline.
+
+Hashing uses hashlib (C-speed; releases the GIL for large buffers) fanned out
+over a thread pool, matching the reference's multi-core scrub capability
+(ref src/util/async_hash.rs offloads hashing to blocking threads).
+
+Reed-Solomon runs through the optional C++ native kernel
+(native/gf256.cpp, loaded via ctypes) when built, else vectorized numpy
+log/exp-table math (gf256.gf_matmul_blocks).  Both satisfy the same contract
+as the TPU backend.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+from typing import List, Sequence
+
+import numpy as np
+
+from ..utils.data import BLOCK_HASH_ALGOS, Hash
+from . import gf256
+from .codec import BlockCodec, CodecParams
+from .native import get_native_gf_matmul_blocks
+
+
+class CpuCodec(BlockCodec):
+    def __init__(self, params: CodecParams):
+        super().__init__(params)
+        self._hash_fn = BLOCK_HASH_ALGOS[params.hash_algo]
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, os.cpu_count() or 4),
+            thread_name_prefix="codec-hash",
+        )
+        self._native = get_native_gf_matmul_blocks()
+        if params.rs_data > 0:
+            self._parity_mat = gf256.rs_parity_matrix(params.rs_data, params.rs_parity)
+
+    def batch_hash(self, blocks: Sequence[bytes]) -> List[Hash]:
+        if len(blocks) <= 1:
+            return [self._hash_fn(b) for b in blocks]
+        return list(self._pool.map(self._hash_fn, blocks))
+
+    def _apply(self, mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        if self._native is not None:
+            return self._native(mat, shards)
+        return gf256.gf_matmul_blocks(mat, shards)
+
+    def rs_encode(self, data: np.ndarray) -> np.ndarray:
+        assert data.shape[-2] == self.params.rs_data, data.shape
+        return self._apply(self._parity_mat, np.ascontiguousarray(data, dtype=np.uint8))
+
+    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int]) -> np.ndarray:
+        k, m = self.params.rs_data, self.params.rs_parity
+        dec = gf256.rs_decode_matrix(k, m, present)
+        return self._apply(dec, np.ascontiguousarray(shards[..., :k, :], dtype=np.uint8))

@@ -1,0 +1,211 @@
+"""GF(2^8) arithmetic and Reed-Solomon matrix construction.
+
+New capability vs the reference (which replicates whole blocks; RS(k,m) is
+the north-star addition per BASELINE.json).  Polynomial 0x11D (x^8+x^4+x^3+
+x^2+1), the standard RS-code field shared by ISA-L/jerasure, so shards are
+interoperable with common tooling.
+
+Two execution formulations of the same code:
+
+1. Byte domain (CPU / numpy): y = Σ_i gf_mul(G[p,i], x_i) via log/exp
+   tables — `rs_encode_numpy`.
+2. Bit domain (TPU / MXU): every GF(2^8) constant c is an 8×8 matrix over
+   GF(2) (column j = bits of c·2^j), so the whole RS generator becomes one
+   (8k × 8m) 0/1 matrix W and encoding is `parity_bits = (data_bits @ W) & 1`
+   — an int8 matmul XLA tiles onto the MXU, batched over byte positions.
+   `bitmatrix_of_gf_matrix` builds W; tests assert both formulations agree.
+
+Decoding/repair: invert the surviving k×k submatrix of the extended
+generator over GF(2^8) (`gf_matrix_inverse`, tiny — CPU), then the recovery
+is again a (bit-)matmul with the inverted matrix.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+_POLY = 0x11D
+
+# --- field tables -----------------------------------------------------------
+
+
+def _build_tables() -> Tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _POLY
+    exp[255:510] = exp[0:255]  # wraparound so exp[log a + log b] needs no mod
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(GF_EXP[int(GF_LOG[a]) + int(GF_LOG[b])])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("gf_inv(0)")
+    return int(GF_EXP[255 - int(GF_LOG[a])])
+
+
+def gf_mul_vec(c: int, x: np.ndarray) -> np.ndarray:
+    """c · x elementwise over GF(2^8), x uint8 array."""
+    if c == 0:
+        return np.zeros_like(x)
+    lc = int(GF_LOG[c])
+    out = GF_EXP[lc + GF_LOG[x.astype(np.int32)]]
+    out = np.where(x == 0, 0, out).astype(np.uint8)
+    return out
+
+
+# --- matrices over GF(2^8) --------------------------------------------------
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(r,k)·(k,c) matrix product over GF(2^8); small matrices only."""
+    r, k = a.shape
+    k2, c = b.shape
+    assert k == k2
+    out = np.zeros((r, c), dtype=np.uint8)
+    for i in range(r):
+        for j in range(c):
+            acc = 0
+            for t in range(k):
+                acc ^= gf_mul(int(a[i, t]), int(b[t, j]))
+            out[i, j] = acc
+    return out
+
+
+def gf_matrix_inverse(m: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inverse of a k×k matrix over GF(2^8)."""
+    k = m.shape[0]
+    assert m.shape == (k, k)
+    a = m.astype(np.uint8).copy()
+    inv = np.eye(k, dtype=np.uint8)
+    for col in range(k):
+        piv = next((r for r in range(col, k) if a[r, col] != 0), None)
+        if piv is None:
+            raise ZeroDivisionError("singular matrix over GF(2^8)")
+        if piv != col:
+            a[[col, piv]] = a[[piv, col]]
+            inv[[col, piv]] = inv[[piv, col]]
+        pinv = gf_inv(int(a[col, col]))
+        a[col] = gf_mul_vec(pinv, a[col])
+        inv[col] = gf_mul_vec(pinv, inv[col])
+        for r in range(k):
+            if r != col and a[r, col] != 0:
+                f = int(a[r, col])
+                a[r] ^= gf_mul_vec(f, a[col])
+                inv[r] ^= gf_mul_vec(f, inv[col])
+    return inv
+
+
+def rs_generator_matrix(k: int, m: int) -> np.ndarray:
+    """Systematic extended generator: (k+m, k), top = I_k, bottom = Cauchy
+    parity rows P[i,j] = 1/(x_i ⊕ y_j) with x_i = k+i, y_j = j.  Any k rows
+    of the result are invertible (Cauchy ⊂ MDS), which is exactly the
+    reconstruct-from-any-k property."""
+    if k + m > 256:
+        raise ValueError("k+m must be ≤ 256 for GF(2^8) RS")
+    g = np.zeros((k + m, k), dtype=np.uint8)
+    g[:k] = np.eye(k, dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            g[k + i, j] = gf_inv((k + i) ^ j)
+    return g
+
+
+def rs_parity_matrix(k: int, m: int) -> np.ndarray:
+    return rs_generator_matrix(k, m)[k:]
+
+
+def rs_decode_matrix(k: int, m: int, present: Sequence[int]) -> np.ndarray:
+    """Recovery matrix (k, k): data = D @ shards[present[:k]].
+
+    `present` = indices (into the k+m extended codeword) of ≥k surviving
+    shards; the first k are used."""
+    rows = list(present)[:k]
+    if len(rows) < k:
+        raise ValueError(f"need ≥{k} shards, have {len(rows)}")
+    g = rs_generator_matrix(k, m)
+    sub = g[rows]  # (k, k): shards[rows] = sub @ data
+    return gf_matrix_inverse(sub)
+
+
+# --- byte-domain (CPU) kernels ---------------------------------------------
+
+
+def gf_matmul_blocks(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """Apply (r, k) GF matrix to shards (..., k, S) → (..., r, S).
+
+    Vectorized over byte positions with log/exp tables; the numpy CPU
+    baseline the TPU path must match bit-for-bit."""
+    r, k = mat.shape
+    assert shards.shape[-2] == k
+    out = np.zeros(shards.shape[:-2] + (r, shards.shape[-1]), dtype=np.uint8)
+    for i in range(r):
+        acc = np.zeros(shards.shape[:-2] + (shards.shape[-1],), dtype=np.uint8)
+        for j in range(k):
+            acc ^= gf_mul_vec(int(mat[i, j]), shards[..., j, :])
+        out[..., i, :] = acc
+    return out
+
+
+# --- bit-domain (TPU) matrix construction -----------------------------------
+
+
+def gf_const_bitmatrix(c: int) -> np.ndarray:
+    """8×8 GF(2) matrix M_c with  bits(c·x) = M_c @ bits(x)  (column j =
+    bits of c·2^j, LSB-first)."""
+    mcols = []
+    for j in range(8):
+        prod = gf_mul(c, 1 << j)
+        mcols.append([(prod >> u) & 1 for u in range(8)])
+    return np.array(mcols, dtype=np.uint8).T  # (8 out-bits, 8 in-bits)
+
+
+def bitmatrix_of_gf_matrix(mat: np.ndarray) -> np.ndarray:
+    """Expand an (r, k) GF(2^8) matrix into the (8k, 8m=8r) 0/1 matmul
+    operand W with  out_bits = in_bits @ W  (in_bits laid out as
+    [..., k*8] = shard-major, LSB-first within each byte)."""
+    r, k = mat.shape
+    w = np.zeros((k * 8, r * 8), dtype=np.uint8)
+    for i in range(r):
+        for j in range(k):
+            m = gf_const_bitmatrix(int(mat[i, j]))  # (8 out, 8 in)
+            w[j * 8:(j + 1) * 8, i * 8:(i + 1) * 8] = m.T  # in-bits rows → out-bits cols
+    return w
+
+
+def unpack_bits_lsb(x: np.ndarray) -> np.ndarray:
+    """uint8 (..., n) → (..., n*8) bits, LSB-first per byte."""
+    return np.unpackbits(x, axis=-1, bitorder="little")
+
+
+def pack_bits_lsb(b: np.ndarray) -> np.ndarray:
+    return np.packbits(b, axis=-1, bitorder="little")
+
+
+def rs_encode_bits_numpy(data: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """Reference implementation of the TPU formulation, in numpy:
+    data (..., k, S) uint8 → parity (..., m, S) via bit-matmul mod 2."""
+    k8, m8 = w.shape
+    k, m = k8 // 8, m8 // 8
+    s = data.shape[-1]
+    # (..., S, k) bytes → (..., S, k*8) bits
+    bits = unpack_bits_lsb(np.swapaxes(data, -1, -2).copy())
+    out_bits = (bits.astype(np.int32) @ w.astype(np.int32)) & 1
+    parity = pack_bits_lsb(out_bits.astype(np.uint8))  # (..., S, m)
+    return np.swapaxes(parity, -1, -2).copy()

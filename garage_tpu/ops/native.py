@@ -1,0 +1,93 @@
+"""ctypes loader for the native C++ GF(2^8) kernel (native/gf256.cpp).
+
+Resolved lazily on first use (not import — short CLI invocations must not pay
+for a compiler run); a failed build is cached on disk against the source
+mtime so it is not retried every process start.  Falls back to the numpy
+implementation in gf256.py when unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Callable, Optional
+
+import numpy as np
+
+logger = logging.getLogger("garage_tpu.ops.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libgf256.so")
+_FAIL_MARKER = os.path.join(_NATIVE_DIR, ".build_failed")
+
+_resolved = False
+_fn: Optional[Callable[[np.ndarray, np.ndarray], np.ndarray]] = None
+
+
+def _build_ok() -> bool:
+    src_mtime = os.path.getmtime(os.path.join(_NATIVE_DIR, "gf256.cpp"))
+    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= src_mtime:
+        return True
+    if os.environ.get("GARAGE_TPU_NO_NATIVE_BUILD"):
+        return False
+    if os.path.exists(_FAIL_MARKER) and os.path.getmtime(_FAIL_MARKER) >= src_mtime:
+        return False  # previous build of this exact source failed; don't retry
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "-s"],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception as e:
+        logger.debug("native gf256 build unavailable: %s", e)
+        try:
+            with open(_FAIL_MARKER, "w") as f:
+                f.write(str(e))
+        except OSError:
+            pass
+        return False
+
+
+def _resolve() -> Optional[Callable]:
+    global _resolved, _fn
+    if _resolved:
+        return _fn
+    _resolved = True
+    if not _build_ok():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.gf_matmul_blocks.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.gf_matmul_blocks.restype = None
+    except OSError as e:
+        logger.debug("native gf256 load failed: %s", e)
+        return None
+
+    def _ptr(a: np.ndarray):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+    def fn(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        r, k = mat.shape
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        lead = shards.shape[:-2]
+        batch = int(np.prod(lead)) if lead else 1
+        s = shards.shape[-1]
+        assert shards.shape[-2] == k
+        out = np.zeros(lead + (r, s), dtype=np.uint8)
+        mat_c = np.ascontiguousarray(mat, dtype=np.uint8)
+        lib.gf_matmul_blocks(_ptr(mat_c), _ptr(shards), _ptr(out), batch, r, k, s)
+        return out
+
+    _fn = fn
+    return _fn
+
+
+def get_native_gf_matmul_blocks() -> Optional[Callable]:
+    """The native kernel, or None (numpy fallback); builds on first call."""
+    return _resolve()

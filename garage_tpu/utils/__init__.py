@@ -1,0 +1,1 @@
+"""L1 foundation utilities (ref: src/util)."""

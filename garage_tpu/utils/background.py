@@ -1,0 +1,200 @@
+"""Background worker runtime.
+
+Equivalent of reference src/util/background/: the `Worker` trait with
+work/wait_for_work phases (background/worker.rs:41-59), the processor loop
+with exponential error backoff 1.5^n capped at ~1h and a worker-info registry
+(worker.rs:61-232), `BackgroundRunner` (background/mod.rs:16-60), and the
+runtime-tunable `BgVars` (background/vars.rs:8-45).
+
+TPU-native difference: the reference runs workers on a tokio runtime; here
+workers are asyncio tasks.  Batch-producing workers (scrub/resync) additionally
+talk to the codec's device executor, which runs JAX dispatch on a dedicated
+thread so the event loop never blocks on TPU work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("garage_tpu.background")
+
+
+class WorkerState(enum.Enum):
+    # ref util/background/worker.rs:19-39
+    BUSY = "busy"
+    THROTTLED = "throttled"
+    IDLE = "idle"
+    DONE = "done"
+
+
+class WorkerStatus:
+    """Operator-visible worker state (ref worker.rs WorkerStatus/WorkerInfo)."""
+
+    def __init__(self) -> None:
+        self.state = WorkerState.IDLE
+        self.errors = 0
+        self.consecutive_errors = 0
+        self.last_error: Optional[str] = None
+        self.last_error_time: float = 0.0
+        self.tranquility: Optional[int] = None
+        self.progress: Optional[str] = None
+        self.queue_length: Optional[int] = None
+        self.persistent_errors: Optional[int] = None
+        self.freeform: List[str] = []
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "state": self.state.value,
+            "errors": self.errors,
+            "consecutive_errors": self.consecutive_errors,
+            "last_error": self.last_error,
+            "tranquility": self.tranquility,
+            "progress": self.progress,
+            "queue_length": self.queue_length,
+            "persistent_errors": self.persistent_errors,
+            "freeform": self.freeform,
+        }
+
+
+class Worker:
+    """Subclass and implement `work` (one step, returns a WorkerState) and
+    optionally `wait_for_work` (ref background/worker.rs:41-59)."""
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def status(self) -> WorkerStatus:
+        st = getattr(self, "_status", None)
+        if st is None:
+            st = self._status = WorkerStatus()
+        return st
+
+    async def work(self) -> WorkerState:
+        raise NotImplementedError
+
+    async def wait_for_work(self) -> None:
+        """Called when work() returned IDLE; return when there may be work."""
+        await asyncio.sleep(1.0)
+
+
+# ref background/worker.rs:28-33: backoff base 1.5^n, 10 errors before warn
+_ERROR_RETRY_BASE = 1.0
+_ERROR_RETRY_MAX = 3600.0
+
+
+class BackgroundRunner:
+    """Spawns and tracks workers (ref background/mod.rs:16-60 +
+    worker.rs:61-175 WorkerProcessor)."""
+
+    def __init__(self) -> None:
+        self.workers: Dict[int, Worker] = {}
+        self.tasks: Dict[int, asyncio.Task] = {}
+        self._next_id = 0
+        self.stopping = asyncio.Event()
+
+    def spawn(self, worker: Worker) -> int:
+        wid = self._next_id
+        self._next_id += 1
+        self.workers[wid] = worker
+        self.tasks[wid] = asyncio.get_running_loop().create_task(
+            self._run_worker(wid, worker), name=f"worker-{wid}-{worker.name()}"
+        )
+        return wid
+
+    async def _run_worker(self, wid: int, worker: Worker) -> None:
+        status = worker.status()
+        while not self.stopping.is_set():
+            try:
+                state = await worker.work()
+                status.consecutive_errors = 0
+                status.state = state
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                status.errors += 1
+                status.consecutive_errors += 1
+                status.last_error = f"{type(e).__name__}: {e}"
+                status.last_error_time = time.time()
+                log = logger.warning if status.consecutive_errors >= 10 else logger.debug
+                log("worker %s (%d) error: %s", worker.name(), wid, e, exc_info=True)
+                # ref worker.rs:161-167: exponential backoff 1.5^n
+                delay = min(
+                    _ERROR_RETRY_BASE * (1.5 ** min(status.consecutive_errors, 20)),
+                    _ERROR_RETRY_MAX,
+                )
+                await self._sleep_or_stop(delay)
+                continue
+            if state == WorkerState.DONE:
+                logger.info("worker %s (%d) done", worker.name(), wid)
+                return
+            if state == WorkerState.IDLE:
+                wait = asyncio.ensure_future(worker.wait_for_work())
+                stop = asyncio.ensure_future(self.stopping.wait())
+                done, pending = await asyncio.wait(
+                    {wait, stop}, return_when=asyncio.FIRST_COMPLETED
+                )
+                for p in pending:
+                    p.cancel()
+                    await asyncio.gather(p, return_exceptions=True)
+                for d in done:
+                    # a raising wait_for_work must not hot-spin the loop
+                    if d is wait and d.exception() is not None:
+                        logger.warning(
+                            "worker %s (%d) wait_for_work error: %s",
+                            worker.name(), wid, d.exception(),
+                        )
+                        await self._sleep_or_stop(1.0)
+
+    async def _sleep_or_stop(self, delay: float) -> None:
+        try:
+            await asyncio.wait_for(self.stopping.wait(), timeout=delay)
+        except asyncio.TimeoutError:
+            pass
+
+    def worker_info(self) -> Dict[int, Dict[str, Any]]:
+        """Registry for `garage worker list` (ref worker.rs:189-232)."""
+        return {
+            wid: {"name": w.name(), **w.status().to_dict()}
+            for wid, w in self.workers.items()
+        }
+
+    async def shutdown(self, timeout: float = 8.0) -> None:
+        """Signal stop; hard-cancel after deadline (ref worker.rs:100-113
+        8s exit deadline)."""
+        self.stopping.set()
+        tasks = [t for t in self.tasks.values() if not t.done()]
+        if tasks:
+            done, pending = await asyncio.wait(tasks, timeout=timeout)
+            for t in pending:
+                t.cancel()
+            await asyncio.gather(*pending, return_exceptions=True)
+
+
+class BgVars:
+    """Runtime-tunable named variables, settable from the CLI
+    (ref util/background/vars.rs:8-45)."""
+
+    def __init__(self) -> None:
+        self._vars: Dict[str, tuple] = {}  # name -> (getter, setter|None)
+
+    def register_rw(self, name: str, getter: Callable[[], Any], setter: Callable[[Any], None]) -> None:
+        self._vars[name] = (getter, setter)
+
+    def register_ro(self, name: str, getter: Callable[[], Any]) -> None:
+        self._vars[name] = (getter, None)
+
+    def get(self, name: str) -> Any:
+        return self._vars[name][0]()
+
+    def set(self, name: str, value: Any) -> None:
+        g, s = self._vars[name]
+        if s is None:
+            raise KeyError(f"variable {name} is read-only")
+        s(value)
+
+    def all(self) -> Dict[str, Any]:
+        return {k: g() for k, (g, _) in sorted(self._vars.items())}

@@ -1,0 +1,179 @@
+"""Daemon configuration.
+
+Equivalent of reference src/util/config.rs:14-298: a single TOML file with
+secrets loadable from separate files (refusing world-readable secret files,
+config.rs:280-287), human-friendly capacities ("10G", config.rs:300-340) and
+compression levels, plus env-var secret overrides (ref garage/main.rs:69-85:
+GARAGE_RPC_SECRET etc. → here GARAGE_TPU_RPC_SECRET).
+
+TPU-first additions under ``[codec]``: backend selection (cpu|tpu), block
+hash algorithm (blake2s is the device-friendly default), Reed-Solomon (k, m)
+data/parity split, and device batch sizing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import stat
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class ConfigError(Exception):
+    pass
+
+
+_CAPACITY_RE = re.compile(r"^\s*([0-9]+(?:\.[0-9]+)?)\s*([kKmMgGtT]?)(i?)[bB]?\s*$")
+_CAPACITY_MULT = {"": 1, "k": 10**3, "m": 10**6, "g": 10**9, "t": 10**12}
+_CAPACITY_MULT_IEC = {"k": 2**10, "m": 2**20, "g": 2**30, "t": 2**40}
+
+
+def parse_capacity(v: Any) -> int:
+    """'10G' / '100M' / int → bytes (ref util/config.rs:300-340, SI units);
+    IEC suffixes ('1GiB') are honored as binary multiples."""
+    if isinstance(v, int):
+        return v
+    m = _CAPACITY_RE.match(str(v))
+    if not m:
+        raise ConfigError(f"invalid capacity: {v!r}")
+    unit = m.group(2).lower()
+    if m.group(3):
+        if not unit:
+            raise ConfigError(f"invalid capacity: {v!r}")
+        return int(float(m.group(1)) * _CAPACITY_MULT_IEC[unit])
+    return int(float(m.group(1)) * _CAPACITY_MULT[unit])
+
+
+def secret_from_file(path: str) -> str:
+    """Read a secret file, refusing world-readable perms
+    (ref util/config.rs:268-298)."""
+    st = os.stat(path)
+    if st.st_mode & (stat.S_IRWXG | stat.S_IRWXO) and not os.environ.get(
+        "GARAGE_TPU_ALLOW_WORLD_READABLE_SECRETS"
+    ):
+        raise ConfigError(
+            f"secret file {path} is group/world-accessible "
+            f"(mode {oct(st.st_mode & 0o777)}); chmod 0600 it"
+        )
+    with open(path, "r") as f:
+        return f.read().strip()
+
+
+@dataclass
+class CodecConfig:
+    """TPU block-codec settings (new vs reference — the BlockCodec seam)."""
+    backend: str = "cpu"            # cpu | tpu
+    hash_algo: str = "blake2s"      # blake2s (TPU-offloadable) | blake2b | sha256
+    rs_data: int = 0                # Reed-Solomon k (0 = replication only, no RS)
+    rs_parity: int = 0              # Reed-Solomon m
+    batch_blocks: int = 256         # blocks per device batch (scrub/resync producers)
+    shard_mesh: int = 1             # devices to shard codec batches over
+
+
+@dataclass
+class Config:
+    """Top-level config (ref util/config.rs:14-107)."""
+    metadata_dir: str = "./meta"
+    data_dir: List[Dict[str, Any]] = field(default_factory=list)  # [{path, capacity?, read_only?}]
+    block_size: int = 1024 * 1024       # ref config.rs:234-236 default 1 MiB
+    replication_mode: str = "3"         # ref rpc/replication_mode.rs
+    compression_level: Optional[int] = 1  # zstd level; None = off (ref config.rs:342-394)
+    rpc_bind_addr: str = "0.0.0.0:3901"
+    rpc_public_addr: Optional[str] = None
+    rpc_secret: Optional[str] = None
+    bootstrap_peers: List[str] = field(default_factory=list)
+    db_engine: str = "sqlite"           # sqlite | memory (ref model/garage.rs:114-213)
+    metadata_fsync: bool = True
+    data_fsync: bool = False
+    s3_api_bind_addr: Optional[str] = "0.0.0.0:3900"
+    s3_region: str = "garage"
+    root_domain: Optional[str] = None
+    web_bind_addr: Optional[str] = None
+    web_root_domain: Optional[str] = None
+    admin_api_bind_addr: Optional[str] = None
+    admin_metrics_token: Optional[str] = None
+    admin_token: Optional[str] = None
+    k2v_api_bind_addr: Optional[str] = None
+    codec: CodecConfig = field(default_factory=CodecConfig)
+    # raw parsed TOML for anything not modeled
+    raw: Dict[str, Any] = field(default_factory=dict, repr=False)
+
+
+_SECRET_ENV = {
+    "rpc_secret": "GARAGE_TPU_RPC_SECRET",
+    "admin_token": "GARAGE_TPU_ADMIN_TOKEN",
+    "admin_metrics_token": "GARAGE_TPU_METRICS_TOKEN",
+}
+
+
+def read_config(path: str) -> Config:
+    """Load + validate a TOML config file (ref util/config.rs:239-266)."""
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    return config_from_dict(raw)
+
+
+def config_from_dict(raw: Dict[str, Any]) -> Config:
+    cfg = Config(raw=raw)
+    for key in (
+        "metadata_dir", "block_size", "replication_mode", "compression_level",
+        "rpc_bind_addr", "rpc_public_addr", "rpc_secret", "bootstrap_peers",
+        "db_engine", "metadata_fsync", "data_fsync", "root_domain",
+    ):
+        if key in raw:
+            setattr(cfg, key, raw[key])
+    if "block_size" in raw:
+        cfg.block_size = parse_capacity(raw["block_size"])
+    cfg.replication_mode = str(cfg.replication_mode)
+
+    dd = raw.get("data_dir", "./data")
+    if isinstance(dd, str):
+        cfg.data_dir = [{"path": dd}]
+    elif isinstance(dd, list):
+        cfg.data_dir = [
+            {**d, "capacity": parse_capacity(d["capacity"])} if "capacity" in d else dict(d)
+            for d in dd
+        ]
+    else:
+        raise ConfigError("data_dir must be a string or list of tables")
+
+    s3 = raw.get("s3_api", {})
+    cfg.s3_api_bind_addr = s3.get("api_bind_addr", cfg.s3_api_bind_addr)
+    cfg.s3_region = s3.get("s3_region", cfg.s3_region)
+    cfg.root_domain = s3.get("root_domain", cfg.root_domain)
+
+    web = raw.get("s3_web", {})
+    cfg.web_bind_addr = web.get("bind_addr", cfg.web_bind_addr)
+    cfg.web_root_domain = web.get("root_domain", cfg.web_root_domain)
+
+    admin = raw.get("admin", {})
+    cfg.admin_api_bind_addr = admin.get("api_bind_addr", cfg.admin_api_bind_addr)
+    cfg.admin_metrics_token = admin.get("metrics_token", cfg.admin_metrics_token)
+    cfg.admin_token = admin.get("admin_token", cfg.admin_token)
+
+    k2v = raw.get("k2v_api", {})
+    cfg.k2v_api_bind_addr = k2v.get("api_bind_addr", cfg.k2v_api_bind_addr)
+
+    codec = raw.get("codec", {})
+    known = {f.name for f in dataclasses.fields(CodecConfig)}
+    bad = set(codec) - known
+    if bad:
+        raise ConfigError(f"unknown [codec] keys: {sorted(bad)}")
+    cfg.codec = CodecConfig(**codec)
+    if cfg.codec.backend not in ("cpu", "tpu"):
+        raise ConfigError(f"codec.backend must be cpu|tpu, got {cfg.codec.backend!r}")
+    if (cfg.codec.rs_data == 0) != (cfg.codec.rs_parity == 0):
+        raise ConfigError("codec.rs_data and codec.rs_parity must both be 0 or both be >0")
+
+    # secrets: env overrides > `<key>_file` in TOML > inline value
+    for key, env in _SECRET_ENV.items():
+        if os.environ.get(env):
+            setattr(cfg, key, os.environ[env])
+        elif raw.get(f"{key}_file"):
+            setattr(cfg, key, secret_from_file(raw[f"{key}_file"]))
+        elif key in ("admin_token", "admin_metrics_token") and admin.get(f"{key}_file"):
+            setattr(cfg, key, secret_from_file(admin[f"{key}_file"]))
+    return cfg

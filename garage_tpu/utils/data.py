@@ -1,0 +1,100 @@
+"""Core data types and hash functions.
+
+Equivalent of reference src/util/data.rs: the 32-byte `FixedBytes32` type
+(used as both node/object UUIDs and content hashes, data.rs:9), plus the
+hash functions `sha256sum` (data.rs:106), `blake2sum` (data.rs:117, blake2b-256)
+and `fasthash` (data.rs:131, xxh3 in the reference; here blake2b-8byte keyed —
+stdlib, non-cryptographic use only) and `gen_uuid` (data.rs:140).
+
+TPU-first addition: `blake2s_sum` — BLAKE2s-256 is the framework's default
+*block* hash because its 32-bit compression function maps onto the TPU VPU
+(uint32 add/xor/rotate), unlike blake2b's 64-bit arithmetic.  The metadata
+plane keeps blake2b for parity with the reference's semantics.  Both are
+exact RFC 7693 and the TPU implementation (ops/tpu_blake2s.py) is verified
+bit-identical against hashlib.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Union
+
+ZERO_UUID: bytes = b"\x00" * 32
+
+
+class FixedBytes32(bytes):
+    """An exactly-32-byte value: UUIDs and hashes (ref util/data.rs:9-104).
+
+    Subclass of ``bytes`` so it is hashable, comparable and serializable
+    everywhere a plain byte string works.
+    """
+
+    __slots__ = ()
+
+    def __new__(cls, value: Union[bytes, bytearray, memoryview, str]) -> "FixedBytes32":
+        if isinstance(value, str):
+            value = bytes.fromhex(value)
+        b = bytes(value)
+        if len(b) != 32:
+            raise ValueError(f"FixedBytes32 requires exactly 32 bytes, got {len(b)}")
+        return super().__new__(cls, b)
+
+    def hex_short(self) -> str:
+        """First 16 hex chars — display form (ref util/data.rs hex_::<16>)."""
+        return self.hex()[:16]
+
+    def as_int_prefix(self, nbytes: int = 2) -> int:
+        """Big-endian integer of the first `nbytes` (ring partition lookup)."""
+        return int.from_bytes(self[:nbytes], "big")
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"FixedBytes32({self.hex()[:16]}…)"
+
+
+# In the reference Uuid and Hash are both aliases of FixedBytes32 (data.rs:96-104).
+Uuid = FixedBytes32
+Hash = FixedBytes32
+
+
+def sha256sum(data: bytes) -> Hash:
+    """SHA-256 (ref util/data.rs:106-112): S3 content sha / SigV4."""
+    return Hash(hashlib.sha256(data).digest())
+
+
+def blake2sum(data: bytes) -> Hash:
+    """BLAKE2b-256 (ref util/data.rs:117-125): metadata/merkle hash."""
+    return Hash(hashlib.blake2b(data, digest_size=32).digest())
+
+
+def blake2s_sum(data: bytes) -> Hash:
+    """BLAKE2s-256: the TPU-native block content hash (framework default)."""
+    return Hash(hashlib.blake2s(data, digest_size=32).digest())
+
+
+def md5sum(data: bytes) -> bytes:
+    """MD5 — S3 ETag compatibility only."""
+    return hashlib.md5(data, usedforsecurity=False).digest()
+
+
+def fasthash(data: bytes) -> int:
+    """64-bit non-cryptographic hash (ref util/data.rs:131 uses xxh3;
+    we use an 8-byte blake2b, stdlib and stable across processes)."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+def gen_uuid() -> Uuid:
+    """Random 32-byte UUID (ref util/data.rs:140-142)."""
+    return Uuid(os.urandom(32))
+
+
+BLOCK_HASH_ALGOS = {
+    "blake2s": blake2s_sum,  # TPU-offloadable (default)
+    "blake2b": blake2sum,    # reference-compatible
+    "sha256": sha256sum,
+}
+
+
+def block_hash(data: bytes, algo: str = "blake2s") -> Hash:
+    """Content hash of a data block under the configured algorithm."""
+    return BLOCK_HASH_ALGOS[algo](data)

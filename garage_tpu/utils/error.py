@@ -1,0 +1,46 @@
+"""Framework error model (ref src/util/error.rs)."""
+
+from __future__ import annotations
+
+
+class GarageError(Exception):
+    """Base error (ref util/error.rs Error enum)."""
+
+
+class RpcError(GarageError):
+    """Remote call failed (ref util/error.rs Error::RemoteError)."""
+
+
+class QuorumError(RpcError):
+    """Quorum not reached (ref util/error.rs Error::Quorum)."""
+
+    def __init__(self, needed: int, got: int, errors: list):
+        self.needed, self.got, self.errors = needed, got, list(errors)
+        super().__init__(
+            f"quorum not reached: {got}/{needed} ok; errors: "
+            + "; ".join(str(e) for e in self.errors[:4])
+        )
+
+
+class TimeoutError_(RpcError):
+    pass
+
+
+class CorruptData(GarageError):
+    """Block content does not match its hash (ref util/error.rs CorruptData)."""
+
+    def __init__(self, expected_hash):
+        self.expected_hash = expected_hash
+        super().__init__(f"corrupt data for block {bytes(expected_hash).hex()[:16]}")
+
+
+class NoSuchBlock(GarageError):
+    pass
+
+
+class DbError(GarageError):
+    pass
+
+
+class LayoutError(GarageError):
+    """Invalid cluster layout operation (ref util/error.rs Message variants)."""

@@ -1,0 +1,23 @@
+"""Test config: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding tests use a virtual
+8-device CPU platform per the standard JAX testing pattern.  The environment
+presets JAX_PLATFORMS=axon (the real TPU tunnel), so we must override —
+tests never touch the real chip (bench.py does).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after env is set)
+
+# The axon TPU plugin overrides JAX_PLATFORMS from the environment, so force
+# the platform through the config API as well.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,111 @@
+"""GF(2^8) field + Reed-Solomon matrix tests (new capability per
+BASELINE.json; formulation-equivalence is the key invariant: byte-domain
+log/exp math ≡ bit-domain matmul math)."""
+
+import numpy as np
+import pytest
+
+from garage_tpu.ops import gf256
+
+
+class TestField:
+    def test_mul_identity_zero(self):
+        for a in (0, 1, 7, 255):
+            assert gf256.gf_mul(a, 1) == a
+            assert gf256.gf_mul(a, 0) == 0
+
+    def test_mul_commutative_associative(self):
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            a, b, c = rng.integers(0, 256, 3)
+            assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+            assert gf256.gf_mul(gf256.gf_mul(a, b), c) == gf256.gf_mul(
+                a, gf256.gf_mul(b, c)
+            )
+
+    def test_inverse(self):
+        for a in range(1, 256):
+            assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+
+    def test_distributive_over_xor(self):
+        rng = np.random.default_rng(1)
+        for _ in range(200):
+            a, b, c = rng.integers(0, 256, 3)
+            assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+
+    def test_mul_vec_matches_scalar(self):
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, 256, 1000).astype(np.uint8)
+        for c in (0, 1, 2, 29, 255):
+            vec = gf256.gf_mul_vec(c, x)
+            assert all(int(vec[i]) == gf256.gf_mul(c, int(x[i])) for i in range(0, 1000, 97))
+
+
+class TestMatrices:
+    def test_inverse_roundtrip(self):
+        rng = np.random.default_rng(3)
+        for k in (2, 4, 8):
+            while True:
+                m = rng.integers(0, 256, (k, k)).astype(np.uint8)
+                try:
+                    inv = gf256.gf_matrix_inverse(m)
+                    break
+                except ZeroDivisionError:
+                    continue
+            assert np.array_equal(gf256.gf_matmul(m, inv), np.eye(k, dtype=np.uint8))
+
+    def test_generator_is_mds(self):
+        """Any k rows of the extended generator are invertible — the
+        reconstruct-from-any-k property."""
+        import itertools
+        k, m = 4, 2
+        g = gf256.rs_generator_matrix(k, m)
+        for rows in itertools.combinations(range(k + m), k):
+            gf256.gf_matrix_inverse(g[list(rows)])  # must not raise
+
+    def test_encode_decode_roundtrip_byte_domain(self):
+        rng = np.random.default_rng(4)
+        k, m, s = 8, 4, 512
+        data = rng.integers(0, 256, (3, k, s)).astype(np.uint8)
+        parity = gf256.gf_matmul_blocks(gf256.rs_parity_matrix(k, m), data)
+        code = np.concatenate([data, parity], axis=1)  # (3, k+m, s)
+        # kill 4 shards (2 data, 2 parity), reconstruct from survivors
+        present = [0, 2, 4, 5, 6, 7, 9, 10]
+        dec = gf256.rs_decode_matrix(k, m, present)
+        rec = gf256.gf_matmul_blocks(dec, code[:, present[:k], :])
+        assert np.array_equal(rec, data)
+
+    def test_bit_domain_equals_byte_domain(self):
+        """The TPU matmul formulation is bit-identical to log/exp math."""
+        rng = np.random.default_rng(5)
+        k, m, s = 4, 2, 256
+        pm = gf256.rs_parity_matrix(k, m)
+        data = rng.integers(0, 256, (2, k, s)).astype(np.uint8)
+        byte_par = gf256.gf_matmul_blocks(pm, data)
+        w = gf256.bitmatrix_of_gf_matrix(pm)
+        bit_par = gf256.rs_encode_bits_numpy(data, w)
+        assert np.array_equal(byte_par, bit_par)
+
+    def test_const_bitmatrix(self):
+        for c in (0, 1, 2, 3, 29, 142, 255):
+            mc = gf256.gf_const_bitmatrix(c)
+            for x in (0, 1, 5, 77, 255):
+                xbits = np.array([(x >> j) & 1 for j in range(8)], dtype=np.uint8)
+                ybits = (mc @ xbits) & 1
+                y = int(sum(int(b) << u for u, b in enumerate(ybits)))
+                assert y == gf256.gf_mul(c, x)
+
+
+class TestNative:
+    def test_native_matches_numpy_if_built(self):
+        from garage_tpu.ops.native import get_native_gf_matmul_blocks
+        native_gf_matmul_blocks = get_native_gf_matmul_blocks()
+        if native_gf_matmul_blocks is None:
+            pytest.skip("native kernel not built")
+        rng = np.random.default_rng(6)
+        k, m, s = 8, 4, 1024
+        pm = gf256.rs_parity_matrix(k, m)
+        data = rng.integers(0, 256, (5, k, s)).astype(np.uint8)
+        assert np.array_equal(
+            native_gf_matmul_blocks(pm, data), gf256.gf_matmul_blocks(pm, data)
+        )

@@ -1,0 +1,209 @@
+"""L1 foundation tests (model: ref src/util config/migrate/crdt unit tests,
+util/config.rs:396-507, util/migrate.rs:80-157)."""
+
+import os
+
+import pytest
+
+from garage_tpu.utils import crdt
+from garage_tpu.utils.config import (
+    ConfigError, config_from_dict, parse_capacity, read_config, secret_from_file,
+)
+from garage_tpu.utils.data import (
+    FixedBytes32, blake2s_sum, blake2sum, fasthash, gen_uuid, sha256sum,
+)
+from garage_tpu.utils.migrate import DecodeError, Migrated
+
+
+class TestData:
+    def test_fixed_bytes32(self):
+        h = FixedBytes32(b"\x01" * 32)
+        assert len(h) == 32
+        assert FixedBytes32(h.hex()) == h
+        with pytest.raises(ValueError):
+            FixedBytes32(b"short")
+
+    def test_hashes_are_32_bytes_and_stable(self):
+        assert sha256sum(b"hello").hex() == (
+            "2cf24dba5fb0a30e26e83b2ac5b9e29e1b161e5c1fa7425e73043362938b9824"
+        )
+        assert len(blake2sum(b"x")) == 32
+        assert len(blake2s_sum(b"x")) == 32
+        assert blake2sum(b"a") != blake2s_sum(b"a")
+        assert fasthash(b"abc") == fasthash(b"abc")
+
+    def test_gen_uuid_unique(self):
+        assert gen_uuid() != gen_uuid()
+
+    def test_partition_prefix(self):
+        h = FixedBytes32(bytes([0xAB, 0xCD]) + b"\x00" * 30)
+        assert h.as_int_prefix(2) == 0xABCD
+
+
+class TestCrdt:
+    def test_lww_merge_takes_latest(self):
+        a = crdt.Lww("a", ts=10)
+        b = crdt.Lww("b", ts=20)
+        a.merge(b)
+        assert a.value == "b" and a.ts == 20
+        # merge is idempotent
+        a.merge(b)
+        assert a.value == "b"
+
+    def test_lww_tie_breaks_deterministically(self):
+        a = crdt.Lww("a", ts=10)
+        b = crdt.Lww("b", ts=10)
+        a2 = crdt.Lww("a", ts=10)
+        b2 = crdt.Lww("b", ts=10)
+        a.merge(b)
+        b2.merge(a2)
+        assert a.value == b2.value  # commutative
+
+    def test_lww_tie_break_unorderable_values(self):
+        """Equal-ts merges of non-orderable values (dicts) must converge,
+        not raise (total order via canonical encoding)."""
+        a = crdt.Lww({"b": 2}, ts=10)
+        b = crdt.Lww({"a": 1}, ts=10)
+        a2 = crdt.Lww({"b": 2}, ts=10)
+        b2 = crdt.Lww({"a": 1}, ts=10)
+        a.merge(b)
+        b2.merge(a2)
+        assert a.value == b2.value
+
+    def test_lww_update_monotonic(self):
+        a = crdt.Lww("a", ts=10**18)  # far future
+        old_ts = a.ts
+        a.update("b")
+        assert a.ts == old_ts + 1 and a.value == "b"
+
+    def test_lww_map(self):
+        m1 = crdt.LwwMap()
+        m1.update_in_place("k", 1, ts=5)
+        m2 = crdt.LwwMap()
+        m2.update_in_place("k", 2, ts=9)
+        m2.update_in_place("j", 7, ts=1)
+        m1.merge(m2)
+        assert m1.get("k") == 2 and m1.get("j") == 7
+        assert m1.pack() == crdt.LwwMap.unpack(m1.pack()).pack()
+
+    def test_bool_or_merge(self):
+        a, b = crdt.CrdtBool(False), crdt.CrdtBool(True)
+        a.merge(b)
+        assert a.value
+
+    def test_deletable_delete_wins(self):
+        a = crdt.Deletable.present(5)
+        a.merge(crdt.Deletable.delete())
+        assert a.is_deleted()
+        # and stays deleted
+        a.merge(crdt.Deletable.present(9))
+        assert a.is_deleted()
+
+    def test_crdt_map_pointwise(self):
+        a = crdt.CrdtMap({"x": crdt.Lww(1, ts=1)})
+        b = crdt.CrdtMap({"x": crdt.Lww(2, ts=2), "y": crdt.Lww(3, ts=1)})
+        a.merge(b)
+        assert a.items["x"].value == 2 and a.items["y"].value == 3
+
+
+class V1(Migrated):
+    VERSION_MARKER = b"G1test"
+
+    def __init__(self, a):
+        self.a = a
+
+    def fields(self):
+        return {"a": self.a}
+
+    @classmethod
+    def from_fields(cls, body):
+        return cls(body["a"])
+
+
+class V2(Migrated):
+    VERSION_MARKER = b"G2test"
+    PREVIOUS = V1
+
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def fields(self):
+        return {"a": self.a, "b": self.b}
+
+    @classmethod
+    def from_fields(cls, body):
+        return cls(body["a"], body["b"])
+
+    @classmethod
+    def migrate(cls, old):
+        return cls(old.a, "migrated")
+
+
+class TestMigrate:
+    def test_roundtrip(self):
+        v = V2("x", "y")
+        out = V2.decode(v.encode())
+        assert (out.a, out.b) == ("x", "y")
+
+    def test_migration_chain(self):
+        old_bytes = V1("legacy").encode()
+        out = V2.decode(old_bytes)
+        assert (out.a, out.b) == ("legacy", "migrated")
+
+    def test_unknown_marker(self):
+        with pytest.raises(DecodeError):
+            V2.decode(b"ZZZZjunk")
+
+
+class TestConfig:
+    def test_parse_capacity(self):
+        assert parse_capacity("10G") == 10_000_000_000
+        assert parse_capacity("1M") == 1_000_000
+        assert parse_capacity("1GiB") == 2**30
+        assert parse_capacity("4Ki") == 4096
+        assert parse_capacity(42) == 42
+        with pytest.raises(ConfigError):
+            parse_capacity("lots")
+
+    def test_read_config(self, tmp_path):
+        p = tmp_path / "c.toml"
+        p.write_text(
+            """
+metadata_dir = "/tmp/meta"
+data_dir = "/tmp/data"
+block_size = "1M"
+replication_mode = "2"
+rpc_bind_addr = "127.0.0.1:3901"
+bootstrap_peers = []
+
+[s3_api]
+api_bind_addr = "127.0.0.1:3900"
+s3_region = "test"
+
+[codec]
+backend = "cpu"
+rs_data = 4
+rs_parity = 2
+"""
+        )
+        cfg = read_config(str(p))
+        assert cfg.block_size == 1_000_000
+        assert cfg.replication_mode == "2"
+        assert cfg.codec.rs_data == 4
+        assert cfg.data_dir == [{"path": "/tmp/data"}]
+        assert cfg.s3_region == "test"
+
+    def test_secret_file_permissions(self, tmp_path):
+        s = tmp_path / "secret"
+        s.write_text("hunter2\n")
+        os.chmod(s, 0o644)
+        with pytest.raises(ConfigError):
+            secret_from_file(str(s))
+        os.chmod(s, 0o600)
+        assert secret_from_file(str(s)) == "hunter2"
+
+    def test_codec_validation(self):
+        with pytest.raises(ConfigError):
+            config_from_dict({"codec": {"backend": "gpu"}})
+        with pytest.raises(ConfigError):
+            config_from_dict({"codec": {"rs_data": 4}})  # parity missing
