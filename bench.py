@@ -1,0 +1,80 @@
+"""Benchmark: scrub + RS(8,4) throughput, TPU codec vs CPU baseline.
+
+Per BASELINE.md the project metric is scrub+RS(8,4)-repair GiB/s over 1 MiB
+blocks (the reference's scrub is a sequential per-block CPU verify,
+ref src/block/repair.rs:438-490).  This bench runs the batched scrub step —
+BLAKE2s-256 integrity verify + Reed-Solomon(8,4) parity encode — over the
+same data on both backends and reports TPU GiB/s with vs_baseline = ratio
+over the CPU codec on this host.
+
+Prints ONE JSON line:
+  {"metric": "scrub_rs84_throughput", "value": <tpu GiB/s>, "unit": "GiB/s",
+   "vs_baseline": <tpu/cpu ratio>}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _timeit(fn, *, warmup: int = 1, iters: int = 3) -> float:
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    from garage_tpu.ops import make_codec
+
+    block_size = 1 << 20  # 1 MiB, the reference's default block size
+    n_blocks = 64         # 64 MiB per batch
+    k, m = 8, 4
+
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 256, (n_blocks, block_size), dtype=np.uint8)
+    blocks = [arr[i].tobytes() for i in range(n_blocks)]
+    shards = arr.reshape(n_blocks, k, block_size // k)
+
+    cpu = make_codec("cpu", rs_data=k, rs_parity=m)
+    hashes = cpu.batch_hash(blocks)
+
+    def run(codec):
+        ok = codec.batch_verify(blocks, hashes)
+        parity = codec.rs_encode(shards)
+        assert ok.all()
+        return parity
+
+    total_bytes = n_blocks * block_size
+    cpu_s = _timeit(lambda: run(cpu))
+    cpu_gibps = total_bytes / cpu_s / (1 << 30)
+
+    import traceback
+
+    try:
+        tpu = make_codec("tpu", rs_data=k, rs_parity=m)
+        tpu_s = _timeit(lambda: run(tpu))
+        tpu_gibps = total_bytes / tpu_s / (1 << 30)
+    except Exception:
+        traceback.print_exc()
+        tpu_gibps = 0.0  # a failed TPU path reports 0, never the CPU number
+
+    print(
+        json.dumps(
+            {
+                "metric": "scrub_rs84_throughput",
+                "value": round(tpu_gibps, 4),
+                "unit": "GiB/s",
+                "vs_baseline": round(tpu_gibps / cpu_gibps, 4) if cpu_gibps else 0.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""CountedTree — a Tree with an O(1) in-RAM length counter.
+
+Equivalent of reference src/db/counted_tree_hack.rs:16-34: sqlite COUNT(*)
+is O(n), but the resync queue/error trees and gc_todo need cheap `.len()`
+for metrics and scheduling, so the count is kept in an atomic alongside the
+tree and initialized from a real count at open.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Iterator, Optional, Tuple
+
+from . import Tree
+
+
+class CountedTree:
+    def __init__(self, tree: Tree):
+        self.tree = tree
+        self._count = len(tree)
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self._count
+
+    def is_empty(self) -> bool:
+        return self._count == 0
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self.tree.get(key)
+
+    def insert(self, key: bytes, value: bytes) -> Optional[bytes]:
+        old = self.tree.insert(key, value)
+        if old is None:
+            with self._lock:
+                self._count += 1
+        return old
+
+    def remove(self, key: bytes) -> Optional[bytes]:
+        old = self.tree.remove(key)
+        if old is not None:
+            with self._lock:
+                self._count -= 1
+        return old
+
+    def first(self) -> Optional[Tuple[bytes, bytes]]:
+        return self.tree.first()
+
+    def items(self, start=None, end=None) -> Iterator[Tuple[bytes, bytes]]:
+        return self.tree.items(start, end)
+
+    def get_gt(self, key: bytes) -> Optional[Tuple[bytes, bytes]]:
+        return self.tree.get_gt(key)

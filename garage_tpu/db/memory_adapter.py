@@ -1,0 +1,162 @@
+"""In-memory DB engine — sorted maps behind one lock.
+
+Test/ephemeral engine; conforms to the same suite as sqlite
+(tests/test_db.py, mirroring ref db/test.rs run across engines).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from . import IDb, Transaction, TxAbort
+
+
+class _MemTree:
+    __slots__ = ("name", "data", "keys")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.data = {}
+        self.keys: List[bytes] = []  # sorted
+
+    def insert(self, key: bytes, value: bytes) -> Optional[bytes]:
+        old = self.data.get(key)
+        if old is None:
+            bisect.insort(self.keys, key)
+        self.data[key] = value
+        return old
+
+    def remove(self, key: bytes) -> Optional[bytes]:
+        old = self.data.pop(key, None)
+        if old is not None:
+            i = bisect.bisect_left(self.keys, key)
+            del self.keys[i]
+        return old
+
+    def range_keys(
+        self, start: Optional[bytes], end: Optional[bytes], reverse: bool
+    ) -> List[bytes]:
+        lo = 0 if start is None else bisect.bisect_left(self.keys, start)
+        hi = len(self.keys) if end is None else bisect.bisect_left(self.keys, end)
+        ks = self.keys[lo:hi]
+        return ks[::-1] if reverse else ks
+
+
+class MemoryDb(IDb):
+    engine = "memory"
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._trees: List[_MemTree] = []
+        self._by_name = {}
+
+    def open_tree(self, name: str) -> int:
+        with self._lock:
+            if name in self._by_name:
+                return self._by_name[name]
+            self._trees.append(_MemTree(name))
+            idx = len(self._trees) - 1
+            self._by_name[name] = idx
+            return idx
+
+    def list_trees(self) -> List[str]:
+        with self._lock:
+            return [t.name for t in self._trees]
+
+    def get(self, tree: int, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._trees[tree].data.get(key)
+
+    def len(self, tree: int) -> int:
+        with self._lock:
+            return len(self._trees[tree].data)
+
+    def insert(self, tree: int, key: bytes, value: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._trees[tree].insert(bytes(key), bytes(value))
+
+    def remove(self, tree: int, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._trees[tree].remove(bytes(key))
+
+    def clear(self, tree: int) -> None:
+        with self._lock:
+            t = self._trees[tree]
+            t.data.clear()
+            t.keys.clear()
+
+    def iter_range(
+        self,
+        tree: int,
+        start: Optional[bytes],
+        end: Optional[bytes],
+        reverse: bool = False,
+    ) -> Iterator[Tuple[bytes, bytes]]:
+        # Snapshot the key range so concurrent mutation can't corrupt the
+        # walk; values are read live (same behavior as a cursor walk).
+        with self._lock:
+            t = self._trees[tree]
+            ks = t.range_keys(start, end, reverse)
+        for k in ks:
+            with self._lock:
+                v = t.data.get(k)
+            if v is not None:
+                yield k, v
+
+    def transaction(self, fn: Callable[[Transaction], object]):
+        with self._lock:
+            tx = _MemTx(self)
+            try:
+                res = fn(tx)
+            except TxAbort as a:
+                tx.rollback()
+                return a.value
+            except BaseException:
+                tx.rollback()
+                raise
+        for hook in tx._on_commit:
+            hook()
+        return res
+
+
+class _MemTx(Transaction):
+    """Undo-log transaction over the in-memory trees (lock held by caller)."""
+
+    def __init__(self, db: MemoryDb):
+        super().__init__()
+        self.db = db
+        self._undo: List[Tuple[int, bytes, Optional[bytes]]] = []
+
+    def get(self, tree, key):
+        return self.db._trees[tree.idx].data.get(bytes(key))
+
+    def len(self, tree):
+        return len(self.db._trees[tree.idx].data)
+
+    def insert(self, tree, key, value):
+        old = self.db._trees[tree.idx].insert(bytes(key), bytes(value))
+        self._undo.append((tree.idx, bytes(key), old))
+        return old
+
+    def remove(self, tree, key):
+        old = self.db._trees[tree.idx].remove(bytes(key))
+        if old is not None:
+            self._undo.append((tree.idx, bytes(key), old))
+        return old
+
+    def iter_range(self, tree, start=None, end=None, reverse=False):
+        t = self.db._trees[tree.idx]
+        for k in t.range_keys(start, end, reverse):
+            v = t.data.get(k)
+            if v is not None:
+                yield k, v
+
+    def rollback(self):
+        for tree_idx, key, old in reversed(self._undo):
+            t = self.db._trees[tree_idx]
+            if old is None:
+                t.remove(key)
+            else:
+                t.insert(key, old)

@@ -24,14 +24,26 @@ from .codec import BlockCodec, CodecParams
 from .native import get_native_gf_matmul_blocks
 
 
+_SHARED_POOL = None
+
+
+def _hash_pool() -> concurrent.futures.ThreadPoolExecutor:
+    """One process-wide hashing pool shared by every CpuCodec instance
+    (codecs are constructed transiently; per-instance pools would leak)."""
+    global _SHARED_POOL
+    if _SHARED_POOL is None:
+        _SHARED_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, os.cpu_count() or 4),
+            thread_name_prefix="codec-hash",
+        )
+    return _SHARED_POOL
+
+
 class CpuCodec(BlockCodec):
     def __init__(self, params: CodecParams):
         super().__init__(params)
         self._hash_fn = BLOCK_HASH_ALGOS[params.hash_algo]
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(32, os.cpu_count() or 4),
-            thread_name_prefix="codec-hash",
-        )
+        self._pool = _hash_pool()
         self._native = get_native_gf_matmul_blocks()
         if params.rs_data > 0:
             self._parity_mat = gf256.rs_parity_matrix(params.rs_data, params.rs_parity)

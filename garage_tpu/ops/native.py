@@ -26,6 +26,19 @@ _resolved = False
 _fn: Optional[Callable[[np.ndarray, np.ndarray], np.ndarray]] = None
 
 
+def _load_lib() -> ctypes.CDLL:
+    """Load the .so; a load failure (e.g. a stale binary built on another
+    host — the Makefile uses -march=native) triggers one clean rebuild."""
+    try:
+        return ctypes.CDLL(_SO_PATH)
+    except OSError:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "-s", "clean", "all"],
+            check=True, capture_output=True, timeout=120,
+        )
+        return ctypes.CDLL(_SO_PATH)
+
+
 def _build_ok() -> bool:
     src_mtime = os.path.getmtime(os.path.join(_NATIVE_DIR, "gf256.cpp"))
     if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= src_mtime:
@@ -58,7 +71,7 @@ def _resolve() -> Optional[Callable]:
     if not _build_ok():
         return None
     try:
-        lib = ctypes.CDLL(_SO_PATH)
+        lib = _load_lib()
         lib.gf_matmul_blocks.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_uint8),

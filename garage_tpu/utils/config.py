@@ -67,10 +67,24 @@ class CodecConfig:
     """TPU block-codec settings (new vs reference — the BlockCodec seam)."""
     backend: str = "cpu"            # cpu | tpu
     hash_algo: str = "blake2s"      # blake2s (TPU-offloadable) | blake2b | sha256
-    rs_data: int = 0                # Reed-Solomon k (0 = replication only, no RS)
-    rs_parity: int = 0              # Reed-Solomon m
+    rs_data: int = 8                # Reed-Solomon k (0 = replication only, no RS)
+    rs_parity: int = 4              # Reed-Solomon m
     batch_blocks: int = 256         # blocks per device batch (scrub/resync producers)
     shard_mesh: int = 1             # devices to shard codec batches over
+
+    def make(self, compression_level: Optional[int] = 1):
+        """Build the configured BlockCodec (forwards only the fields
+        CodecParams knows; `backend`/`shard_mesh` select the impl)."""
+        from ..ops import make_codec
+
+        return make_codec(
+            self.backend,
+            hash_algo=self.hash_algo,
+            rs_data=self.rs_data,
+            rs_parity=self.rs_parity,
+            batch_blocks=self.batch_blocks,
+            compression_level=compression_level,
+        )
 
 
 @dataclass

@@ -1,0 +1,170 @@
+"""Shared DB engine conformance suite, run against every engine.
+
+Mirrors the reference's pattern of one `test_suite(db)` applied to all
+engines (ref src/db/test.rs:1-111).
+"""
+
+import threading
+
+import pytest
+
+from garage_tpu.db import TxAbort, open_db
+from garage_tpu.db.counted_tree import CountedTree
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def db(request, tmp_path):
+    if request.param == "sqlite":
+        d = open_db("sqlite", str(tmp_path / "db.sqlite"))
+    else:
+        d = open_db("memory")
+    yield d
+    d.close()
+
+
+def test_get_insert_remove(db):
+    t = db.open_tree("t")
+    assert t.get(b"k") is None
+    assert t.insert(b"k", b"v1") is None
+    assert t.get(b"k") == b"v1"
+    assert t.insert(b"k", b"v2") == b"v1"
+    assert t.get(b"k") == b"v2"
+    assert len(t) == 1
+    assert t.remove(b"k") == b"v2"
+    assert t.remove(b"k") is None
+    assert len(t) == 0 and t.is_empty()
+
+
+def test_ordered_iteration_and_range(db):
+    t = db.open_tree("t")
+    keys = [bytes([i]) for i in (5, 1, 9, 3, 7)]
+    for k in keys:
+        t.insert(k, k * 2)
+    assert [k for k, _ in t.items()] == sorted(keys)
+    assert [k for k, _ in t.items_rev()] == sorted(keys, reverse=True)
+    assert [k for k, _ in t.items(bytes([3]), bytes([8]))] == [
+        bytes([3]), bytes([5]), bytes([7])
+    ]
+    assert t.first() == (bytes([1]), bytes([1, 1]))
+    assert t.get_gt(bytes([5])) == (bytes([7]), bytes([7, 7]))
+    assert t.get_gt(bytes([9])) is None
+
+
+def test_multiple_trees_independent(db):
+    a, b = db.open_tree("a"), db.open_tree("b")
+    a.insert(b"k", b"va")
+    b.insert(b"k", b"vb")
+    assert a.get(b"k") == b"va" and b.get(b"k") == b"vb"
+    assert set(db.list_trees()) >= {"a", "b"}
+    assert db.open_tree("a") is a
+
+
+def test_transaction_commit(db):
+    t = db.open_tree("t")
+    t.insert(b"a", b"1")
+    fired = []
+
+    def txf(tx):
+        assert tx.get(t, b"a") == b"1"
+        tx.insert(t, b"b", b"2")
+        assert tx.get(t, b"b") == b"2"
+        tx.remove(t, b"a")
+        tx.on_commit(lambda: fired.append(True))
+        return "done"
+
+    assert db.transaction(txf) == "done"
+    assert t.get(b"a") is None and t.get(b"b") == b"2"
+    assert fired == [True]
+
+
+def test_transaction_abort_rolls_back(db):
+    t = db.open_tree("t")
+    t.insert(b"a", b"1")
+    fired = []
+
+    def txf(tx):
+        tx.insert(t, b"a", b"overwritten")
+        tx.insert(t, b"b", b"2")
+        tx.remove(t, b"a")
+        tx.on_commit(lambda: fired.append(True))
+        raise TxAbort("aborted-value")
+
+    assert db.transaction(txf) == "aborted-value"
+    assert t.get(b"a") == b"1"
+    assert t.get(b"b") is None
+    assert fired == []
+
+
+def test_transaction_exception_rolls_back_and_raises(db):
+    t = db.open_tree("t")
+
+    def txf(tx):
+        tx.insert(t, b"x", b"1")
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        db.transaction(txf)
+    assert t.get(b"x") is None
+
+
+def test_transaction_iter(db):
+    t = db.open_tree("t")
+    for i in range(5):
+        t.insert(bytes([i]), bytes([i]))
+
+    def txf(tx):
+        return [k for k, _ in tx.iter_range(t, bytes([1]), bytes([4]))]
+
+    assert db.transaction(txf) == [bytes([1]), bytes([2]), bytes([3])]
+
+
+def test_iteration_survives_concurrent_mutation(db):
+    t = db.open_tree("t")
+    for i in range(100):
+        t.insert(i.to_bytes(2, "big"), b"v")
+    seen = []
+    for k, _ in t.items():
+        seen.append(k)
+        if len(seen) == 50:
+            t.remove((99).to_bytes(2, "big"))
+            t.insert((300).to_bytes(2, "big"), b"new")
+    assert len(seen) >= 99
+
+
+def test_threaded_writes(db):
+    t = db.open_tree("t")
+
+    def writer(base):
+        for i in range(50):
+            t.insert((base + i).to_bytes(4, "big"), b"v")
+
+    threads = [threading.Thread(target=writer, args=(n * 1000,)) for n in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(t) == 200
+
+
+def test_counted_tree(db):
+    t = db.open_tree("t")
+    t.insert(b"pre", b"1")
+    ct = CountedTree(t)
+    assert len(ct) == 1
+    ct.insert(b"a", b"1")
+    ct.insert(b"a", b"2")  # overwrite: count unchanged
+    assert len(ct) == 2
+    ct.remove(b"a")
+    ct.remove(b"a")
+    assert len(ct) == 1 and not ct.is_empty()
+
+
+def test_sqlite_snapshot(tmp_path):
+    d = open_db("sqlite", str(tmp_path / "db.sqlite"))
+    t = d.open_tree("t")
+    t.insert(b"k", b"v")
+    d.snapshot(str(tmp_path / "snap.sqlite"))
+    d.close()
+    d2 = open_db("sqlite", str(tmp_path / "snap.sqlite"))
+    assert d2.open_tree("t").get(b"k") == b"v"
+    d2.close()

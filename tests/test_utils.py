@@ -206,4 +206,4 @@ rs_parity = 2
         with pytest.raises(ConfigError):
             config_from_dict({"codec": {"backend": "gpu"}})
         with pytest.raises(ConfigError):
-            config_from_dict({"codec": {"rs_data": 4}})  # parity missing
+            config_from_dict({"codec": {"rs_data": 4, "rs_parity": 0}})
