@@ -204,8 +204,13 @@ class _SqliteTx(Transaction):
             conds.append("k < ?"); params.append(end)
         where = ("WHERE " + " AND ".join(conds)) if conds else ""
         order = "DESC" if reverse else "ASC"
-        rows = self.db._conn.execute(
-            f"SELECT k, v FROM {self.db._table(tree.idx)} {where} ORDER BY k {order}",
-            params,
-        ).fetchall()
-        return iter(rows)
+        # stream via cursor: the tx holds BEGIN IMMEDIATE + the adapter
+        # lock, so a live cursor is consistent and avoids materializing
+        # the whole range
+        return iter(
+            self.db._conn.execute(
+                f"SELECT k, v FROM {self.db._table(tree.idx)} {where} "
+                f"ORDER BY k {order}",
+                params,
+            )
+        )

@@ -1,0 +1,703 @@
+"""NetApp — authenticated, multiplexed, priority-scheduled RPC transport.
+
+Equivalent of the reference's netapp crate (SURVEY.md §2.3): TCP transport
+with an ed25519 handshake (node ID = public key; a cluster-wide shared
+secret gates membership, ref rpc/system.rs:22-23,185-242), typed endpoints,
+multiplexed request streams with 4 priorities, and streaming bodies.
+
+Design notes (asyncio-native, not a port):
+  - One reader task and one writer task per connection.  Outgoing frames sit
+    in four bounded per-priority deques; the writer always drains the most
+    urgent non-empty level, so PRIO_BACKGROUND bulk (resync/scrub) yields to
+    PRIO_HIGH gossip at 16 KiB granularity.
+  - A request = msgpack header + opaque payload + optional byte stream.
+    Responses mirror that.  Stream frames of one stream are FIFO, which
+    gives the reference's OrderTag ordering for free within a stream.
+  - Handshake: both sides exchange pubkey+nonce, then prove (a) possession
+    of the cluster secret (HMAC-SHA256 over the transcript) and (b) their
+    node identity (ed25519 signature over the transcript).  The channel is
+    authenticated, not encrypted — same trust model as deployments of the
+    reference that run RPC on a private network.
+
+Known simplification (round 1): incoming per-stream buffers are bounded by
+blocking the connection reader (head-of-line) rather than per-stream flow
+control; bodies are consumed promptly by the block layer so the window is
+rarely hit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import logging
+import os
+import struct
+import time
+from collections import deque
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
+
+import msgpack
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+from ..utils.data import FixedBytes32
+from ..utils.error import RpcError
+from .frame import (
+    CHUNK,
+    HDR_SIZE,
+    K_DATA,
+    K_EOS,
+    K_ERR,
+    K_GOODBYE,
+    K_PING,
+    K_PONG,
+    K_REQ,
+    K_RESP,
+    MAX_FRAME,
+    N_PRIO,
+    PRIO_HIGH,
+    PRIO_NORMAL,
+    Frame,
+    decode_header,
+)
+
+logger = logging.getLogger("garage_tpu.net")
+
+NodeID = FixedBytes32
+
+MAGIC = b"GTPU/1\n"
+_OUT_QUEUE_LIMIT = 16       # frames buffered per priority level
+_IN_STREAM_LIMIT = 128      # chunks buffered per incoming stream (~2 MiB)
+
+
+def gen_node_key() -> Ed25519PrivateKey:
+    return Ed25519PrivateKey.generate()
+
+
+def key_to_bytes(key: Ed25519PrivateKey) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.Raw,
+        serialization.PrivateFormat.Raw,
+        serialization.NoEncryption(),
+    )
+
+
+def key_from_bytes(raw: bytes) -> Ed25519PrivateKey:
+    return Ed25519PrivateKey.from_private_bytes(raw)
+
+
+def node_id_of(key: Ed25519PrivateKey) -> NodeID:
+    return NodeID(
+        key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+    )
+
+
+def load_or_gen_node_key(path: str) -> Ed25519PrivateKey:
+    """Persisted node identity, file mode 0600 (ref rpc/system.rs:201-242)."""
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return key_from_bytes(f.read())
+    key = gen_node_key()
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(key_to_bytes(key))
+    return key
+
+
+class ByteStream:
+    """Incoming streaming body: async-iterate 16 KiB chunks."""
+
+    def __init__(self, limit: int = _IN_STREAM_LIMIT):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=limit)
+        self._err: Optional[str] = None
+
+    async def _push(self, chunk: Optional[bytes]):
+        await self._q.put(chunk)
+
+    def _fail(self, err: str):
+        self._err = err
+        try:
+            self._q.put_nowait(None)
+        except asyncio.QueueFull:
+            pass
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> bytes:
+        if self._err is not None and self._q.empty():
+            raise RpcError(f"stream error: {self._err}")
+        chunk = await self._q.get()
+        if chunk is None:
+            if self._err is not None:
+                raise RpcError(f"stream error: {self._err}")
+            raise StopAsyncIteration
+        return chunk
+
+    async def read_all(self) -> bytes:
+        return b"".join([c async for c in self])
+
+
+# handler(remote_node, msg, body) -> (resp_msg, resp_body | None)
+Handler = Callable[
+    [NodeID, Any, Optional[ByteStream]],
+    Awaitable[Tuple[Any, Optional[AsyncIterator[bytes]]]],
+]
+
+
+class Endpoint:
+    """A typed RPC endpoint (ref netapp endpoint registration, e.g.
+    table/table.rs:72-74).  Register a handler server-side; call remotely."""
+
+    def __init__(self, netapp: "NetApp", path: str):
+        self.netapp = netapp
+        self.path = path
+        self.handler: Optional[Handler] = None
+
+    def set_handler(self, handler: Handler) -> "Endpoint":
+        self.handler = handler
+        return self
+
+    async def call(
+        self,
+        node: NodeID,
+        msg: Any,
+        prio: int = PRIO_NORMAL,
+        timeout: Optional[float] = 30.0,
+        body: Optional[AsyncIterator[bytes]] = None,
+    ) -> Any:
+        resp, stream = await self.call_streaming(node, msg, prio, timeout, body)
+        if stream is not None:
+            await stream.read_all()  # drain ignored body
+        return resp
+
+    async def call_streaming(
+        self,
+        node: NodeID,
+        msg: Any,
+        prio: int = PRIO_NORMAL,
+        timeout: Optional[float] = 30.0,
+        body: Optional[AsyncIterator[bytes]] = None,
+    ) -> Tuple[Any, Optional[ByteStream]]:
+        return await self.netapp.call_streaming(node, self.path, msg, prio, timeout, body)
+
+
+class _OutMux:
+    """Bounded per-priority outgoing frame queues + strict-priority pop."""
+
+    def __init__(self):
+        self.queues = [deque() for _ in range(N_PRIO)]
+        self.cv = asyncio.Condition()
+        self.closed = False
+
+    async def put(self, frame: Frame):
+        async with self.cv:
+            while (
+                len(self.queues[frame.prio]) >= _OUT_QUEUE_LIMIT and not self.closed
+            ):
+                await self.cv.wait()
+            if self.closed:
+                raise RpcError("connection closed")
+            self.queues[frame.prio].append(frame)
+            self.cv.notify_all()
+
+    async def pop(self) -> Optional[Frame]:
+        async with self.cv:
+            while True:
+                for q in self.queues:
+                    if q:
+                        frame = q.popleft()
+                        self.cv.notify_all()
+                        return frame
+                if self.closed:
+                    return None
+                await self.cv.wait()
+
+    async def close(self):
+        async with self.cv:
+            self.closed = True
+            self.cv.notify_all()
+
+
+class Connection:
+    """One authenticated, multiplexed peer connection."""
+
+    def __init__(
+        self,
+        netapp: "NetApp",
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        remote_id: NodeID,
+        is_dialer: bool,
+    ):
+        self.netapp = netapp
+        self.reader = reader
+        self.writer = writer
+        self.remote_id = remote_id
+        self.is_dialer = is_dialer
+        self._next_stream = 1 if is_dialer else 2  # odd/even split
+        self._out = _OutMux()
+        self._pending: Dict[int, asyncio.Future] = {}   # stream -> resp future
+        self._in_streams: Dict[int, ByteStream] = {}
+        self._pings: Dict[bytes, asyncio.Future] = {}
+        self._tasks: list = []
+        self._closed = False
+        self.last_seen = time.monotonic()
+
+    def start(self):
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._read_loop()),
+            loop.create_task(self._write_loop()),
+        ]
+
+    # --- outgoing ---
+
+    def _alloc_stream(self) -> int:
+        sid = self._next_stream
+        self._next_stream += 2
+        return sid
+
+    async def request(
+        self,
+        path: str,
+        msg_bytes: bytes,
+        prio: int,
+        timeout: Optional[float],
+        body: Optional[AsyncIterator[bytes]],
+    ) -> Tuple[bytes, Optional[ByteStream]]:
+        if self._closed:
+            raise RpcError(f"connection to {self.remote_id.hex_short()} closed")
+        sid = self._alloc_stream()
+        header = msgpack.packb(
+            {"p": path, "b": body is not None}, use_bin_type=True
+        )
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[sid] = fut
+        try:
+            await self._out.put(
+                Frame(K_REQ, prio, sid, struct.pack(">I", len(header)) + header + msg_bytes)
+            )
+            pump = None
+            if body is not None:
+                pump = asyncio.get_running_loop().create_task(
+                    self._pump_body(sid, prio, body)
+                )
+            try:
+                resp_payload, stream = await (
+                    asyncio.wait_for(fut, timeout) if timeout else fut
+                )
+            finally:
+                if pump is not None and not pump.done():
+                    pump.cancel()
+            hlen = struct.unpack(">I", resp_payload[:4])[0]
+            rheader = msgpack.unpackb(resp_payload[4 : 4 + hlen], raw=False)
+            rbody = resp_payload[4 + hlen :]
+            if not rheader.get("ok", False):
+                raise RpcError(rheader.get("err", "remote error"))
+            return rbody, stream
+        except asyncio.TimeoutError:
+            raise RpcError(
+                f"rpc timeout after {timeout}s calling {path} on "
+                f"{self.remote_id.hex_short()}"
+            )
+        finally:
+            self._pending.pop(sid, None)
+
+    async def _pump_body(self, sid: int, prio: int, body: AsyncIterator[bytes]):
+        try:
+            async for chunk in body:
+                for i in range(0, len(chunk), CHUNK):
+                    await self._out.put(Frame(K_DATA, prio, sid, bytes(chunk[i : i + CHUNK])))
+            await self._out.put(Frame(K_EOS, prio, sid, b""))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.debug("body pump error on stream %d: %s", sid, e)
+            try:
+                await self._out.put(Frame(K_ERR, prio, sid, str(e).encode()))
+            except RpcError:
+                pass
+
+    async def ping(self, timeout: float = 10.0) -> float:
+        token = os.urandom(8)
+        fut = asyncio.get_running_loop().create_future()
+        self._pings[token] = fut
+        t0 = time.monotonic()
+        try:
+            await self._out.put(Frame(K_PING, PRIO_HIGH, 0, token))
+            await asyncio.wait_for(fut, timeout)
+            return time.monotonic() - t0
+        finally:
+            self._pings.pop(token, None)
+
+    # --- loops ---
+
+    async def _write_loop(self):
+        try:
+            while True:
+                frame = await self._out.pop()
+                if frame is None:
+                    break
+                self.writer.write(frame.encode())
+                await self.writer.drain()
+        except (ConnectionError, asyncio.CancelledError, OSError):
+            pass
+        finally:
+            await self._shutdown()
+
+    async def _read_loop(self):
+        try:
+            while True:
+                hdr = await self.reader.readexactly(HDR_SIZE)
+                kind, prio, sid, length = decode_header(hdr)
+                if length > MAX_FRAME:
+                    raise RpcError(f"oversized frame: {length}")
+                payload = await self.reader.readexactly(length) if length else b""
+                self.last_seen = time.monotonic()
+                await self._dispatch(kind, prio, sid, payload)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            asyncio.CancelledError,
+            OSError,
+            RpcError,
+        ):
+            pass
+        finally:
+            await self._shutdown()
+
+    async def _dispatch(self, kind: int, prio: int, sid: int, payload: bytes):
+        if kind == K_REQ:
+            hlen = struct.unpack(">I", payload[:4])[0]
+            header = msgpack.unpackb(payload[4 : 4 + hlen], raw=False)
+            msg = payload[4 + hlen :]
+            body = None
+            if header.get("b"):
+                body = ByteStream()
+                self._in_streams[sid] = body
+            asyncio.get_running_loop().create_task(
+                self._handle_request(sid, prio, header["p"], msg, body)
+            )
+        elif kind == K_RESP:
+            # register the body stream before resolving the future, and hand
+            # the stream object to the future directly — it may be fully
+            # received (and deregistered) before the caller wakes up
+            hlen = struct.unpack(">I", payload[:4])[0]
+            rheader = msgpack.unpackb(payload[4 : 4 + hlen], raw=False)
+            stream = None
+            if rheader.get("b"):
+                stream = ByteStream()
+                self._in_streams[sid] = stream
+            fut = self._pending.get(sid)
+            if fut is not None and not fut.done():
+                fut.set_result((payload, stream))
+        elif kind == K_DATA:
+            stream = self._in_streams.get(sid)
+            if stream is not None:
+                await stream._push(payload)  # blocks reader when full (HOL)
+        elif kind == K_EOS:
+            stream = self._in_streams.pop(sid, None)
+            if stream is not None:
+                await stream._push(None)
+        elif kind == K_ERR:
+            stream = self._in_streams.pop(sid, None)
+            if stream is not None:
+                stream._fail(payload.decode("utf-8", "replace"))
+        elif kind == K_PING:
+            await self._out.put(Frame(K_PONG, PRIO_HIGH, 0, payload))
+        elif kind == K_PONG:
+            fut = self._pings.get(bytes(payload))
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+        elif kind == K_GOODBYE:
+            raise RpcError("peer said goodbye")
+
+    async def _handle_request(
+        self, sid: int, prio: int, path: str, msg: bytes, body: Optional[ByteStream]
+    ):
+        ep = self.netapp.endpoints.get(path)
+        try:
+            if ep is None or ep.handler is None:
+                raise RpcError(f"no handler for endpoint {path!r}")
+            msg_obj = msgpack.unpackb(msg, raw=False)
+            resp_obj, resp_body = await ep.handler(self.remote_id, msg_obj, body)
+            resp = msgpack.packb(resp_obj, use_bin_type=True)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.debug("handler %s error: %s", path, e)
+            header = msgpack.packb({"ok": False, "err": str(e)}, use_bin_type=True)
+            try:
+                await self._out.put(
+                    Frame(K_RESP, prio, sid, struct.pack(">I", len(header)) + header)
+                )
+            except RpcError:
+                pass
+            return
+        header = msgpack.packb({"ok": True, "b": resp_body is not None}, use_bin_type=True)
+        try:
+            await self._out.put(
+                Frame(K_RESP, prio, sid, struct.pack(">I", len(header)) + header + resp)
+            )
+            if resp_body is not None:
+                await self._pump_body(sid, prio, resp_body)
+        except RpcError:
+            pass
+
+    async def _shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        await self._out.close()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(RpcError("connection lost"))
+        for stream in self._in_streams.values():
+            stream._fail("connection lost")
+        self._in_streams.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        self.netapp._conn_lost(self)
+
+    async def close(self):
+        try:
+            await self._out.put(Frame(K_GOODBYE, PRIO_HIGH, 0, b""))
+        except RpcError:
+            pass
+        await asyncio.sleep(0)
+        await self._shutdown()
+        for t in self._tasks:
+            t.cancel()
+
+
+class NetApp:
+    """The node's RPC stack: listener, dialer, endpoint registry, conn map."""
+
+    def __init__(self, privkey: Ed25519PrivateKey, secret: Optional[str] = None):
+        self.privkey = privkey
+        self.id: NodeID = node_id_of(privkey)
+        self.secret = (secret or "").encode()
+        self.endpoints: Dict[str, Endpoint] = {}
+        self.conns: Dict[NodeID, Connection] = {}
+        self.on_connected: Optional[Callable[[NodeID, bool], None]] = None
+        self.on_disconnected: Optional[Callable[[NodeID], None]] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._dial_locks: Dict[str, asyncio.Lock] = {}
+        self._addr_ids: Dict[str, NodeID] = {}  # addr -> last node seen there
+
+    def endpoint(self, path: str) -> Endpoint:
+        ep = self.endpoints.get(path)
+        if ep is None:
+            ep = Endpoint(self, path)
+            self.endpoints[path] = ep
+        return ep
+
+    # --- handshake ---
+
+    def _transcript_mac(self, transcript: bytes, label: bytes) -> bytes:
+        return hmac.new(self.secret, transcript + label, hashlib.sha256).digest()
+
+    async def _handshake(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, is_dialer: bool
+    ) -> NodeID:
+        my_pub = bytes(self.id)
+        my_nonce = os.urandom(32)
+        writer.write(MAGIC + my_pub + my_nonce)
+        await writer.drain()
+        hello = await asyncio.wait_for(reader.readexactly(len(MAGIC) + 64), 10.0)
+        if hello[: len(MAGIC)] != MAGIC:
+            raise RpcError("bad protocol magic")
+        their_pub = hello[len(MAGIC) : len(MAGIC) + 32]
+        their_nonce = hello[len(MAGIC) + 32 :]
+        if is_dialer:
+            transcript = MAGIC + my_pub + my_nonce + their_pub + their_nonce
+            my_label, their_label = b"dialer", b"listener"
+        else:
+            transcript = MAGIC + their_pub + their_nonce + my_pub + my_nonce
+            my_label, their_label = b"listener", b"dialer"
+        sig = self.privkey.sign(transcript + my_label)
+        mac = self._transcript_mac(transcript, my_label)
+        writer.write(sig + mac)
+        await writer.drain()
+        proof = await asyncio.wait_for(reader.readexactly(64 + 32), 10.0)
+        their_sig, their_mac = proof[:64], proof[64:]
+        if not hmac.compare_digest(
+            their_mac, self._transcript_mac(transcript, their_label)
+        ):
+            raise RpcError("peer does not know the cluster secret")
+        Ed25519PublicKey.from_public_bytes(their_pub).verify(
+            their_sig, transcript + their_label
+        )
+        return NodeID(their_pub)
+
+    # --- connection management ---
+
+    def _register_conn(self, conn: Connection) -> bool:
+        """Keep one connection per peer.  On a simultaneous-dial race the
+        connection dialed by the lower node ID wins deterministically."""
+        old = self.conns.get(conn.remote_id)
+        if old is not None and not old._closed:
+            new_dialer = self.id if conn.is_dialer else conn.remote_id
+            old_dialer = self.id if old.is_dialer else old.remote_id
+            if old_dialer == new_dialer:
+                # same dialer re-dialed (e.g. reconnect we haven't noticed):
+                # the newest connection is the live one — replace old
+                asyncio.get_running_loop().create_task(old.close())
+            elif old_dialer <= new_dialer:
+                # simultaneous cross-dial: both sides deterministically keep
+                # the connection dialed by the smaller node id
+                return False
+            else:
+                asyncio.get_running_loop().create_task(old.close())
+        self.conns[conn.remote_id] = conn
+        if self.on_connected:
+            self.on_connected(conn.remote_id, conn.is_dialer)
+        return True
+
+    def _conn_lost(self, conn: Connection):
+        cur = self.conns.get(conn.remote_id)
+        if cur is conn:
+            del self.conns[conn.remote_id]
+            if self.on_disconnected:
+                self.on_disconnected(conn.remote_id)
+
+    async def listen(self, bind_addr: str):
+        host, port = bind_addr.rsplit(":", 1)
+        self._server = await asyncio.start_server(
+            self._accept, host or "0.0.0.0", int(port)
+        )
+
+    async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            remote = await self._handshake(reader, writer, is_dialer=False)
+        except Exception as e:
+            logger.debug("handshake failed (accept): %s", e)
+            writer.close()
+            return
+        conn = Connection(self, reader, writer, remote, is_dialer=False)
+        if self._register_conn(conn):
+            conn.start()
+        else:
+            writer.close()
+
+    async def connect(self, addr: str, expected_id: Optional[NodeID] = None) -> Connection:
+        """Dial a peer.  Dials to one address are serialized and live
+        connections reused, so concurrent discovery/peering dials can't
+        create duplicate connections that then kill each other."""
+        lock = self._dial_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            known = expected_id or self._addr_ids.get(addr)
+            if known is not None:
+                existing = self.conns.get(known)
+                if existing is not None and not existing._closed:
+                    return existing
+            host, port = addr.rsplit(":", 1)
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), 10.0
+            )
+            try:
+                remote = await self._handshake(reader, writer, is_dialer=True)
+            except Exception:
+                writer.close()
+                raise
+            if expected_id is not None and remote != expected_id:
+                writer.close()
+                raise RpcError(
+                    f"peer at {addr} is {remote.hex_short()}, expected "
+                    f"{expected_id.hex_short()}"
+                )
+            if remote == self.id:
+                writer.close()
+                raise RpcError("connected to self")
+            self._addr_ids[addr] = remote
+            conn = Connection(self, reader, writer, remote, is_dialer=True)
+            if not self._register_conn(conn):
+                writer.close()
+                return self.conns[remote]
+            conn.start()
+            return conn
+
+    # --- calls ---
+
+    async def call_streaming(
+        self,
+        node: NodeID,
+        path: str,
+        msg: Any,
+        prio: int = PRIO_NORMAL,
+        timeout: Optional[float] = 30.0,
+        body: Optional[AsyncIterator[bytes]] = None,
+    ) -> Tuple[Any, Optional[ByteStream]]:
+        msg_bytes = msgpack.packb(msg, use_bin_type=True)
+        if node == self.id:
+            return await self._local_call(path, msg_bytes, body)
+        conn = self.conns.get(node)
+        if conn is None or conn._closed:
+            raise RpcError(f"not connected to {node.hex_short()}")
+        resp_bytes, stream = await conn.request(path, msg_bytes, prio, timeout, body)
+        return msgpack.unpackb(resp_bytes, raw=False), stream
+
+    async def _local_call(self, path, msg_bytes, body):
+        """Self-calls short-circuit the network (the reference does the same
+        via its own entry in the node list)."""
+        ep = self.endpoints.get(path)
+        if ep is None or ep.handler is None:
+            raise RpcError(f"no handler for endpoint {path!r}")
+        in_stream: Optional[ByteStream] = None
+        pump = None
+        if body is not None:
+            in_stream = ByteStream()
+
+            async def _feed():
+                try:
+                    async for chunk in body:
+                        await in_stream._push(bytes(chunk))
+                    await in_stream._push(None)
+                except Exception as e:
+                    in_stream._fail(str(e))
+
+            pump = asyncio.get_running_loop().create_task(_feed())
+        try:
+            resp, resp_body = await ep.handler(
+                self.id, msgpack.unpackb(msg_bytes, raw=False), in_stream
+            )
+        finally:
+            if pump is not None and not pump.done():
+                pump.cancel()
+        out_stream = None
+        if resp_body is not None:
+            out_stream = ByteStream()
+
+            async def _feed_out():
+                try:
+                    async for chunk in resp_body:
+                        await out_stream._push(bytes(chunk))
+                    await out_stream._push(None)
+                except Exception as e:
+                    out_stream._fail(str(e))
+
+            asyncio.get_running_loop().create_task(_feed_out())
+        return resp, out_stream
+
+    async def shutdown(self):
+        # stop accepting first, then close conns; only then wait_closed —
+        # py3.12 Server.wait_closed blocks until every accepted transport
+        # is closed, so the order matters
+        if self._server is not None:
+            self._server.close()
+        for conn in list(self.conns.values()):
+            await conn.close()
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                logger.debug("server wait_closed timed out")

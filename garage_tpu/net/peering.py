@@ -1,0 +1,166 @@
+"""Full-mesh peering: keep a connection to every known peer, ping for
+latency, expose liveness.
+
+Equivalent of netapp's FullMeshPeeringStrategy (ref rpc/system.rs:329-332):
+the latency estimates feed RpcHelper's request ordering
+(ref rpc/rpc_helper.rs:392-435) and the ping liveness feeds `is_up`
+(ref rpc/system.rs:405-426).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set, Tuple
+
+from .netapp import NetApp, NodeID
+
+logger = logging.getLogger("garage_tpu.net.peering")
+
+PING_INTERVAL = 15.0
+RECONNECT_BASE = 2.0
+RECONNECT_MAX = 60.0
+EWMA_ALPHA = 0.3
+
+
+@dataclass
+class PeerState:
+    addr: Optional[str] = None
+    latency: Optional[float] = None       # EWMA RTT seconds
+    last_seen: Optional[float] = None     # monotonic, last successful ping
+    failures: int = 0                     # consecutive connect/ping failures
+    addrs_tried: Set[str] = field(default_factory=set)
+
+    @property
+    def is_up(self) -> bool:
+        return self.last_seen is not None and (
+            time.monotonic() - self.last_seen < 2.5 * PING_INTERVAL
+        )
+
+
+class FullMeshPeering:
+    """Dial every known peer, keep latency estimates fresh.
+
+    `known_peers` accumulates from bootstrap config, the persisted peer
+    list, and layout gossip (the rpc System layer feeds those in via
+    `add_peer`)."""
+
+    def __init__(self, netapp: NetApp):
+        self.netapp = netapp
+        self.peers: Dict[NodeID, PeerState] = {}
+        self._addr_only: Set[str] = set()   # peers known only by address
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = asyncio.Event()
+        netapp.on_connected = self._on_connected
+        netapp.on_disconnected = self._on_disconnected
+
+    # --- peer book ---
+
+    def add_peer(self, addr: str, node_id: Optional[NodeID] = None):
+        if node_id is None:
+            self._addr_only.add(addr)
+            return
+        if node_id == self.netapp.id:
+            return
+        st = self.peers.setdefault(node_id, PeerState())
+        if addr:
+            st.addr = addr
+
+    def latency(self, node: NodeID) -> Optional[float]:
+        st = self.peers.get(node)
+        return st.latency if st else None
+
+    def is_up(self, node: NodeID) -> bool:
+        if node == self.netapp.id:
+            return True
+        st = self.peers.get(node)
+        return bool(st and st.is_up)
+
+    def connected_nodes(self) -> Set[NodeID]:
+        return set(self.netapp.conns.keys())
+
+    def peer_info(self) -> Dict[NodeID, Tuple[Optional[str], bool, Optional[float]]]:
+        return {
+            nid: (st.addr, st.is_up, st.latency) for nid, st in self.peers.items()
+        }
+
+    # --- lifecycle ---
+
+    def start(self):
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self):
+        self._stopped.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def _on_connected(self, node: NodeID, is_dialer: bool):
+        st = self.peers.setdefault(node, PeerState())
+        st.failures = 0
+        st.last_seen = time.monotonic()
+        logger.debug("connected to %s", node.hex_short())
+
+    def _on_disconnected(self, node: NodeID):
+        logger.debug("disconnected from %s", node.hex_short())
+
+    async def _run(self):
+        """Main loop: every PING_INTERVAL, (re)dial missing peers and ping
+        connected ones.  Reconnect backoff is per-peer exponential."""
+        while not self._stopped.is_set():
+            await self._tick()
+            await asyncio.sleep(PING_INTERVAL * random.uniform(0.8, 1.2))
+
+    async def _tick(self):
+        # resolve addr-only bootstrap peers by dialing them once
+        for addr in list(self._addr_only):
+            try:
+                conn = await self.netapp.connect(addr)
+                self._addr_only.discard(addr)
+                self.add_peer(addr, conn.remote_id)
+            except Exception as e:
+                logger.debug("bootstrap dial %s failed: %s", addr, e)
+
+        tasks = []
+        for nid, st in list(self.peers.items()):
+            conn = self.netapp.conns.get(nid)
+            if conn is None or conn._closed:
+                if st.addr and self._should_retry(st):
+                    tasks.append(self._dial(nid, st))
+            else:
+                tasks.append(self._ping(nid, st, conn))
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    def _should_retry(self, st: PeerState) -> bool:
+        if st.failures == 0 or st.last_seen is None:
+            return True
+        backoff = min(RECONNECT_BASE * (2 ** min(st.failures, 6)), RECONNECT_MAX)
+        return time.monotonic() - st.last_seen > backoff
+
+    async def _dial(self, nid: NodeID, st: PeerState):
+        try:
+            await self.netapp.connect(st.addr, expected_id=nid)
+            st.failures = 0
+        except Exception as e:
+            st.failures += 1
+            logger.debug("dial %s (%s) failed: %s", nid.hex_short(), st.addr, e)
+
+    async def _ping(self, nid: NodeID, st: PeerState, conn):
+        try:
+            rtt = await conn.ping()
+            st.last_seen = time.monotonic()
+            st.latency = (
+                rtt if st.latency is None
+                else EWMA_ALPHA * rtt + (1 - EWMA_ALPHA) * st.latency
+            )
+            st.failures = 0
+        except Exception as e:
+            st.failures += 1
+            logger.debug("ping %s failed: %s", nid.hex_short(), e)

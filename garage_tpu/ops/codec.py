@@ -35,6 +35,7 @@ class CodecParams:
     rs_parity: int = 4        # m
     compression_level: Optional[int] = 1
     batch_blocks: int = 256
+    shard_mesh: int = 1       # devices to shard codec batches over (tpu)
 
 
 class BlockCodec:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import os
+import threading
 from typing import List, Sequence
 
 import numpy as np
@@ -25,18 +26,20 @@ from .native import get_native_gf_matmul_blocks
 
 
 _SHARED_POOL = None
+_SHARED_POOL_LOCK = threading.Lock()
 
 
 def _hash_pool() -> concurrent.futures.ThreadPoolExecutor:
     """One process-wide hashing pool shared by every CpuCodec instance
     (codecs are constructed transiently; per-instance pools would leak)."""
     global _SHARED_POOL
-    if _SHARED_POOL is None:
-        _SHARED_POOL = concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(32, os.cpu_count() or 4),
-            thread_name_prefix="codec-hash",
-        )
-    return _SHARED_POOL
+    with _SHARED_POOL_LOCK:
+        if _SHARED_POOL is None:
+            _SHARED_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(32, os.cpu_count() or 4),
+                thread_name_prefix="codec-hash",
+            )
+        return _SHARED_POOL
 
 
 class CpuCodec(BlockCodec):

@@ -26,17 +26,33 @@ _resolved = False
 _fn: Optional[Callable[[np.ndarray, np.ndarray], np.ndarray]] = None
 
 
-def _load_lib() -> ctypes.CDLL:
+def _load_lib() -> Optional[ctypes.CDLL]:
     """Load the .so; a load failure (e.g. a stale binary built on another
-    host — the Makefile uses -march=native) triggers one clean rebuild."""
+    host — the Makefile uses -march=native) triggers one clean rebuild,
+    subject to the same opt-out/fail-marker policy as _build_ok."""
     try:
         return ctypes.CDLL(_SO_PATH)
     except OSError:
+        pass
+    if os.environ.get("GARAGE_TPU_NO_NATIVE_BUILD"):
+        return None
+    src_mtime = os.path.getmtime(os.path.join(_NATIVE_DIR, "gf256.cpp"))
+    if os.path.exists(_FAIL_MARKER) and os.path.getmtime(_FAIL_MARKER) >= src_mtime:
+        return None
+    try:
         subprocess.run(
             ["make", "-C", _NATIVE_DIR, "-s", "clean", "all"],
             check=True, capture_output=True, timeout=120,
         )
         return ctypes.CDLL(_SO_PATH)
+    except Exception as e:
+        logger.debug("native gf256 rebuild failed: %s", e)
+        try:
+            with open(_FAIL_MARKER, "w") as f:
+                f.write(str(e))
+        except OSError:
+            pass
+        return None
 
 
 def _build_ok() -> bool:
@@ -72,13 +88,15 @@ def _resolve() -> Optional[Callable]:
         return None
     try:
         lib = _load_lib()
+        if lib is None:
+            return None
         lib.gf_matmul_blocks.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ]
         lib.gf_matmul_blocks.restype = None
-    except OSError as e:
+    except Exception as e:
         logger.debug("native gf256 load failed: %s", e)
         return None
 

@@ -92,10 +92,39 @@ class TpuCodec(BlockCodec):
             self._w_enc = jnp.asarray(
                 gf256.bitmatrix_of_gf_matrix(pm), dtype=jnp.int8
             )
-        self._hash_jit = jax.jit(blake2s_batch)
-        self._verify_jit = jax.jit(verify_kernel)
-        self._bitmatmul_jit = jax.jit(gf_bitmatmul)
         self._decode_w_cache = {}
+        self.mesh = None
+        if params.shard_mesh > 1:
+            devs = (devices or jax.devices())[: params.shard_mesh]
+            if len(devs) >= params.shard_mesh:
+                self.mesh = jax.sharding.Mesh(np.array(devs), ("data",))
+            else:
+                import logging
+
+                logging.getLogger("garage_tpu.ops").warning(
+                    "codec.shard_mesh=%d but only %d devices; running "
+                    "single-device", params.shard_mesh, len(devs),
+                )
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            batch = NamedSharding(self.mesh, P("data"))
+            repl = NamedSharding(self.mesh, P())
+            self._hash_jit = jax.jit(
+                blake2s_batch, in_shardings=(batch, batch), out_shardings=batch
+            )
+            self._verify_jit = jax.jit(
+                verify_kernel,
+                in_shardings=(batch, batch, batch),
+                out_shardings=(batch, batch, repl),
+            )
+            self._bitmatmul_jit = jax.jit(
+                gf_bitmatmul, in_shardings=(batch, repl), out_shardings=batch
+            )
+        else:
+            self._hash_jit = jax.jit(blake2s_batch)
+            self._verify_jit = jax.jit(verify_kernel)
+            self._bitmatmul_jit = jax.jit(gf_bitmatmul)
 
     # --- hashing ---
     @staticmethod
@@ -109,10 +138,17 @@ class TpuCodec(BlockCodec):
             b <<= 1
         return b
 
+    def _batch_size(self, n: int) -> int:
+        bsz = self._bucket(n, 8)
+        if self.mesh is not None:
+            m = self.mesh.size
+            bsz += (-bsz) % m  # batch axis must divide over the mesh
+        return bsz
+
     def _pad_batch(self, blocks: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
         maxlen = max((len(b) for b in blocks), default=0)
         padded = self._bucket(maxlen)
-        bsz = self._bucket(len(blocks), 8)  # pad batch dim too
+        bsz = self._batch_size(len(blocks))
         arr = np.zeros((bsz, padded), dtype=np.uint8)
         lengths = np.zeros((bsz,), dtype=np.int32)
         for i, b in enumerate(blocks):
@@ -150,13 +186,24 @@ class TpuCodec(BlockCodec):
         return np.asarray(ok)[: len(blocks)]
 
     # --- Reed-Solomon ---
+    def _flat_padded(self, arr: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Flatten leading dims to one batch axis padded for the mesh."""
+        flat = np.ascontiguousarray(arr, dtype=np.uint8).reshape(
+            (-1,) + arr.shape[-2:]
+        )
+        n = flat.shape[0]
+        bsz = self._batch_size(n) if self.mesh is not None else n
+        if bsz != n:
+            flat = np.concatenate(
+                [flat, np.zeros((bsz - n,) + flat.shape[1:], dtype=np.uint8)]
+            )
+        return flat, n
+
     def rs_encode(self, data: np.ndarray) -> np.ndarray:
         assert data.shape[-2] == self.params.rs_data, data.shape
         lead = data.shape[:-2]
-        flat = np.ascontiguousarray(data, dtype=np.uint8).reshape(
-            (-1,) + data.shape[-2:]
-        )
-        out = np.asarray(self._bitmatmul_jit(jnp.asarray(flat), self._w_enc))
+        flat, n = self._flat_padded(data)
+        out = np.asarray(self._bitmatmul_jit(jnp.asarray(flat), self._w_enc))[:n]
         return out.reshape(lead + out.shape[-2:])
 
     def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int]) -> np.ndarray:
@@ -168,10 +215,8 @@ class TpuCodec(BlockCodec):
             w = jnp.asarray(gf256.bitmatrix_of_gf_matrix(dec), dtype=jnp.int8)
             self._decode_w_cache[key] = w
         lead = shards.shape[:-2]
-        flat = np.ascontiguousarray(shards[..., :k, :], dtype=np.uint8).reshape(
-            (-1, k, shards.shape[-1])
-        )
-        out = np.asarray(self._bitmatmul_jit(jnp.asarray(flat), w))
+        flat, n = self._flat_padded(shards[..., :k, :])
+        out = np.asarray(self._bitmatmul_jit(jnp.asarray(flat), w))[:n]
         return out.reshape(lead + out.shape[-2:])
 
 

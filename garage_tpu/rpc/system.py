@@ -1,0 +1,321 @@
+"""System — cluster membership manager.
+
+Equivalent of reference src/rpc/system.rs: persisted node key + cluster
+layout + peer list, status gossip every STATUS_EXCHANGE_INTERVAL, discovery
+loop every DISCOVERY_INTERVAL (bootstrap peers + persisted peers), layout
+push/pull & CRDT merge (system.rs:652-701), and `ClusterHealth` computed by
+partition quorum counting (system.rs:468-527).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import os
+import socket
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..net import FullMeshPeering, NetApp
+from ..net.frame import PRIO_HIGH
+from ..net.netapp import load_or_gen_node_key
+from ..utils.config import Config
+from ..utils.data import FixedBytes32
+from ..utils.migrate import Migrated
+from ..utils.persister import Persister
+from .layout import N_PARTITIONS, ClusterLayout
+from .replication_mode import ReplicationMode, parse_replication_mode
+from .ring import Ring
+from .rpc_helper import RpcHelper
+
+logger = logging.getLogger("garage_tpu.rpc.system")
+
+STATUS_EXCHANGE_INTERVAL = 10.0   # ref system.rs:44-50
+DISCOVERY_INTERVAL = 60.0
+
+SYSTEM_ENDPOINT = "garage/system"
+
+
+class PersistedPeers(Migrated):
+    """[(node_id, addr)] remembered across restarts (ref system.rs:88-89)."""
+
+    VERSION_MARKER = b"GT01peers"
+
+    def __init__(self, peers: Optional[List[List]] = None):
+        self.peers = peers or []  # [ [id_bytes, addr_str], ... ]
+
+    def fields(self):
+        return self.peers
+
+    @classmethod
+    def from_fields(cls, body):
+        return cls([[bytes(i), str(a)] for i, a in body])
+
+
+@dataclasses.dataclass
+class NodeStatus:
+    """Gossiped per-node status (ref system.rs NodeStatus)."""
+
+    hostname: str = "?"
+    replication_factor: int = 0
+    layout_version: int = 0
+    layout_staging_hash: bytes = b""
+    data_avail: Optional[int] = None   # bytes free on data disk
+    data_total: Optional[int] = None
+    meta_avail: Optional[int] = None
+    meta_total: Optional[int] = None
+
+    def pack(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def unpack(cls, d):
+        return cls(**{k: d.get(k) for k in (
+            "hostname", "replication_factor", "layout_version",
+            "layout_staging_hash", "data_avail", "data_total",
+            "meta_avail", "meta_total",
+        )})
+
+
+@dataclasses.dataclass
+class ClusterHealth:
+    """(ref system.rs:468-527)"""
+
+    status: str                 # healthy | degraded | unavailable
+    known_nodes: int
+    connected_nodes: int
+    storage_nodes: int
+    storage_nodes_ok: int
+    partitions: int
+    partitions_quorum: int
+    partitions_all_ok: int
+
+
+class System:
+    """Membership + layout + ring + rpc helper — the node's cluster brain
+    (ref rpc/system.rs:84-123)."""
+
+    def __init__(self, config: Config, replication_mode: Optional[ReplicationMode] = None):
+        self.config = config
+        self.replication_mode = replication_mode or parse_replication_mode(
+            config.replication_mode
+        )
+        os.makedirs(config.metadata_dir, exist_ok=True)
+        self.node_key = load_or_gen_node_key(
+            os.path.join(config.metadata_dir, "node_key")
+        )
+        self.netapp = NetApp(self.node_key, config.rpc_secret)
+        self.id = self.netapp.id
+        self.peering = FullMeshPeering(self.netapp)
+        self.rpc = RpcHelper(self.netapp, self.peering)
+
+        self._layout_persister: Persister = Persister(
+            config.metadata_dir, "cluster_layout", ClusterLayout
+        )
+        loaded = self._layout_persister.load()
+        self.layout: ClusterLayout = (
+            loaded if loaded is not None
+            else ClusterLayout(self.replication_mode.replication_factor)
+        )
+        self.ring = Ring(self.layout)
+        self._ring_callbacks: List[Callable[[Ring], None]] = []
+
+        self._peers_persister: Persister = Persister(
+            config.metadata_dir, "peer_list", PersistedPeers
+        )
+        saved = self._peers_persister.load()
+        if saved:
+            for nid, addr in saved.peers:
+                self.peering.add_peer(addr, FixedBytes32(nid))
+
+        self.node_status: Dict[FixedBytes32, NodeStatus] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = asyncio.Event()
+
+        self.endpoint = self.netapp.endpoint(SYSTEM_ENDPOINT)
+        self.endpoint.set_handler(self._handle)
+
+    # --- ring watching ---
+
+    def on_ring_change(self, cb: Callable[[Ring], None]):
+        self._ring_callbacks.append(cb)
+
+    def _rebuild_ring(self):
+        self.ring = Ring(self.layout)
+        for cb in self._ring_callbacks:
+            try:
+                cb(self.ring)
+            except Exception:
+                logger.exception("ring-change callback failed")
+
+    # --- layout operations ---
+
+    async def update_cluster_layout(self, other: ClusterLayout):
+        """CRDT-merge a layout received from a peer or the CLI; on change,
+        persist, rebuild ring, and push to peers
+        (ref system.rs:652-701 handle_advertise_cluster_layout)."""
+        changed = self.layout.merge(other)
+        if changed:
+            self._layout_persister.save(self.layout)
+            self._rebuild_ring()
+            await self._push_layout()
+
+    async def _push_layout(self):
+        msg = {"t": "advertise_layout", "layout": self.layout.encode()}
+        await self.rpc.broadcast(self.endpoint, msg, prio=PRIO_HIGH, timeout=10.0)
+
+    # --- status gossip ---
+
+    def _local_status(self) -> NodeStatus:
+        st = NodeStatus(
+            hostname=socket.gethostname(),
+            replication_factor=self.replication_mode.replication_factor,
+            layout_version=self.layout.version,
+            layout_staging_hash=bytes(self.layout.staging_hash()),
+        )
+        try:
+            sv = os.statvfs(self.config.metadata_dir)
+            st.meta_avail = sv.f_bavail * sv.f_frsize
+            st.meta_total = sv.f_blocks * sv.f_frsize
+            if self.config.data_dir:
+                sv = os.statvfs(self.config.data_dir[0]["path"])
+                st.data_avail = sv.f_bavail * sv.f_frsize
+                st.data_total = sv.f_blocks * sv.f_frsize
+        except OSError:
+            pass
+        return st
+
+    async def _status_exchange_loop(self):
+        while not self._stopped.is_set():
+            try:
+                msg = {"t": "advertise_status", "status": self._local_status().pack()}
+                await self.rpc.broadcast(self.endpoint, msg, prio=PRIO_HIGH, timeout=10.0)
+            except Exception as e:
+                logger.debug("status exchange failed: %s", e)
+            await asyncio.sleep(STATUS_EXCHANGE_INTERVAL)
+
+    async def _discovery_loop(self):
+        while not self._stopped.is_set():
+            for addr in self.config.bootstrap_peers:
+                self.peering.add_peer(addr)
+            await self.peering._tick()
+            # persist known peers for next restart
+            peers = [
+                [bytes(nid), st.addr]
+                for nid, st in self.peering.peers.items()
+                if st.addr
+            ]
+            try:
+                self._peers_persister.save(PersistedPeers(peers))
+            except OSError as e:
+                logger.debug("peer list save failed: %s", e)
+            # pull layout from a peer if ours is older than advertised
+            await asyncio.sleep(DISCOVERY_INTERVAL)
+
+    # --- rpc handler ---
+
+    async def _handle(self, remote, msg, body):
+        t = msg.get("t")
+        if t == "pull_layout":
+            return {"layout": self.layout.encode()}, None
+        if t == "advertise_layout":
+            other = ClusterLayout.decode(bytes(msg["layout"]))
+            await self.update_cluster_layout(other)
+            return {"ok": True}, None
+        if t == "advertise_status":
+            st = NodeStatus.unpack(msg["status"])
+            self.node_status[FixedBytes32(remote)] = st
+            # a peer with a newer layout triggers a pull
+            if st.layout_version > self.layout.version:
+                asyncio.get_running_loop().create_task(self._pull_layout(remote))
+            return {"ok": True}, None
+        if t == "ping":
+            return {"pong": True, "id": bytes(self.id)}, None
+        raise ValueError(f"unknown system message {t!r}")
+
+    async def _pull_layout(self, node):
+        try:
+            resp = await self.endpoint.call(
+                FixedBytes32(node), {"t": "pull_layout"}, prio=PRIO_HIGH, timeout=10.0
+            )
+            await self.update_cluster_layout(
+                ClusterLayout.decode(bytes(resp["layout"]))
+            )
+        except Exception as e:
+            logger.debug("layout pull from %s failed: %s", bytes(node).hex()[:8], e)
+
+    # --- health (ref system.rs:468-527) ---
+
+    def health(self) -> ClusterHealth:
+        roles = self.layout.node_roles()
+        storage_nodes = [
+            nid for nid, r in roles.items() if r.capacity is not None
+        ]
+        storage_ok = [n for n in storage_nodes if self.peering.is_up(FixedBytes32(n))]
+        partitions = N_PARTITIONS if self.ring.ready else 0
+        quorum = self.replication_mode.write_quorum
+        p_quorum = p_all = 0
+        for p in range(partitions):
+            nodes = self.ring.partition_nodes(p)
+            up = sum(1 for n in nodes if self.peering.is_up(n))
+            if up == len(nodes):
+                p_all += 1
+            if up >= quorum:
+                p_quorum += 1
+        if partitions and p_quorum == partitions and len(storage_ok) == len(storage_nodes):
+            status = "healthy"
+        elif partitions and p_quorum == partitions:
+            status = "degraded"
+        else:
+            status = "unavailable"
+        return ClusterHealth(
+            status=status,
+            known_nodes=len(self.peering.peers) + 1,
+            connected_nodes=len(self.peering.connected_nodes()) + 1,
+            storage_nodes=len(storage_nodes),
+            storage_nodes_ok=len(storage_ok),
+            partitions=partitions,
+            partitions_quorum=p_quorum,
+            partitions_all_ok=p_all,
+        )
+
+    def get_known_nodes(self) -> List[dict]:
+        out = [{
+            "id": bytes(self.id),
+            "addr": self.config.rpc_public_addr or self.config.rpc_bind_addr,
+            "is_up": True,
+            "last_seen_secs_ago": 0,
+            "status": self._local_status().pack(),
+        }]
+        now = time.monotonic()
+        for nid, st in self.peering.peers.items():
+            status = self.node_status.get(nid)
+            out.append({
+                "id": bytes(nid),
+                "addr": st.addr,
+                "is_up": st.is_up,
+                "last_seen_secs_ago": (
+                    int(now - st.last_seen) if st.last_seen else None
+                ),
+                "status": status.pack() if status else None,
+            })
+        return out
+
+    # --- lifecycle (ref system.rs:391-400) ---
+
+    async def run(self):
+        await self.netapp.listen(self.config.rpc_bind_addr)
+        self.peering.start()
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._status_exchange_loop()),
+            loop.create_task(self._discovery_loop()),
+        ]
+
+    async def shutdown(self):
+        self._stopped.set()
+        for t in self._tasks:
+            t.cancel()
+        await self.peering.stop()
+        await self.netapp.shutdown()

@@ -73,8 +73,7 @@ class CodecConfig:
     shard_mesh: int = 1             # devices to shard codec batches over
 
     def make(self, compression_level: Optional[int] = 1):
-        """Build the configured BlockCodec (forwards only the fields
-        CodecParams knows; `backend`/`shard_mesh` select the impl)."""
+        """Build the configured BlockCodec (`backend` selects the impl)."""
         from ..ops import make_codec
 
         return make_codec(
@@ -84,6 +83,7 @@ class CodecConfig:
             rs_parity=self.rs_parity,
             batch_blocks=self.batch_blocks,
             compression_level=compression_level,
+            shard_mesh=self.shard_mesh,
         )
 
 

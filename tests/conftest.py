@@ -15,7 +15,27 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
 import jax  # noqa: E402  (import after env is set)
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests via asyncio.run (pytest-asyncio is not in the
+    image; `pytestmark = pytest.mark.asyncio` markers are inert no-ops)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: async test (run via asyncio.run)")
 
 # The axon TPU plugin overrides JAX_PLATFORMS from the environment, so force
 # the platform through the config API as well.

@@ -1,0 +1,286 @@
+"""Layout optimizer tests, mirroring the reference's property-test strategy
+(ref rpc/layout.rs:1146-1293): the optimal partition size is recomputed by
+an independent naive algorithm over scripted cluster mutations and asserted
+equal; assignment validity invariants are checked after every mutation.
+"""
+
+import itertools
+
+import pytest
+
+from garage_tpu.rpc.graph_algo import Graph
+from garage_tpu.rpc.layout import (
+    ClusterLayout,
+    LayoutParameters,
+    NodeRole,
+    compute_optimal_partition_size,
+)
+from garage_tpu.rpc.ring import N_PARTITIONS, Ring, partition_of
+from garage_tpu.utils.error import LayoutError
+
+
+def nid(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+# --- graph algo unit tests ---
+
+
+def test_maxflow_simple():
+    g = Graph()
+    g.add_edge("s", "a", 10)
+    g.add_edge("s", "b", 5)
+    g.add_edge("a", "t", 7)
+    g.add_edge("b", "t", 9)
+    g.add_edge("a", "b", 100)
+    assert g.compute_maximal_flow("s", "t") == 15
+
+
+def test_maxflow_bottleneck():
+    g = Graph()
+    g.add_edge("s", "a", 100)
+    g.add_edge("a", "b", 3)
+    g.add_edge("b", "t", 100)
+    assert g.compute_maximal_flow("s", "t") == 3
+
+
+def test_mincost_prefers_cheap_path():
+    g = Graph()
+    g.add_edge("s", "a", 1, cost=0)
+    g.add_edge("s", "b", 1, cost=0)
+    g.add_edge("a", "t", 1, cost=5)
+    g.add_edge("b", "t", 1, cost=1)
+    g.add_edge("a", "b", 1, cost=0)
+    # max flow is 2 via both; any valid max flow config has cost 6
+    assert g.compute_maximal_flow("s", "t") == 2
+    g.optimize_flow_with_cost()
+    assert g.flow_cost() == 6
+
+
+def test_mincost_cancels_expensive_cycle():
+    # two parallel unit edges, one expensive; flow 1 should use the cheap one
+    g = Graph()
+    g.add_edge("s", "m", 1)
+    g.add_edge("m", "t", 1, cost=10)
+    g.add_edge("m", "t", 1, cost=1)
+    assert g.compute_maximal_flow("s", "t") == 1
+    g.optimize_flow_with_cost()
+    assert g.flow_cost() == 1
+
+
+# --- naive recomputation (independent of graph_algo) ---
+
+
+def naive_feasible(storage, f, zr, n_partitions, size):
+    """Greedy + exhaustive fallback feasibility check of one partition at a
+    time is NOT correct in general; instead do a simple independent flow:
+    repeatedly find an augmenting path by DFS (Ford-Fulkerson on an
+    adjacency-dict residual graph)."""
+    # residual graph as dict-of-dict caps
+    cap = {}
+
+    def add(u, v, c):
+        cap.setdefault(u, {})[v] = cap.get(u, {}).get(v, 0) + c
+        cap.setdefault(v, {}).setdefault(u, 0)
+
+    zones = sorted({r.zone for r in storage.values()})
+    for p in range(n_partitions):
+        add("s", ("p", p), f)
+        for z in zones:
+            add(("p", p), ("pz", p, z), f - zr + 1)
+    for nid_, role in storage.items():
+        for p in range(n_partitions):
+            add(("pz", p, role.zone), ("n", nid_), 1)
+        add(("n", nid_), "t", role.capacity // size)
+
+    def dfs(u, seen):
+        if u == "t":
+            return ["t"]
+        seen.add(u)
+        for v, c in cap[u].items():
+            if c > 0 and v not in seen:
+                path = dfs(v, seen)
+                if path:
+                    return [u] + path
+        return None
+
+    flow = 0
+    while True:
+        path = dfs("s", set())
+        if not path:
+            break
+        for u, v in zip(path, path[1:]):
+            cap[u][v] -= 1
+            cap[v][u] += 1
+        flow += 1
+    return flow == n_partitions * f
+
+
+def naive_optimal_size(storage, f, zr, n_partitions):
+    """Brute-force downward scan (the reference's check_against_naive
+    recomputes the optimum with a non-dichotomy algorithm)."""
+    hi = max(r.capacity for r in storage.values())
+    for s in range(hi, 0, -1):
+        if naive_feasible(storage, f, zr, n_partitions, s):
+            return s
+    return None
+
+
+def check_valid_assignment(layout: ClusterLayout, n_partitions=N_PARTITIONS):
+    f = layout.replication_factor
+    storage = {k: r for k, r in layout.node_roles().items() if r.capacity is not None}
+    zr = layout.effective_zone_redundancy()
+    assert len(layout.ring_assignment_data) == n_partitions * f
+    s_opt = compute_optimal_partition_size(storage, f, zr, n_partitions)
+    usage = {k: 0 for k in storage}
+    for p in range(n_partitions):
+        nodes = layout.partition_nodes(p)
+        assert len(set(nodes)) == f, f"partition {p}: duplicate replicas"
+        zones = {storage[n].zone for n in nodes}
+        assert len(zones) >= min(zr, len({r.zone for r in storage.values()}))
+        for n in nodes:
+            usage[n] += 1
+    for k, u in usage.items():
+        assert u <= storage[k].capacity // s_opt, (
+            f"node {k.hex()[:4]} over capacity: {u} > "
+            f"{storage[k].capacity // s_opt}"
+        )
+    return s_opt
+
+
+SCENARIOS = [
+    # (roles dict, zone_redundancy)
+    ({1: ("z1", 100), 2: ("z1", 100), 3: ("z1", 100)}, 1),
+    ({1: ("z1", 100), 2: ("z2", 100), 3: ("z3", 100)}, "maximum"),
+    ({1: ("z1", 50), 2: ("z2", 100), 3: ("z3", 200), 4: ("z3", 200)}, 2),
+    ({1: ("z1", 1000), 2: ("z2", 100), 3: ("z3", 100)}, "maximum"),
+    (
+        {1: ("z1", 100), 2: ("z1", 100), 3: ("z2", 150),
+         4: ("z2", 50), 5: ("z3", 200), 6: ("z3", 33)},
+        2,
+    ),
+]
+
+
+@pytest.mark.parametrize("roles,zr", SCENARIOS)
+def test_assignment_against_naive(roles, zr):
+    n_partitions = 16  # smaller ring for the naive O(V*E*flow) cross-check
+    lay = ClusterLayout(replication_factor=3)
+    lay.parameters = LayoutParameters(zone_redundancy=zr)
+    for i, (zone, cap) in roles.items():
+        lay.roles.update(nid(i), NodeRole(zone, cap).pack())
+    storage = lay._storage_nodes()
+    ezr = lay.effective_zone_redundancy()
+    s_flow = compute_optimal_partition_size(storage, 3, ezr, n_partitions)
+    s_naive = naive_optimal_size(storage, 3, ezr, n_partitions)
+    assert s_flow == s_naive, f"dichotomy {s_flow} != naive {s_naive}"
+    msgs = lay.calculate_partition_assignment(n_partitions)
+    assert msgs
+    # validity invariants
+    f = 3
+    usage = {k: 0 for k in storage}
+    for p in range(n_partitions):
+        nodes = lay.partition_nodes(p)
+        assert len(set(nodes)) == f
+        zones = {storage[n].zone for n in nodes}
+        assert len(zones) >= ezr
+        for n in nodes:
+            usage[n] += 1
+    for k, u in usage.items():
+        assert u <= storage[k].capacity // s_flow
+
+
+def test_scripted_cluster_mutations_minimize_movement():
+    """Scripted sequence (ref layout.rs:1146+): grow, shrink, rebalance —
+    assignment stays valid and movement is bounded."""
+    lay = ClusterLayout(replication_factor=3)
+    for i in (1, 2, 3):
+        lay.stage_role(nid(i), NodeRole(f"z{i}", 1000))
+    lay.apply_staged_changes()
+    s1 = check_valid_assignment(lay)
+    before = [lay.partition_nodes(p) for p in range(N_PARTITIONS)]
+
+    # add one node in a new zone: some movement expected, but existing
+    # replicas should mostly stay (cost optimization)
+    lay.stage_role(nid(4), NodeRole("z4", 1000))
+    lay.apply_staged_changes()
+    check_valid_assignment(lay)
+    after = [lay.partition_nodes(p) for p in range(N_PARTITIONS)]
+    kept = sum(len(set(a) & set(b)) for a, b in zip(before, after))
+    total = N_PARTITIONS * 3
+    assert kept >= total * 0.6, f"only {kept}/{total} replicas kept in place"
+
+    # remove a node
+    lay.stage_role(nid(1), None)
+    lay.apply_staged_changes()
+    check_valid_assignment(lay)
+    assert nid(1) not in lay.all_nodes() or lay.node_roles().get(nid(1)) is None
+
+    # capacity change
+    lay.stage_role(nid(2), NodeRole("z2", 5000))
+    lay.apply_staged_changes()
+    s_end = check_valid_assignment(lay)
+    assert lay.version == 4
+
+
+def test_layout_errors():
+    lay = ClusterLayout(replication_factor=3)
+    lay.stage_role(nid(1), NodeRole("z1", 100))
+    with pytest.raises(LayoutError, match="not enough storage nodes"):
+        lay.apply_staged_changes()
+    lay2 = ClusterLayout(replication_factor=3)
+    lay2.parameters = LayoutParameters(zone_redundancy=3)
+    for i in (1, 2, 3):
+        lay2.stage_role(nid(i), NodeRole("z1", 100))
+    lay2.staging_parameters.update(LayoutParameters(zone_redundancy=3).pack())
+    with pytest.raises(LayoutError, match="not enough zones"):
+        lay2.apply_staged_changes()
+    with pytest.raises(LayoutError, match="expected version"):
+        lay.revert_staged_changes(99)
+
+
+def test_layout_crdt_merge_and_serialization():
+    a = ClusterLayout(replication_factor=3)
+    for i in (1, 2, 3):
+        a.stage_role(nid(i), NodeRole(f"z{i}", 1000))
+    a.apply_staged_changes()
+
+    # roundtrip
+    b = ClusterLayout.decode(a.encode())
+    assert b.version == a.version
+    assert b.ring_assignment_data == a.ring_assignment_data
+    assert b.node_roles().keys() == a.node_roles().keys()
+
+    # stale layout merging into newer: no change
+    old = ClusterLayout(replication_factor=3)
+    assert not a.merge(old)
+    # newer into older: adopt
+    old.merge(a)
+    assert old.version == a.version
+
+    # concurrent staging on same version merges via LWW
+    c = ClusterLayout.decode(a.encode())
+    a.stage_role(nid(4), NodeRole("z4", 1000))
+    c.stage_role(nid(5), NodeRole("z5", 1000))
+    assert a.merge(c)
+    staged = a.staged_roles()
+    assert nid(4) in staged and nid(5) in staged
+
+
+def test_ring_lookup():
+    lay = ClusterLayout(replication_factor=3)
+    for i in (1, 2, 3, 4):
+        lay.stage_role(nid(i), NodeRole(f"z{i % 2}", 1000))
+    lay.apply_staged_changes()
+    ring = Ring(lay)
+    assert ring.ready
+    h = bytes([7]) + b"\x01" * 31
+    assert partition_of(h) == 7
+    nodes = ring.get_nodes(h, 3)
+    assert len(nodes) == 3 and len(set(nodes)) == 3
+    assert nodes == ring.partition_nodes(7)
+    assert len(ring.partitions()) == N_PARTITIONS
+
+    empty_ring = Ring(ClusterLayout(replication_factor=3))
+    assert not empty_ring.ready
+    assert empty_ring.get_nodes(h, 3) == []

@@ -1,0 +1,242 @@
+"""Net layer tests: in-process loopback node pairs (SURVEY.md §7 stage 4:
+"Test with in-process loopback pairs")."""
+
+import asyncio
+
+import pytest
+
+from garage_tpu.net import (
+    PRIO_BACKGROUND,
+    PRIO_HIGH,
+    FullMeshPeering,
+    NetApp,
+    gen_node_key,
+)
+from garage_tpu.net.netapp import ByteStream, node_id_of
+from garage_tpu.utils.error import RpcError
+
+pytestmark = pytest.mark.asyncio
+
+
+async def make_pair(secret="s3cret", secret_b=None):
+    """Two NetApps connected over loopback; returns (a, b, conn_a_to_b)."""
+    a = NetApp(gen_node_key(), secret)
+    b = NetApp(gen_node_key(), secret_b if secret_b is not None else secret)
+    await b.listen("127.0.0.1:0")
+    port = b._server.sockets[0].getsockname()[1]
+    conn = await a.connect(f"127.0.0.1:{port}", expected_id=b.id)
+    return a, b, conn
+
+
+async def shutdown(*apps):
+    for app in apps:
+        await app.shutdown()
+
+
+async def test_handshake_and_echo():
+    a, b, _ = await make_pair()
+
+    async def handler(remote, msg, body):
+        assert remote == a.id
+        return {"echo": msg["x"] * 2}, None
+
+    b.endpoint("test/echo").set_handler(handler)
+    resp = await a.endpoint("test/echo").call(b.id, {"x": 21})
+    assert resp == {"echo": 42}
+    await shutdown(a, b)
+
+
+async def test_wrong_secret_rejected():
+    with pytest.raises((RpcError, asyncio.IncompleteReadError, ConnectionError)):
+        await make_pair(secret="right", secret_b="wrong")
+
+
+async def test_handler_error_propagates():
+    a, b, _ = await make_pair()
+
+    async def handler(remote, msg, body):
+        raise ValueError("intentional")
+
+    b.endpoint("test/fail").set_handler(handler)
+    with pytest.raises(RpcError, match="intentional"):
+        await a.endpoint("test/fail").call(b.id, {})
+    await shutdown(a, b)
+
+
+async def test_no_handler():
+    a, b, _ = await make_pair()
+    with pytest.raises(RpcError, match="no handler"):
+        await a.endpoint("test/none").call(b.id, {})
+    await shutdown(a, b)
+
+
+async def test_streaming_body_roundtrip():
+    a, b, _ = await make_pair()
+    received = []
+
+    async def handler(remote, msg, body):
+        data = await body.read_all()
+        received.append(data)
+
+        async def resp_body():
+            for i in range(4):
+                yield bytes([i]) * 1000
+
+        return {"n": len(data)}, resp_body()
+
+    b.endpoint("test/stream").set_handler(handler)
+
+    async def req_body():
+        for i in range(100):
+            yield b"x" * 5000  # 500 KB total, crosses chunking boundary
+
+    resp, stream = await a.endpoint("test/stream").call_streaming(
+        b.id, {}, body=req_body()
+    )
+    assert resp == {"n": 500_000}
+    assert received[0] == b"x" * 500_000
+    back = await stream.read_all()
+    assert back == b"".join(bytes([i]) * 1000 for i in range(4))
+    await shutdown(a, b)
+
+
+async def test_concurrent_requests_multiplexed():
+    a, b, _ = await make_pair()
+
+    async def handler(remote, msg, body):
+        await asyncio.sleep(msg["delay"])
+        return msg["i"], None
+
+    b.endpoint("test/mux").set_handler(handler)
+    ep = a.endpoint("test/mux")
+    results = await asyncio.gather(
+        *[ep.call(b.id, {"i": i, "delay": 0.05 * (5 - i)}) for i in range(5)]
+    )
+    assert results == list(range(5))
+    await shutdown(a, b)
+
+
+async def test_outmux_strict_priority():
+    """The writer-side mux always pops the most urgent queued frame —
+    this is the guarantee that repair bulk yields to gossip/user traffic."""
+    from garage_tpu.net.frame import Frame, K_DATA
+    from garage_tpu.net.netapp import _OutMux
+
+    mux = _OutMux()
+    for i in range(5):
+        await mux.put(Frame(K_DATA, PRIO_BACKGROUND, 1, bytes([i])))
+    await mux.put(Frame(K_DATA, PRIO_HIGH, 2, b"hi"))
+    first = await mux.pop()
+    assert first.prio == PRIO_HIGH and first.payload == b"hi"
+    rest = [await mux.pop() for _ in range(5)]
+    assert [f.payload for f in rest] == [bytes([i]) for i in range(5)]  # FIFO
+
+
+async def test_priority_bulk_and_high_coexist():
+    """Integration smoke: a high-prio call completes while a large
+    background stream is in flight (exact interleave is timing-dependent;
+    strict ordering is covered by test_outmux_strict_priority)."""
+    a, b, _ = await make_pair()
+
+    async def bulk_handler(remote, msg, body):
+        return {"n": len(await body.read_all())}, None
+
+    async def hi_handler(remote, msg, body):
+        return "hi", None
+
+    b.endpoint("test/bulk").set_handler(bulk_handler)
+    b.endpoint("test/hi").set_handler(hi_handler)
+
+    async def big_body():
+        for _ in range(400):
+            yield b"z" * 16384
+
+    bulk = asyncio.create_task(
+        a.endpoint("test/bulk").call(
+            b.id, {}, prio=PRIO_BACKGROUND, body=big_body(), timeout=60
+        )
+    )
+    assert await a.endpoint("test/hi").call(b.id, {}, prio=PRIO_HIGH) == "hi"
+    assert (await bulk) == {"n": 400 * 16384}
+    await shutdown(a, b)
+
+
+async def test_self_call_shortcircuit():
+    a = NetApp(gen_node_key(), "s")
+
+    async def handler(remote, msg, body):
+        data = await body.read_all() if body else b""
+        return {"remote": bytes(remote) == bytes(a.id), "len": len(data)}, None
+
+    a.endpoint("test/self").set_handler(handler)
+
+    async def body():
+        yield b"abc"
+
+    resp = await a.endpoint("test/self").call(a.id, {}, body=body())
+    assert resp == {"remote": True, "len": 3}
+    await a.shutdown()
+
+
+async def test_expected_id_mismatch():
+    a, b, _ = await make_pair()
+    c = NetApp(gen_node_key(), "s3cret")
+    await c.listen("127.0.0.1:0")
+    port = c._server.sockets[0].getsockname()[1]
+    wrong = node_id_of(gen_node_key())
+    with pytest.raises(RpcError, match="expected"):
+        await a.connect(f"127.0.0.1:{port}", expected_id=wrong)
+    await shutdown(a, b, c)
+
+
+async def test_ping_and_peering_latency():
+    a, b, conn = await make_pair()
+    rtt = await conn.ping()
+    assert 0 <= rtt < 1.0
+    peering = FullMeshPeering(a)
+    peering.add_peer(None, b.id)
+    await peering._tick()
+    assert peering.is_up(b.id)
+    assert peering.latency(b.id) is not None
+    await shutdown(a, b)
+
+
+async def test_peering_reconnects():
+    a, b, conn = await make_pair()
+    port = b._server.sockets[0].getsockname()[1]
+    peering = FullMeshPeering(a)
+    peering.add_peer(f"127.0.0.1:{port}", b.id)
+    await conn.close()
+    assert b.id not in a.conns
+    await peering._tick()
+    assert b.id in a.conns
+    await shutdown(a, b)
+
+
+async def test_connection_loss_fails_pending():
+    a, b, conn = await make_pair()
+
+    async def handler(remote, msg, body):
+        await asyncio.sleep(30)
+        return None, None
+
+    b.endpoint("test/slow").set_handler(handler)
+    call = asyncio.create_task(a.endpoint("test/slow").call(b.id, {}, timeout=60))
+    await asyncio.sleep(0.05)
+    await conn.close()
+    with pytest.raises(RpcError):
+        await call
+    await shutdown(a, b)
+
+
+async def test_large_message_and_binary():
+    a, b, _ = await make_pair()
+
+    async def handler(remote, msg, body):
+        return {"data": msg["data"]}, None
+
+    b.endpoint("test/bin").set_handler(handler)
+    blob = bytes(range(256)) * 4096  # 1 MiB in the msg itself
+    resp = await a.endpoint("test/bin").call(b.id, {"data": blob})
+    assert resp["data"] == blob
+    await shutdown(a, b)

@@ -1,0 +1,239 @@
+"""RPC layer tests: RpcHelper quorum semantics + System membership with
+real in-process nodes on loopback."""
+
+import asyncio
+
+import pytest
+
+from garage_tpu.net import NetApp, gen_node_key
+from garage_tpu.net.peering import FullMeshPeering
+from garage_tpu.rpc.layout import NodeRole
+from garage_tpu.rpc.replication_mode import parse_replication_mode
+from garage_tpu.rpc.rpc_helper import RequestStrategy, RpcHelper
+from garage_tpu.rpc.system import System
+from garage_tpu.utils.config import config_from_dict
+from garage_tpu.utils.error import GarageError, QuorumError
+
+pytestmark = pytest.mark.asyncio
+
+
+def test_replication_modes():
+    m3 = parse_replication_mode("3")
+    assert (m3.replication_factor, m3.read_quorum, m3.write_quorum) == (3, 2, 2)
+    assert m3.is_read_after_write_consistent
+    md = parse_replication_mode("3-degraded")
+    assert not md.is_read_after_write_consistent
+    with pytest.raises(GarageError):
+        parse_replication_mode("7")
+
+
+async def make_mesh(n, secret="testsecret"):
+    """n fully-connected NetApps on loopback."""
+    apps = [NetApp(gen_node_key(), secret) for _ in range(n)]
+    for a in apps:
+        await a.listen("127.0.0.1:0")
+    ports = [a._server.sockets[0].getsockname()[1] for a in apps]
+    for i, a in enumerate(apps):
+        for j, b in enumerate(apps):
+            if i < j:
+                await a.connect(f"127.0.0.1:{ports[j]}", expected_id=b.id)
+    return apps
+
+
+async def test_quorum_write_returns_at_quorum():
+    apps = await make_mesh(3)
+    a = apps[0]
+    slow_release = asyncio.Event()
+    calls = []
+
+    def mk_handler(i):
+        async def h(remote, msg, body):
+            calls.append(i)
+            if i == 2:
+                await slow_release.wait()  # node 2 is a straggler
+            return i, None
+        return h
+
+    for i, app in enumerate(apps):
+        app.endpoint("t/q").set_handler(mk_handler(i))
+    helper = RpcHelper(a, FullMeshPeering(a))
+    ep = a.endpoint("t/q")
+    res = await helper.try_call_many(
+        ep, [app.id for app in apps], {}, RequestStrategy(rs_quorum=2)
+    )
+    assert sorted(res) == [0, 1]  # returned at quorum without the straggler
+    slow_release.set()
+    await asyncio.sleep(0.05)  # background drain completes
+    assert sorted(calls) == [0, 1, 2]
+    for app in apps:
+        await app.shutdown()
+
+
+async def test_quorum_write_fails_below_quorum():
+    apps = await make_mesh(3)
+    a = apps[0]
+
+    async def ok(remote, msg, body):
+        return "ok", None
+
+    async def fail(remote, msg, body):
+        raise RuntimeError("nope")
+
+    apps[0].endpoint("t/q").set_handler(ok)
+    apps[1].endpoint("t/q").set_handler(fail)
+    apps[2].endpoint("t/q").set_handler(fail)
+    helper = RpcHelper(a, FullMeshPeering(a))
+    with pytest.raises(QuorumError) as ei:
+        await helper.try_call_many(
+            a.endpoint("t/q"), [x.id for x in apps], {}, RequestStrategy(rs_quorum=2)
+        )
+    assert ei.value.got == 1 and ei.value.needed == 2
+    for app in apps:
+        await app.shutdown()
+
+
+async def test_quorum_read_interrupt_after_quorum():
+    """Read mode: only quorum requests in flight; remaining are never sent
+    once quorum is reached; a failure triggers the next candidate."""
+    apps = await make_mesh(3)
+    a = apps[0]
+    called = []
+
+    def mk(i, should_fail=False):
+        async def h(remote, msg, body):
+            called.append(i)
+            if should_fail:
+                raise RuntimeError("broken")
+            return i, None
+        return h
+
+    apps[0].endpoint("t/r").set_handler(mk(0, should_fail=True))
+    apps[1].endpoint("t/r").set_handler(mk(1))
+    apps[2].endpoint("t/r").set_handler(mk(2))
+    helper = RpcHelper(a, FullMeshPeering(a))
+    strat = RequestStrategy(rs_quorum=2, rs_interrupt_after_quorum=True)
+    res = await helper.try_call_many(
+        a.endpoint("t/r"), [x.id for x in apps], {}, strat
+    )
+    # self (node 0) ordered first, fails; 1 and 2 succeed
+    assert sorted(res) == [1, 2]
+    assert sorted(called) == [0, 1, 2]
+    for app in apps:
+        await app.shutdown()
+
+
+async def test_request_order_prefers_self_then_latency():
+    a = NetApp(gen_node_key(), "s")
+    peering = FullMeshPeering(a)
+    helper = RpcHelper(a, peering)
+    others = [gen_node_key() for _ in range(3)]
+    from garage_tpu.net.netapp import node_id_of
+
+    ids = [node_id_of(k) for k in others]
+    peering.add_peer(None, ids[0])
+    peering.add_peer(None, ids[1])
+    peering.peers[ids[0]].latency = 0.5
+    peering.peers[ids[1]].latency = 0.01
+    order = helper.request_order([ids[0], a.id, ids[1], ids[2]])
+    assert order[0] == a.id
+    assert order[1] == ids[1]          # lowest latency
+    assert order[2] == ids[0]
+    assert order[3] == ids[2]          # unknown latency last
+    await a.shutdown()
+
+
+# --- System integration ---
+
+
+def sys_config(tmp_path, i, bootstrap=(), mode="3"):
+    return config_from_dict({
+        "metadata_dir": str(tmp_path / f"node{i}" / "meta"),
+        "data_dir": str(tmp_path / f"node{i}" / "data"),
+        "replication_mode": mode,
+        "rpc_bind_addr": "127.0.0.1:0",
+        "rpc_secret": "sys-test-secret",
+        "bootstrap_peers": list(bootstrap),
+    })
+
+
+async def start_system(tmp_path, i, bootstrap=(), mode="3"):
+    sys = System(sys_config(tmp_path, i, bootstrap, mode))
+    await sys.run()
+    port = sys.netapp._server.sockets[0].getsockname()[1]
+    sys.config.rpc_public_addr = f"127.0.0.1:{port}"
+    return sys
+
+
+async def test_system_cluster_forms_and_layout_propagates(tmp_path):
+    s1 = await start_system(tmp_path, 1)
+    p1 = s1.netapp._server.sockets[0].getsockname()[1]
+    s2 = await start_system(tmp_path, 2, bootstrap=[f"127.0.0.1:{p1}"])
+    s3 = await start_system(tmp_path, 3, bootstrap=[f"127.0.0.1:{p1}"])
+    # force discovery ticks instead of waiting for the 60s loop
+    for s in (s2, s3):
+        for addr in s.config.bootstrap_peers:
+            s.peering.add_peer(addr)
+        await s.peering._tick()
+    await s1.peering._tick()
+    # s2/s3 connected to s1; mesh completion needs gossip of peer addrs —
+    # connect directly for the test
+    await s2.netapp.connect(s3.config.rpc_public_addr, expected_id=s3.id)
+
+    assert s2.id in s1.peering.connected_nodes()
+    assert s3.id in s2.netapp.conns
+
+    # stage + apply a layout on s1, push to peers
+    for s in (s1, s2, s3):
+        s1.layout.stage_role(bytes(s.id), NodeRole("dc1", 1000))
+    s1.layout.apply_staged_changes()
+    s1._layout_persister.save(s1.layout)
+    s1._rebuild_ring()
+    await s1._push_layout()
+    await asyncio.sleep(0.1)
+    assert s2.layout.version == 1 and s3.layout.version == 1
+    assert s2.ring.ready and s3.ring.ready
+    assert s2.ring.get_nodes(b"\x42" + b"\x00" * 31, 3) == s1.ring.get_nodes(
+        b"\x42" + b"\x00" * 31, 3
+    )
+
+    # health: all nodes pinged recently → healthy
+    for s in (s1, s2, s3):
+        await s.peering._tick()
+    h = s1.health()
+    assert h.status == "healthy", h
+    assert h.partitions_quorum == h.partitions
+
+    # layout persisted: reload from disk
+    from garage_tpu.rpc.layout import ClusterLayout
+
+    reloaded = s1._layout_persister.load()
+    assert reloaded.version == 1
+
+    for s in (s1, s2, s3):
+        await s.shutdown()
+
+
+async def test_system_status_gossip_triggers_layout_pull(tmp_path):
+    s1 = await start_system(tmp_path, 1)
+    p1 = s1.netapp._server.sockets[0].getsockname()[1]
+    s2 = await start_system(tmp_path, 2, bootstrap=[f"127.0.0.1:{p1}"])
+    s2.peering.add_peer(f"127.0.0.1:{p1}")
+    await s2.peering._tick()
+    await asyncio.sleep(0.05)  # let s1 finish its accept-side handshake
+
+    # s1 applies a layout while s2 is unaware
+    for s in (s1, s2):
+        s1.layout.stage_role(bytes(s.id), NodeRole("dc1", 1000))
+    # need 3 storage nodes for factor 3 — use mode 2 instead
+    s1.layout.replication_factor = 2
+    s2.layout.replication_factor = 2
+    s1.layout.apply_staged_changes()
+    s1._rebuild_ring()
+
+    # s1 advertises its status (with layout_version=1) to s2 → s2 pulls
+    msg = {"t": "advertise_status", "status": s1._local_status().pack()}
+    await s1.endpoint.call(s2.id, msg)
+    await asyncio.sleep(0.1)
+    assert s2.layout.version == 1
+    for s in (s1, s2):
+        await s.shutdown()

@@ -50,3 +50,28 @@ def test_sharded_encode_matches_numpy(mesh):
     fns = sharded_fns(mesh)
     out = np.asarray(fns["rs_encode"](data, w))
     assert np.array_equal(out, gf256.gf_matmul_blocks(pm, data))
+
+
+def test_codec_shard_mesh_from_config(mesh):
+    """codec.shard_mesh wires a device mesh into the TpuCodec itself."""
+    import hashlib as _h
+
+    from garage_tpu.utils.config import config_from_dict
+
+    cfg = config_from_dict({"codec": {"backend": "tpu", "shard_mesh": 8}})
+    codec = cfg.codec.make(cfg.compression_level)
+    assert codec.mesh is not None and codec.mesh.size == 8
+    blocks = [bytes([i]) * (100 + i) for i in range(5)]  # odd batch, padded
+    hashes = codec.batch_hash(blocks)
+    assert [bytes(x) for x in hashes] == [
+        _h.blake2s(b, digest_size=32).digest() for b in blocks
+    ]
+    assert codec.batch_verify(blocks, hashes).all()
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (5, 8, 64), dtype=np.uint8)  # 5 % 8 != 0
+    parity = codec.rs_encode(data)
+    assert parity.shape == (5, 4, 64)
+    from garage_tpu.ops import make_codec
+
+    cpu = make_codec("cpu")
+    assert np.array_equal(parity, cpu.rs_encode(data))
