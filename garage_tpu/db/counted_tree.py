@@ -52,3 +52,52 @@ class CountedTree:
 
     def get_gt(self, key: bytes) -> Optional[Tuple[bytes, bytes]]:
         return self.tree.get_gt(key)
+
+    def _add(self, delta: int) -> None:
+        with self._lock:
+            self._count += delta
+
+    def tx_insert(self, tx, key: bytes, value: bytes) -> Optional[bytes]:
+        """Transactional insert that keeps the counter consistent: the
+        count adjustment is applied via on_commit so aborts don't skew it."""
+        old = tx.insert(self.tree, key, value)
+        if old is None:
+            tx.on_commit(lambda: self._add(1))
+        return old
+
+    def tx_remove(self, tx, key: bytes) -> Optional[bytes]:
+        old = tx.remove(self.tree, key)
+        if old is not None:
+            tx.on_commit(lambda: self._add(-1))
+        return old
+
+    def compare_and_swap(
+        self,
+        key: bytes,
+        expected: Optional[bytes],
+        new: Optional[bytes],
+    ) -> bool:
+        """Atomically set key → new (None = delete) iff current == expected
+        (ref db/counted_tree_hack.rs compare_and_swap, used by gc/resync to
+        remove a todo entry only if unchanged since it was read)."""
+        tree = self.tree
+
+        def txn(tx):
+            cur = tx.get(tree, key)
+            if cur != expected:
+                return False
+            if new is None:
+                if cur is not None:
+                    tx.remove(tree, key)
+            else:
+                tx.insert(tree, key, new)
+            return True
+
+        ok = tree.db.transaction(txn)
+        if ok:
+            with self._lock:
+                if expected is None and new is not None:
+                    self._count += 1
+                elif expected is not None and new is None:
+                    self._count -= 1
+        return ok

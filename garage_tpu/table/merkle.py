@@ -1,0 +1,247 @@
+"""MerkleUpdater — incremental per-partition Merkle trees over table items.
+
+Equivalent of reference src/table/merkle.rs (SURVEY.md §2.4): one Merkle
+trie per ring partition; the trie descends on the bytes of **blake2(tree
+key)** (so no key is a prefix of another); node kinds are Empty,
+Intermediate([(next_byte, child_hash)]) and Leaf(item_key, value_hash)
+(merkle.rs:45-67).  A todo-queue written transactionally by TableData
+drives the updater (merkle.rs:92-253); node hash = blake2 of the node's
+canonical serialization; an intermediate left with a single leaf child
+collapses back into that leaf (merkle.rs:163-182).
+
+Node db key = 1 byte partition ‖ khash prefix (the framework uses
+PARTITION_BITS=8 partitions, ring.py; the reference packs a u16).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Tuple
+
+from ..db import Transaction
+from ..utils.background import Worker, WorkerState
+from ..utils.data import Hash, blake2sum
+from ..utils.migrate import pack, unpack
+from .data import TableData
+
+logger = logging.getLogger("garage_tpu.table.merkle")
+
+EMPTY = None
+_UNCHANGED = object()  # "subtree not modified" — distinct from EMPTY (ref
+                       # merkle.rs models this as Option<MerkleNode>)
+EMPTY_HASH = Hash(b"\x00" * 32)
+
+
+def _encode_node(node: Any) -> bytes:
+    return pack(node)
+
+
+def _decode_node(data: Optional[bytes]) -> Any:
+    if data is None or data == b"":
+        return EMPTY
+    return unpack(data)
+
+
+def node_hash(node: Any) -> Hash:
+    """Hash of a node; the empty node hashes to all-zeros (ref merkle.rs
+    empty_node_hash)."""
+    if node is EMPTY:
+        return EMPTY_HASH
+    return blake2sum(_encode_node(node))
+
+
+def _is_leaf(node: Any) -> bool:
+    return isinstance(node, (list, tuple)) and len(node) == 3 and node[0] == "l"
+
+
+def _is_int(node: Any) -> bool:
+    return isinstance(node, (list, tuple)) and len(node) == 2 and node[0] == "i"
+
+
+def leaf(key: bytes, vhash: bytes) -> list:
+    return ["l", key, bytes(vhash)]
+
+
+def intermediate(children: List[Tuple[int, bytes]]) -> list:
+    return ["i", [[b, bytes(h)] for b, h in sorted(children)]]
+
+
+def int_children(node: Any) -> List[Tuple[int, bytes]]:
+    return [(b, bytes(h)) for b, h in node[1]]
+
+
+def node_key(partition: int, prefix: bytes) -> bytes:
+    return bytes([partition]) + prefix
+
+
+class MerkleUpdater:
+    def __init__(self, data: TableData):
+        self.data = data
+
+    # --- tree access (ref merkle.rs:255-301) ---
+
+    def read_node(self, tx: Optional[Transaction], nk: bytes) -> Any:
+        if tx is not None:
+            return _decode_node(tx.get(self.data.merkle_tree, nk))
+        return _decode_node(self.data.merkle_tree.get(nk))
+
+    def _put_node(self, tx: Transaction, nk: bytes, node: Any) -> Hash:
+        if node is EMPTY:
+            tx.remove(self.data.merkle_tree, nk)
+        else:
+            tx.insert(self.data.merkle_tree, nk, _encode_node(node))
+        return node_hash(node)
+
+    def partition_root_hash(self, partition: int) -> Hash:
+        """Root hash of one partition's subtree — what sync compares."""
+        return node_hash(self.read_node(None, node_key(partition, b"")))
+
+    # --- the update algorithm (ref merkle.rs:92-253) ---
+
+    def update_item(self, k: bytes) -> None:
+        """Apply one todo entry for item key `k`.  The todo value is the new
+        value hash (b'' = item deleted); it is removed only if unchanged
+        after the tree transaction (ref merkle.rs:113-128)."""
+        todo_val = self.data.merkle_todo.get(k)
+        if todo_val is None:
+            return
+        new_vhash = None if todo_val == b"" else Hash(todo_val)
+        khash = blake2sum(k)
+        partition = self.data.replication.partition_of(Hash(k[:32]))
+
+        def txn(tx: Transaction):
+            self._update_rec(tx, k, khash, partition, b"", new_vhash)
+            cur = tx.get(self.data.merkle_todo.tree, k)
+            if cur == todo_val:
+                self.data.merkle_todo.tx_remove(tx, k)
+
+        self.data.db.transaction(txn)
+
+    def _update_rec(
+        self,
+        tx: Transaction,
+        k: bytes,
+        khash: Hash,
+        partition: int,
+        prefix: bytes,
+        new_vhash: Optional[Hash],
+    ) -> Optional[Hash]:
+        """Returns the node's new hash, or None if the subtree is unchanged
+        (ref merkle.rs:131-253 update_item_rec)."""
+        i = len(prefix)
+        nk = node_key(partition, prefix)
+        node = self.read_node(tx, nk)
+        mutate = _UNCHANGED
+
+        if node is EMPTY:
+            if new_vhash is not None:
+                mutate = leaf(k, bytes(new_vhash))
+
+        elif _is_int(node):
+            children = int_children(node)
+            next_prefix = prefix + khash[i : i + 1]
+            subhash = self._update_rec(tx, k, khash, partition, next_prefix, new_vhash)
+            if subhash is not None:
+                nb = khash[i]
+                children = [(b, h) for b, h in children if b != nb]
+                if subhash != EMPTY_HASH:
+                    children.append((nb, bytes(subhash)))
+                if not children:
+                    logger.warning("intermediate collapsed to empty (unexpected)")
+                    mutate = EMPTY
+                elif len(children) == 1:
+                    sub_nk = node_key(partition, prefix + bytes([children[0][0]]))
+                    subnode = self.read_node(tx, sub_nk)
+                    if _is_leaf(subnode):
+                        # hoist the single remaining leaf up one level
+                        tx.remove(self.data.merkle_tree, sub_nk)
+                        mutate = subnode
+                    else:
+                        mutate = intermediate(children)
+                else:
+                    mutate = intermediate(children)
+
+        else:  # leaf
+            exlf_k, exlf_vhash = bytes(node[1]), bytes(node[2])
+            if exlf_k == k:
+                if new_vhash is not None and bytes(new_vhash) != exlf_vhash:
+                    mutate = leaf(k, bytes(new_vhash))
+                elif new_vhash is None:
+                    mutate = EMPTY
+            elif new_vhash is not None:
+                # split: push the existing leaf down by its own khash byte,
+                # then insert our key (ref merkle.rs:196-238)
+                exlf_khash = blake2sum(exlf_k)
+                assert exlf_khash[:i] == khash[:i]
+                children = []
+                sub1 = self._update_rec(
+                    tx, exlf_k, exlf_khash, partition,
+                    prefix + exlf_khash[i : i + 1], Hash(exlf_vhash),
+                )
+                children.append((exlf_khash[i], bytes(sub1)))
+                sub2 = self._update_rec(
+                    tx, k, khash, partition, prefix + khash[i : i + 1], new_vhash
+                )
+                children = [(b, h) for b, h in children if b != khash[i]]
+                children.append((khash[i], bytes(sub2)))
+                mutate = intermediate(children)
+
+        if mutate is _UNCHANGED:
+            return None
+        return self._put_node(tx, nk, mutate)
+
+    # --- subtree walks (used by sync) ---
+
+    def collect_leaves(self, partition: int, prefix: bytes) -> List[Tuple[bytes, bytes]]:
+        """All (item_key, value_hash) leaves under a node."""
+        out: List[Tuple[bytes, bytes]] = []
+        self._collect(partition, prefix, out)
+        return out
+
+    def _collect(self, partition: int, prefix: bytes, out):
+        node = self.read_node(None, node_key(partition, prefix))
+        if node is EMPTY:
+            return
+        if _is_leaf(node):
+            out.append((bytes(node[1]), bytes(node[2])))
+            return
+        for b, _h in int_children(node):
+            self._collect(partition, prefix + bytes([b]), out)
+
+
+class MerkleWorker(Worker):
+    """Drains the merkle_todo queue (ref merkle.rs:303-340, batches of 100)."""
+
+    BATCH = 100
+
+    def __init__(self, updater: MerkleUpdater):
+        self.updater = updater
+        self.data = updater.data
+
+    def name(self) -> str:
+        return f"{self.data.schema.TABLE_NAME} Merkle"
+
+    async def work(self) -> WorkerState:
+        st = self.status()
+        processed = 0
+        cursor = b""
+        while processed < self.BATCH:
+            nxt = (
+                self.data.merkle_todo.first()
+                if cursor == b""
+                else self.data.merkle_todo.get_gt(cursor)
+            )
+            if nxt is None:
+                break
+            key, _val = nxt
+            self.updater.update_item(key)
+            cursor = key
+            processed += 1
+        st.queue_length = self.data.merkle_todo_len()
+        return WorkerState.BUSY if processed else WorkerState.IDLE
+
+    async def wait_for_work(self) -> None:
+        self.data.merkle_todo_notify.clear()
+        if self.data.merkle_todo_len() > 0:
+            return
+        await self.data.merkle_todo_notify.wait()

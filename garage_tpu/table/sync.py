@@ -1,0 +1,231 @@
+"""TableSyncer — Merkle anti-entropy between replicas.
+
+Equivalent of reference src/table/sync.rs (SURVEY.md §2.4): every
+ANTI_ENTROPY_INTERVAL (10 min), on ring change and on demand, each stored
+partition's Merkle root hash is compared with the other replicas'; on
+mismatch the tries are descended in parallel and differing items are
+pushed in ≤256-item batches (sync.rs:286-415).  Partitions this node no
+longer stores are offloaded: sent whole to the current replicas, then
+deleted locally (sync.rs:170-269).
+
+Sync is push-only and symmetric: each replica pushes what the other lacks
+in its own sync round, so convergence needs no pull protocol.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import List, Optional
+
+from ..net.frame import PRIO_BACKGROUND
+from ..rpc.rpc_helper import RequestStrategy
+from ..utils.background import Worker, WorkerState
+from ..utils.data import FixedBytes32, Hash
+from ..utils.error import GarageError
+from .merkle import (
+    EMPTY,
+    EMPTY_HASH,
+    MerkleUpdater,
+    _is_int,
+    _is_leaf,
+    int_children,
+    node_hash,
+    node_key,
+)
+
+logger = logging.getLogger("garage_tpu.table.sync")
+
+ANTI_ENTROPY_INTERVAL = 600.0  # ref sync.rs:30 (10 min)
+BATCH_SIZE = 256               # ref sync.rs push batches
+OFFLOAD_BATCH = 1024
+
+
+class TableSyncer:
+    def __init__(self, system, data, merkle: MerkleUpdater):
+        self.system = system
+        self.data = data
+        self.merkle = merkle
+        self.endpoint = system.netapp.endpoint(
+            f"garage/table_sync/{data.schema.TABLE_NAME}"
+        )
+        self.endpoint.set_handler(self._handle)
+        self.worker: Optional[SyncWorker] = None
+
+    def make_worker(self) -> "SyncWorker":
+        self.worker = SyncWorker(self)
+        self.system.on_ring_change(lambda _ring: self.worker.add_full_sync())
+        return self.worker
+
+    def add_full_sync(self):
+        if self.worker is not None:
+            self.worker.add_full_sync()
+
+    # --- one partition (ref sync.rs:110-168) ---
+
+    async def sync_partition(self, partition: int, first_hash: Hash) -> None:
+        nodes = self.data.replication.write_nodes(first_hash)
+        if self.system.id in nodes:
+            others = [n for n in nodes if n != self.system.id]
+            await asyncio.gather(
+                *[self._do_sync_with(partition, n) for n in others],
+                return_exceptions=False,
+            )
+        elif nodes:
+            await self._offload_partition(partition, nodes)
+
+    # --- push sync (ref sync.rs:286-415) ---
+
+    async def _do_sync_with(self, partition: int, who: FixedBytes32) -> None:
+        root_nk = node_key(partition, b"")
+        local_root = self.merkle.read_node(None, root_nk)
+        local_hash = node_hash(local_root)
+        resp = await self.endpoint.call(
+            who,
+            {"t": "root_ck", "p": partition},
+            prio=PRIO_BACKGROUND,
+        )
+        remote_hash = bytes(resp["ck"])
+        if bytes(local_hash) == remote_hash:
+            return
+        todo: List[bytes] = [root_nk]
+        to_send: List[bytes] = []
+        while todo:
+            nk = todo.pop()
+            node = self.merkle.read_node(None, nk)
+            if node is EMPTY:
+                continue  # remote has extra data; its own round pushes to us
+            r = await self.endpoint.call(
+                who, {"t": "get_node", "nk": nk}, prio=PRIO_BACKGROUND
+            )
+            rnode = r.get("node")
+            if _is_leaf(node):
+                rh = node_hash(rnode) if rnode is not None else EMPTY_HASH
+                if bytes(node_hash(node)) != bytes(rh):
+                    to_send.append(bytes(node[1]))
+            else:
+                # local intermediate: diff children against remote's child map
+                rchildren = (
+                    dict(int_children(rnode))
+                    if rnode is not None and _is_int(rnode)
+                    else {}
+                )
+                for b, h in int_children(node):
+                    if rchildren.get(b) != h:
+                        todo.append(nk + bytes([b]))
+            if len(to_send) >= BATCH_SIZE:
+                await self._send_items(who, to_send)
+                to_send = []
+        if to_send:
+            await self._send_items(who, to_send)
+
+    async def _send_items(self, who: FixedBytes32, keys: List[bytes]) -> None:
+        values = []
+        for k in keys:
+            v = self.data.store.get(k)
+            if v is not None:
+                values.append(v)
+        if not values:
+            return
+        await self.endpoint.call(
+            who, {"t": "items", "vs": values}, prio=PRIO_BACKGROUND
+        )
+
+    # --- offload (ref sync.rs:170-269) ---
+
+    async def _offload_partition(
+        self, partition: int, nodes: List[FixedBytes32]
+    ) -> None:
+        """We hold data for a partition that is no longer ours: send all of
+        it to the real replicas (quorum = all), then delete locally."""
+        begin = bytes([partition])
+        end = bytes([partition + 1]) if partition < 255 else None
+        while True:
+            batch = []
+            for k, v in self.data.store.items(begin, end):
+                batch.append((k, v))
+                if len(batch) >= OFFLOAD_BATCH:
+                    break
+            if not batch:
+                break
+            values = [v for _k, v in batch]
+            await self.system.rpc.try_call_many(
+                self.endpoint,
+                nodes,
+                {"t": "items", "vs": values},
+                RequestStrategy(rs_quorum=len(nodes), rs_priority=PRIO_BACKGROUND),
+            )
+            for k, v in batch:
+                self.data.delete_if_equal(k, v)
+            logger.info(
+                "%s: offloaded %d items of partition %d",
+                self.data.schema.TABLE_NAME, len(batch), partition,
+            )
+
+    # --- server side (ref sync.rs SyncRpc) ---
+
+    async def _handle(self, remote, msg, body):
+        t = msg.get("t")
+        if t == "root_ck":
+            ck = self.merkle.partition_root_hash(int(msg["p"]))
+            return {"ck": bytes(ck)}, None
+        if t == "get_node":
+            node = self.merkle.read_node(None, bytes(msg["nk"]))
+            return {"node": node}, None
+        if t == "items":
+            self.data.update_many([bytes(v) for v in msg["vs"]])
+            return {"ok": True}, None
+        raise GarageError(f"unknown sync rpc {t!r}")
+
+
+class SyncWorker(Worker):
+    """ref sync.rs:493-614: queue of partitions to sync, refilled by the
+    anti-entropy timer, ring changes and manual full-sync requests."""
+
+    def __init__(self, syncer: TableSyncer):
+        self.syncer = syncer
+        self.todo: List = []
+        self.next_full_sync = time.monotonic() + random.uniform(0.0, 30.0)
+        self._notify = asyncio.Event()
+
+    def name(self) -> str:
+        return f"{self.syncer.data.schema.TABLE_NAME} sync"
+
+    def add_full_sync(self):
+        self.todo = list(self.syncer.data.replication.partitions())
+        self.next_full_sync = time.monotonic() + anti_entropy_interval()
+        self._notify.set()
+
+    async def work(self) -> WorkerState:
+        st = self.status()
+        if time.monotonic() >= self.next_full_sync:
+            self.add_full_sync()
+        if not self.todo:
+            return WorkerState.IDLE
+        partition, first_hash = self.todo.pop(0)
+        st.queue_length = len(self.todo)
+        st.progress = f"partition {partition}"
+        try:
+            await self.syncer.sync_partition(partition, first_hash)
+        except Exception as e:
+            logger.debug(
+                "%s: sync of partition %d failed: %s",
+                self.syncer.data.schema.TABLE_NAME, partition, e,
+            )
+            raise
+        return WorkerState.BUSY
+
+    async def wait_for_work(self) -> None:
+        self._notify.clear()
+        delay = max(0.1, self.next_full_sync - time.monotonic())
+        try:
+            await asyncio.wait_for(self._notify.wait(), timeout=delay)
+        except asyncio.TimeoutError:
+            pass
+
+
+def anti_entropy_interval() -> float:
+    """Test hook: module-level override point."""
+    return ANTI_ENTROPY_INTERVAL
