@@ -1,0 +1,92 @@
+"""DataBlock — a block payload, plain or zstd-compressed.
+
+Equivalent of reference src/block/block.rs:10-115: `Plain(bytes)` vs
+`Compressed(bytes)` (zstd frame with content checksum); `verify` checks
+the content hash for plain data and the zstd frame checksum for compressed
+data (block.rs:66-78); `from_buffer` compresses when it shrinks the block
+(block.rs:80-91).
+
+The hash/verify primitives route through the BlockCodec so single-block
+ops and batched scrub ops share one implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import zstandard
+
+from ..utils.data import Hash, block_hash
+from ..utils.error import CorruptData
+
+
+@dataclasses.dataclass
+class DataBlockHeader:
+    """Wire header accompanying a block body (ref block.rs DataBlockHeader)."""
+
+    compressed: bool
+
+    def pack(self) -> str:
+        return "zst" if self.compressed else "plain"
+
+    @classmethod
+    def unpack(cls, v: str) -> "DataBlockHeader":
+        return cls(compressed=(v == "zst"))
+
+
+class DataBlock:
+    __slots__ = ("compressed", "inner")
+
+    def __init__(self, inner: bytes, compressed: bool):
+        self.inner = inner
+        self.compressed = compressed
+
+    @classmethod
+    def plain(cls, data: bytes) -> "DataBlock":
+        return cls(data, compressed=False)
+
+    @classmethod
+    def compressed_from(cls, data: bytes) -> "DataBlock":
+        return cls(data, compressed=True)
+
+    @classmethod
+    def from_buffer(
+        cls, data: bytes, compression_level: Optional[int]
+    ) -> "DataBlock":
+        """Compress if configured and it shrinks the block
+        (ref block.rs:80-91)."""
+        if compression_level is not None:
+            c = zstandard.ZstdCompressor(
+                level=compression_level,
+                write_checksum=True,
+                write_content_size=True,
+            )
+            out = c.compress(data)
+            if len(out) < len(data):
+                return cls(out, compressed=True)
+        return cls(data, compressed=False)
+
+    def header(self) -> DataBlockHeader:
+        return DataBlockHeader(self.compressed)
+
+    def verify(self, hash: Hash, algo: str = "blake2s") -> None:
+        """ref block.rs:66-78: plain → content hash must match; compressed →
+        zstd frame checksum validates (content hash covers the *uncompressed*
+        bytes, which we don't have without decompressing)."""
+        if self.compressed:
+            try:
+                zstandard.ZstdDecompressor().decompress(self.inner)
+            except zstandard.ZstdError as e:
+                raise CorruptData(f"zstd verify failed: {e}") from None
+        else:
+            if bytes(block_hash(self.inner, algo)) != bytes(hash):
+                raise CorruptData(f"hash mismatch for block {hash.hex()[:16]}")
+
+    def decompressed(self) -> bytes:
+        if self.compressed:
+            return zstandard.ZstdDecompressor().decompress(self.inner)
+        return self.inner
+
+    def __len__(self) -> int:
+        return len(self.inner)

@@ -1,0 +1,321 @@
+"""BlockManager — content-addressed block storage + streaming block RPC.
+
+Equivalent of reference src/block/manager.rs (SURVEY.md §2.5):
+  - local storage: write_block (tmp file + rename + optional fsync incl.
+    dir fsync, dedupe against existing copy, manager.rs:689-784), read_block
+    with verify (corruption → rename `.corrupted` + immediate resync
+    requeue, manager.rs:528-590), find_block across dirs and compression
+    states (manager.rs:608-643).
+  - RPC: rpc_get_block(_streaming) tries replicas in latency order with a
+    per-node timeout then moves on (manager.rs:231-317); rpc_put_block
+    compresses then quorum-writes via try_call_many (manager.rs:356-377).
+  - 256-way sharded mutation locks (manager.rs:115) serialize writes to the
+    same block without a global lock.
+
+TPU-first: single-block verify routes through the same BlockCodec used by
+the batch scrub path, so cpu/tpu backends share semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import AsyncIterator, List, Optional, Tuple
+
+from ..db import Db
+from ..net.frame import PRIO_BACKGROUND, PRIO_NORMAL
+from ..rpc.system import System
+from ..utils.data import Hash, block_hash
+from ..utils.error import CorruptData, GarageError, NoSuchBlock
+from ..utils.persister import Persister
+from .block import DataBlock, DataBlockHeader
+from .layout import DataLayout
+from .rc import BlockRc
+
+logger = logging.getLogger("garage_tpu.block.manager")
+
+INLINE_THRESHOLD = 3072       # ref manager.rs:49
+BLOCK_RW_TIMEOUT = 60.0
+MUTEX_SHARDS = 256            # ref manager.rs:115
+STREAM_CHUNK = 256 * 1024
+
+
+class BlockManager:
+    def __init__(
+        self,
+        config,
+        db: Db,
+        system: System,
+        replication,            # TableShardedReplication for data partitions
+        codec=None,
+    ):
+        self.config = config
+        self.db = db
+        self.system = system
+        self.replication = replication
+        self.codec = codec or config.codec.make(config.compression_level)
+        self.hash_algo = config.codec.hash_algo
+        self.compression_level = config.compression_level
+        self.data_fsync = config.data_fsync
+
+        # multi-drive layout, persisted (ref manager.rs:122-160)
+        self._layout_persister = Persister(
+            config.metadata_dir, "data_layout", DataLayout
+        )
+        saved = self._layout_persister.load()
+        if saved is None:
+            self.data_layout = DataLayout.initialize(config.data_dir)
+            self._layout_persister.save(self.data_layout)
+        elif saved.config_changed(config.data_dir):
+            self.data_layout = saved.update(config.data_dir)
+            self._layout_persister.save(self.data_layout)
+        else:
+            self.data_layout = saved
+        for d in self.data_layout.data_dirs:
+            os.makedirs(d.path, exist_ok=True)
+
+        self.rc = BlockRc(db.open_tree("block_local_rc"))
+        self._locks = [asyncio.Lock() for _ in range(MUTEX_SHARDS)]
+
+        self.endpoint = system.netapp.endpoint("garage/block")
+        self.endpoint.set_handler(self._handle)
+
+        # attached after construction (circular dep): BlockResyncManager
+        self.resync = None
+
+        # metrics counters (ref block/metrics.rs)
+        self.bytes_read = 0
+        self.bytes_written = 0
+        self.corruptions = 0
+
+    # --- paths ---
+
+    def _block_dir(self, root: str, h: Hash) -> str:
+        hx = bytes(h).hex()
+        return os.path.join(root, hx[:2], hx[2:4])
+
+    def block_path(self, root: str, h: Hash, compressed: bool) -> str:
+        return os.path.join(
+            self._block_dir(root, h), bytes(h).hex() + (".zst" if compressed else "")
+        )
+
+    def find_block(self, h: Hash) -> Optional[Tuple[str, bool]]:
+        """Locate an existing copy: (path, compressed), preferring the
+        primary dir then secondaries, compressed then plain
+        (ref manager.rs:608-643)."""
+        for root in self.data_layout.all_dirs(h):
+            for compressed in (True, False):
+                p = self.block_path(root, h, compressed)
+                if os.path.exists(p):
+                    return p, compressed
+        return None
+
+    def is_block_present(self, h: Hash) -> bool:
+        return self.find_block(h) is not None
+
+    def _lock_for(self, h: Hash) -> asyncio.Lock:
+        return self._locks[h[0] % MUTEX_SHARDS]
+
+    # --- local read/write (ref manager.rs:478-590,689-784) ---
+
+    async def write_block(self, h: Hash, data: DataBlock) -> None:
+        async with self._lock_for(h):
+            await asyncio.to_thread(self._write_block_sync, h, data)
+
+    def _write_block_sync(self, h: Hash, data: DataBlock) -> None:
+        root = self.data_layout.primary_dir(h)
+        final = self.block_path(root, h, data.compressed)
+        existing = self.find_block(h)
+        if existing is not None:
+            path, compressed = existing
+            if compressed or not data.compressed:
+                # an equal-or-better copy exists (compressed preferred):
+                # keep it (ref manager.rs:717-735 dedupe)
+                return
+        d = os.path.dirname(final)
+        os.makedirs(d, exist_ok=True)
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data.inner)
+            if self.data_fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, final)
+        if self.data_fsync:
+            # fsync the directory so the rename is durable (manager.rs:760-775)
+            dirfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        if existing is not None and existing[0] != final:
+            # plain copy superseded by compressed one
+            try:
+                os.remove(existing[0])
+            except OSError:
+                pass
+        self.bytes_written += len(data.inner)
+
+    async def read_block(self, h: Hash) -> DataBlock:
+        """Read + verify; on corruption move the file aside and requeue a
+        resync so a good copy is re-fetched (ref manager.rs:528-590)."""
+        found = self.find_block(h)
+        if found is None:
+            raise NoSuchBlock(f"block {bytes(h).hex()[:16]} not found locally")
+        path, compressed = found
+        raw = await asyncio.to_thread(_read_file, path)
+        block = DataBlock(raw, compressed)
+        try:
+            block.verify(h, self.hash_algo)
+        except CorruptData:
+            self.corruptions += 1
+            logger.error("corrupted block %s at %s", bytes(h).hex()[:16], path)
+            await asyncio.to_thread(_move_corrupted, path)
+            if self.resync is not None:
+                self.resync.put_to_resync(h, 0.0)
+            raise
+        self.bytes_read += len(raw)
+        return block
+
+    async def delete_if_unneeded(self, h: Hash) -> None:
+        """Delete the local copy if rc says it's deletable (resync path,
+        ref resync.rs:431-455)."""
+        async with self._lock_for(h):
+            if not self.rc.get(h).is_deletable():
+                return
+            while True:
+                found = self.find_block(h)
+                if found is None:
+                    break
+                await asyncio.to_thread(os.remove, found[0])
+            self.rc.clear_deleted_block_rc(h)
+
+    # --- refcounting entry points (called from table updated() hooks) ---
+
+    def block_incref(self, tx, h: Hash) -> None:
+        if self.rc.block_incref(tx, h):
+            # 0→1: we might not have the block yet — check after commit
+            if self.resync is not None:
+                tx.on_commit(lambda: self.resync.put_to_resync(h, 2.0))
+
+    def block_decref(self, tx, h: Hash) -> None:
+        if self.rc.block_decref(tx, h):
+            # reached zero: schedule deletion check after the GC delay
+            if self.resync is not None:
+                from .rc import BLOCK_GC_DELAY_MS
+
+                tx.on_commit(
+                    lambda: self.resync.put_to_resync(h, BLOCK_GC_DELAY_MS / 1000.0)
+                )
+
+    # --- RPC client side ---
+
+    async def rpc_put_block(self, h: Hash, data: bytes) -> None:
+        """Compress + quorum-write to the block's replica set
+        (ref manager.rs:356-377)."""
+        who = self.replication.write_nodes(h)
+        block = await asyncio.to_thread(
+            DataBlock.from_buffer, data, self.compression_level
+        )
+        from ..rpc.rpc_helper import RequestStrategy
+
+        async def send(node):
+            await self.endpoint.call(
+                node,
+                {"t": "put_block", "h": bytes(h), "hdr": block.header().pack()},
+                prio=PRIO_NORMAL,
+                timeout=BLOCK_RW_TIMEOUT,
+                body=_chunks(block.inner),
+            )
+            return node
+
+        await self.system.rpc.try_call_many(
+            self.endpoint,
+            who,
+            None,
+            RequestStrategy(
+                rs_quorum=self.replication.write_quorum(),
+                rs_timeout=BLOCK_RW_TIMEOUT,
+            ),
+            make_call=send,
+        )
+
+    async def rpc_get_block(self, h: Hash, order_tag: Optional[int] = None) -> bytes:
+        """Fetch + decompress a block, trying replicas one at a time in
+        latency order (ref manager.rs:231-317)."""
+        block = await self.rpc_get_raw_block(h, order_tag)
+        return await asyncio.to_thread(block.decompressed)
+
+    async def rpc_get_raw_block(
+        self, h: Hash, order_tag: Optional[int] = None
+    ) -> DataBlock:
+        who = self.system.rpc.request_order(self.replication.read_nodes(h))
+        errors = []
+        for node in who:
+            try:
+                resp, stream = await self.endpoint.call_streaming(
+                    node,
+                    {"t": "get_block", "h": bytes(h), "order": order_tag},
+                    prio=PRIO_NORMAL,
+                    timeout=BLOCK_RW_TIMEOUT,
+                )
+                if resp.get("err"):
+                    raise NoSuchBlock(resp["err"])
+                raw = await stream.read_all() if stream is not None else b""
+                return DataBlock(raw, DataBlockHeader.unpack(resp["hdr"]).compressed)
+            except Exception as e:
+                errors.append(f"{bytes(node).hex()[:8]}: {e}")
+        raise GarageError(
+            f"could not get block {bytes(h).hex()[:16]} from any node: {errors}"
+        )
+
+    async def need_block(self, h: Hash) -> bool:
+        """Do we need a copy of this block? (rc>0 but no local file)"""
+        return self.rc.get(h).is_needed() and not self.is_block_present(h)
+
+    # --- RPC server side (ref manager.rs:671-687) ---
+
+    async def _handle(self, remote, msg, body):
+        t = msg.get("t")
+        if t == "put_block":
+            h = Hash(bytes(msg["h"]))
+            hdr = DataBlockHeader.unpack(msg["hdr"])
+            raw = await body.read_all() if body is not None else b""
+            await self.write_block(h, DataBlock(raw, hdr.compressed))
+            return {"ok": True}, None
+        if t == "get_block":
+            h = Hash(bytes(msg["h"]))
+            try:
+                block = await self.read_block(h)
+            except (NoSuchBlock, CorruptData) as e:
+                return {"err": str(e)}, None
+            return {"hdr": block.header().pack()}, _chunks(block.inner)
+        if t == "need_block":
+            h = Hash(bytes(msg["h"]))
+            return {"needed": await self.need_block(h)}, None
+        raise GarageError(f"unknown block rpc {t!r}")
+
+    # --- introspection ---
+
+    def rc_len(self) -> int:
+        return self.rc.rc_len()
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _move_corrupted(path: str) -> None:
+    try:
+        os.replace(path, path + ".corrupted")
+    except OSError:
+        pass
+
+
+async def _chunks(data: bytes) -> AsyncIterator[bytes]:
+    for i in range(0, len(data), STREAM_CHUNK):
+        yield data[i : i + STREAM_CHUNK]
+    if not data:
+        return

@@ -1,0 +1,408 @@
+"""Scrub / repair / rebalance workers — batch-first.
+
+Equivalent of reference src/block/repair.rs (SURVEY.md §2.5):
+  - ScrubWorker: full-datastore integrity pass every 25-35 days
+    (randomized, repair.rs:24,244-254), resumable via a persisted iterator
+    checkpoint (60 s cadence), Start/Pause/Resume/Cancel commands,
+    tranquilizer-throttled, corruption counter.
+  - RepairWorker: one-shot: re-enqueue every referenced hash to resync,
+    then walk the disk and enqueue every found block (repair.rs:35-155).
+  - RebalanceWorker: move blocks to their primary dir after a data-layout
+    change (repair.rs:531-626).
+  - BlockStoreIterator: resumable hash-ordered walk of the block store
+    with fixed-point progress (repair.rs:634-764).
+
+TPU-first difference (the north-star design, BASELINE.md): the reference
+scrubs strictly one block at a time — read, blake2, next
+(repair.rs:438-490).  Here the iterator feeds *batches* to the BlockCodec:
+one device dispatch hashes `batch_blocks` blocks at once, so a TPU codec
+turns scrub from CPU-bound into IO-bound.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import time
+from typing import List, Optional, Tuple
+
+from ..utils.background import Worker, WorkerState
+from ..utils.crdt import now_msec
+from ..utils.data import Hash
+from ..utils.migrate import Migrated
+from ..utils.persister import Persister
+from ..utils.tranquilizer import Tranquilizer
+
+logger = logging.getLogger("garage_tpu.block.repair")
+
+SCRUB_INTERVAL_MIN = 25 * 86400   # ref repair.rs:24 (randomized 25-35 days)
+SCRUB_INTERVAL_MAX = 35 * 86400
+DEFAULT_SCRUB_TRANQUILITY = 4     # ref repair.rs:27
+CHECKPOINT_INTERVAL = 60.0        # ref repair.rs:460-464
+REPAIR_BATCH = 1000               # ref repair.rs:92-101 (sqlite-safe batches)
+
+
+class BlockStoreIterator:
+    """Hash-ordered walk over every block file across all data dirs,
+    resumable from a serialized position (ref repair.rs:634-764).
+
+    Position = last fully-processed 2-level prefix (0..65536); progress is
+    prefix/65536 — equivalent to the reference's fixed-point fraction."""
+
+    def __init__(self, roots: List[str], position: int = 0):
+        self.roots = roots
+        self.position = position  # next 2-byte prefix to scan
+        self._prefixes: Optional[List[int]] = None  # existing dirs, sorted
+
+    def progress(self) -> float:
+        return self.position / 65536.0
+
+    def is_done(self) -> bool:
+        return self.position >= 65536
+
+    def _scan_prefixes(self) -> List[int]:
+        """Enumerate existing 2-level prefix dirs (≤256 listdir calls per
+        root instead of probing all 65536 combinations)."""
+        pref = set()
+        for root in self.roots:
+            try:
+                level1 = os.listdir(root)
+            except FileNotFoundError:
+                continue
+            for a in level1:
+                if len(a) != 2:
+                    continue
+                try:
+                    ai = int(a, 16)
+                    level2 = os.listdir(os.path.join(root, a))
+                except (ValueError, OSError):
+                    continue
+                for b in level2:
+                    if len(b) == 2:
+                        try:
+                            pref.add((ai << 8) | int(b, 16))
+                        except ValueError:
+                            pass
+        return sorted(pref)
+
+    def next_prefix(self) -> Optional[List[Tuple[Hash, str, bool]]]:
+        """All blocks under the next existing prefix dir:
+        [(hash, path, compressed)]; None when the walk is complete."""
+        if self._prefixes is None:
+            self._prefixes = self._scan_prefixes()
+        import bisect
+
+        i = bisect.bisect_left(self._prefixes, self.position)
+        if i >= len(self._prefixes) or self.is_done():
+            self.position = 65536
+            return None
+        p = self._prefixes[i]
+        self.position = p + 1
+        d1, d2 = f"{p >> 8:02x}", f"{p & 0xFF:02x}"
+        seen = {}
+        for root in self.roots:
+            d = os.path.join(root, d1, d2)
+            try:
+                names = os.listdir(d)
+            except FileNotFoundError:
+                continue
+            for name in names:
+                base = name[:-4] if name.endswith(".zst") else name
+                if len(base) != 64 or name.endswith((".tmp", ".corrupted")):
+                    continue
+                try:
+                    h = Hash(bytes.fromhex(base))
+                except ValueError:
+                    continue
+                # prefer the compressed copy, first root wins (primary first)
+                if bytes(h) not in seen or name.endswith(".zst"):
+                    seen[bytes(h)] = (h, os.path.join(d, name), name.endswith(".zst"))
+        return sorted(seen.values(), key=lambda t: bytes(t[0]))
+
+
+class ScrubWorkerState(Migrated):
+    """Persisted scrub state (ref repair.rs:165-232)."""
+
+    VERSION_MARKER = b"GT01scrub"
+
+    def __init__(
+        self,
+        position: int = 0,
+        running: bool = False,
+        paused: bool = False,
+        time_next_run: int = 0,
+        tranquility: int = DEFAULT_SCRUB_TRANQUILITY,
+        corruptions: int = 0,
+        time_last_complete: int = 0,
+    ):
+        self.position = position
+        self.running = running
+        self.paused = paused
+        self.time_next_run = time_next_run
+        self.tranquility = tranquility
+        self.corruptions = corruptions
+        self.time_last_complete = time_last_complete
+
+    def fields(self):
+        return [
+            self.position, self.running, self.paused, self.time_next_run,
+            self.tranquility, self.corruptions, self.time_last_complete,
+        ]
+
+    @classmethod
+    def from_fields(cls, b):
+        return cls(*b)
+
+
+def randomize_next_scrub() -> int:
+    return now_msec() + random.randint(
+        SCRUB_INTERVAL_MIN * 1000, SCRUB_INTERVAL_MAX * 1000
+    )
+
+
+class ScrubWorker(Worker):
+    """Batch-first scrub: BlockStoreIterator prefixes → codec.batch_verify
+    (one device dispatch per batch) → corrupted blocks moved aside +
+    requeued for resync."""
+
+    def __init__(self, manager, persister: Optional[Persister] = None):
+        self.manager = manager
+        self.persister = persister
+        st = persister.load() if persister is not None else None
+        self.state: ScrubWorkerState = st or ScrubWorkerState(
+            time_next_run=randomize_next_scrub()
+        )
+        self.iterator: Optional[BlockStoreIterator] = None
+        if self.state.running:
+            self.iterator = BlockStoreIterator(
+                self._roots(), self.state.position
+            )
+        self.tranquilizer = Tranquilizer()
+        self._last_checkpoint = time.monotonic()
+        self._cmd: asyncio.Queue = asyncio.Queue()
+        self._wake = asyncio.Event()
+
+    def _roots(self) -> List[str]:
+        return [d.path for d in self.manager.data_layout.data_dirs]
+
+    def name(self) -> str:
+        return "Block scrub worker"
+
+    # --- operator commands (ref repair.rs Start/Pause/Resume/Cancel) ---
+
+    def send_command(self, cmd: str) -> None:
+        self._cmd.put_nowait(cmd)
+        self._wake.set()
+
+    def _apply_command(self, cmd: str) -> None:
+        st = self.state
+        if cmd == "start":
+            if self.iterator is None:
+                self.iterator = BlockStoreIterator(self._roots())
+                st.running, st.paused, st.position, st.corruptions = True, False, 0, 0
+        elif cmd == "pause":
+            st.paused = True
+        elif cmd == "resume":
+            st.paused = False
+        elif cmd == "cancel":
+            self.iterator = None
+            st.running, st.paused, st.position = False, False, 0
+        self._checkpoint(force=True)
+
+    def _checkpoint(self, force: bool = False) -> None:
+        if self.persister is None:
+            return
+        if force or time.monotonic() - self._last_checkpoint > CHECKPOINT_INTERVAL:
+            self.state.position = self.iterator.position if self.iterator else 0
+            self.persister.save(self.state)
+            self._last_checkpoint = time.monotonic()
+
+    # --- the batch scrub step ---
+
+    async def work(self) -> WorkerState:
+        while not self._cmd.empty():
+            self._apply_command(self._cmd.get_nowait())
+        st = self.state
+        status = self.status()
+        status.tranquility = st.tranquility
+        if self.iterator is None:
+            # waiting for the next scheduled run
+            if now_msec() >= st.time_next_run:
+                self._apply_command("start")
+                return WorkerState.BUSY
+            return WorkerState.IDLE
+        if st.paused:
+            return WorkerState.IDLE
+        self.tranquilizer.reset()
+        batch = await asyncio.to_thread(self.iterator.next_prefix)
+        if batch is None:
+            # complete
+            st.time_last_complete = now_msec()
+            st.time_next_run = randomize_next_scrub()
+            st.running = False
+            self.iterator = None
+            self._checkpoint(force=True)
+            logger.info("scrub complete, %d corruptions found", st.corruptions)
+            return WorkerState.BUSY
+        status.progress = f"{self.iterator.progress() * 100:.2f}%"
+        if batch:
+            await self.scrub_batch(batch)
+        self._checkpoint()
+        return await self.tranquilizer.tranquilize_worker(st.tranquility)
+
+    async def scrub_batch(self, batch: List[Tuple[Hash, str, bool]]) -> None:
+        """Verify one batch through the codec; quarantine corrupt blocks.
+
+        Plain blocks go through codec.batch_verify (the device dispatch);
+        compressed blocks validate their zstd frame checksum on CPU, as in
+        the reference (block.rs:66-78)."""
+        mgr = self.manager
+        plain_idx, plain_blocks, plain_hashes = [], [], []
+        reads = await asyncio.gather(
+            *[asyncio.to_thread(_try_read, path) for _h, path, _c in batch]
+        )
+        for i, ((h, path, compressed), raw) in enumerate(zip(batch, reads)):
+            if raw is None:
+                continue
+            if compressed:
+                ok = await asyncio.to_thread(_zstd_ok, raw)
+                if not ok:
+                    await self._quarantine(h, path)
+            else:
+                plain_idx.append(i)
+                plain_blocks.append(raw)
+                plain_hashes.append(h)
+        if plain_blocks:
+            ok = await asyncio.to_thread(
+                mgr.codec.batch_verify, plain_blocks, plain_hashes
+            )
+            for j, good in enumerate(ok):
+                if not good:
+                    h, path, _ = batch[plain_idx[j]]
+                    await self._quarantine(h, path)
+
+    async def _quarantine(self, h: Hash, path: str) -> None:
+        self.state.corruptions += 1
+        self.manager.corruptions += 1
+        logger.error("scrub: corrupted block %s at %s", bytes(h).hex()[:16], path)
+        await asyncio.to_thread(_move_aside, path)
+        if self.manager.resync is not None:
+            self.manager.resync.put_to_resync(h, 0.0)
+
+    async def wait_for_work(self) -> None:
+        self._wake.clear()
+        delay = max(1.0, (self.state.time_next_run - now_msec()) / 1000.0)
+        try:
+            await asyncio.wait_for(self._wake.wait(), timeout=min(delay, 10.0))
+        except asyncio.TimeoutError:
+            pass
+
+
+class RepairWorker(Worker):
+    """One-shot consistency repair (ref repair.rs:35-155): phase 1 enqueues
+    every referenced hash to resync; phase 2 walks the disk and enqueues
+    every found block (catches rc=0 leftovers)."""
+
+    def __init__(self, manager):
+        self.manager = manager
+        self.phase = 1
+        self.cursor: Optional[bytes] = b""
+        self.iterator: Optional[BlockStoreIterator] = None
+
+    def name(self) -> str:
+        return "Block repair worker"
+
+    async def work(self) -> WorkerState:
+        mgr = self.manager
+        if self.phase == 1:
+            batch = 0
+            while batch < REPAIR_BATCH:
+                nxt = (
+                    mgr.rc.tree.first()
+                    if self.cursor == b""
+                    else mgr.rc.get_gt(self.cursor)
+                )
+                if nxt is None:
+                    self.phase = 2
+                    self.iterator = BlockStoreIterator(
+                        [d.path for d in mgr.data_layout.data_dirs]
+                    )
+                    return WorkerState.BUSY
+                key, _v = nxt
+                mgr.resync.put_to_resync(Hash(key), 0.0)
+                self.cursor = key
+                batch += 1
+            self.status().progress = "phase 1"
+            return WorkerState.BUSY
+        batch = await asyncio.to_thread(self.iterator.next_prefix)
+        if batch is None:
+            return WorkerState.DONE
+        for h, _path, _c in batch:
+            mgr.resync.put_to_resync(h, 0.0)
+        self.status().progress = f"phase 2: {self.iterator.progress() * 100:.1f}%"
+        return WorkerState.BUSY
+
+
+class RebalanceWorker(Worker):
+    """One-shot: move blocks into their primary dir after a layout change,
+    dropping secondary copies (ref repair.rs:531-626)."""
+
+    def __init__(self, manager):
+        self.manager = manager
+        self.iterator = BlockStoreIterator(
+            [d.path for d in manager.data_layout.data_dirs]
+        )
+        self.moved = 0
+
+    def name(self) -> str:
+        return "Block rebalance worker"
+
+    async def work(self) -> WorkerState:
+        mgr = self.manager
+        batch = await asyncio.to_thread(self.iterator.next_prefix)
+        if batch is None:
+            logger.info("rebalance done, moved %d blocks", self.moved)
+            return WorkerState.DONE
+        for h, path, compressed in batch:
+            primary = mgr.block_path(mgr.data_layout.primary_dir(h), h, compressed)
+            if os.path.abspath(path) == os.path.abspath(primary):
+                continue
+            await asyncio.to_thread(_move_into_place, path, primary)
+            self.moved += 1
+        self.status().progress = f"{self.iterator.progress() * 100:.1f}%"
+        return WorkerState.BUSY
+
+
+def _try_read(path: str) -> Optional[bytes]:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _zstd_ok(raw: bytes) -> bool:
+    import zstandard
+
+    try:
+        zstandard.ZstdDecompressor().decompress(raw)
+        return True
+    except zstandard.ZstdError:
+        return False
+
+
+def _move_aside(path: str) -> None:
+    try:
+        os.replace(path, path + ".corrupted")
+    except OSError:
+        pass
+
+
+def _move_into_place(src: str, dst: str) -> None:
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    if os.path.exists(dst):
+        os.remove(src)
+    else:
+        os.replace(src, dst)

@@ -1,0 +1,234 @@
+"""BlockResyncManager — the persistent resync queue and its workers.
+
+Equivalent of reference src/block/resync.rs (SURVEY.md §2.5): a persistent
+queue keyed `timestamp(8B BE ms) ‖ hash(32B)` of blocks to re-examine, an
+error tree with exponential backoff (60 s × 2^n, capped at 2^6 ≈ 1 h,
+resync.rs:38-41), up to MAX_RESYNC_WORKERS concurrent workers throttled by
+a Tranquilizer and deduplicated through a shared busy-set (resync.rs:80-86).
+
+resync_block (resync.rs:361-471) is the convergence step:
+  - rc = 0 and block on disk  → offer it to replicas that need it
+    (NeedBlockQuery), upload to all needy nodes, then delete locally.
+  - rc > 0 and block missing  → fetch from a replica and store it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+from typing import Optional, Set
+
+from ..db import Db
+from ..db.counted_tree import CountedTree
+from ..net.frame import PRIO_BACKGROUND
+from ..utils.background import Worker, WorkerState
+from ..utils.crdt import now_msec
+from ..utils.data import Hash
+from ..utils.error import GarageError
+from ..utils.migrate import pack, unpack
+from ..utils.tranquilizer import Tranquilizer
+
+logger = logging.getLogger("garage_tpu.block.resync")
+
+RESYNC_RETRY_DELAY = 60.0       # ref resync.rs:38
+RESYNC_RETRY_MAX_EXP = 6        # ref resync.rs:41 (max 60s * 2^6)
+MAX_RESYNC_WORKERS = 8          # ref resync.rs:44
+DEFAULT_RESYNC_TRANQUILITY = 2  # ref resync.rs:47
+
+
+class ErrorCounter:
+    """ref resync.rs ErrorCounter: (errors, last_try) with backoff."""
+
+    __slots__ = ("errors", "last_try")
+
+    def __init__(self, errors: int = 0, last_try: int = 0):
+        self.errors = errors
+        self.last_try = last_try
+
+    @classmethod
+    def parse(cls, v: bytes) -> "ErrorCounter":
+        e, lt = unpack(v)
+        return cls(e, lt)
+
+    def serialize(self) -> bytes:
+        return pack([self.errors, self.last_try])
+
+    def delay_ms(self) -> int:
+        return int(
+            RESYNC_RETRY_DELAY * 1000 * (1 << min(self.errors - 1, RESYNC_RETRY_MAX_EXP))
+        )
+
+    def next_try(self) -> int:
+        return self.last_try + self.delay_ms()
+
+
+class BlockResyncManager:
+    def __init__(self, manager, db: Db):
+        self.manager = manager
+        self.queue = CountedTree(db.open_tree("block_local_resync_queue"))
+        self.errors = CountedTree(db.open_tree("block_local_resync_errors"))
+        self.busy_set: Set[bytes] = set()
+        self.notify = asyncio.Event()
+        self.n_workers = 1
+        self.tranquility = DEFAULT_RESYNC_TRANQUILITY
+
+    # --- queue management (ref resync.rs:88-260) ---
+
+    def put_to_resync(self, h: Hash, delay_secs: float) -> None:
+        when = now_msec() + int(delay_secs * 1000)
+        self.put_to_resync_at(h, when)
+
+    def put_to_resync_at(self, h: Hash, when_ms: int) -> None:
+        key = struct.pack(">Q", when_ms) + bytes(h)
+        self.queue.insert(key, b"")
+        self.notify.set()
+
+    def clear_backoff(self, h: Hash) -> None:
+        if self.errors.get(bytes(h)) is not None:
+            self.errors.remove(bytes(h))
+
+    def queue_len(self) -> int:
+        return len(self.queue)
+
+    def errors_len(self) -> int:
+        return len(self.errors)
+
+    # --- iteration (ref resync.rs:262-359) ---
+
+    async def resync_iter(self) -> WorkerState:
+        """Process (at most) the first due queue entry; returns the worker
+        state to report."""
+        first = self.queue.first()
+        if first is None:
+            return WorkerState.IDLE
+        key, _v = first
+        when = struct.unpack(">Q", key[:8])[0]
+        now = now_msec()
+        if when > now:
+            return WorkerState.IDLE  # head not due yet
+        h = Hash(key[8:])
+        hb = bytes(h)
+        if hb in self.busy_set:
+            # another worker is on it; drop this queue entry (it will be
+            # requeued if needed)
+            self.queue.remove(key)
+            return WorkerState.BUSY
+        # error backoff check (ref resync.rs:317-343)
+        ev = self.errors.get(hb)
+        if ev is not None:
+            ec = ErrorCounter.parse(ev)
+            if ec.next_try() > now:
+                # not yet: move the queue entry to the retry time
+                self.queue.remove(key)
+                self.put_to_resync_at(h, ec.next_try())
+                return WorkerState.BUSY
+        self.busy_set.add(hb)
+        try:
+            await self.resync_block(h)
+        except Exception as e:
+            logger.warning("resync of %s failed: %s", hb.hex()[:16], e)
+            ec = ErrorCounter.parse(ev) if ev is not None else ErrorCounter()
+            ec = ErrorCounter(ec.errors + 1, now)
+            self.errors.insert(hb, ec.serialize())
+            self.queue.remove(key)
+            self.put_to_resync_at(h, ec.next_try())
+            return WorkerState.BUSY
+        finally:
+            self.busy_set.discard(hb)
+        self.clear_backoff(h)
+        self.queue.remove(key)
+        return WorkerState.BUSY
+
+    # --- the convergence step (ref resync.rs:361-471) ---
+
+    async def resync_block(self, h: Hash) -> None:
+        mgr = self.manager
+        rc = mgr.rc.get(h)
+        present = mgr.is_block_present(h)
+
+        if rc.is_deletable() and present:
+            # we hold a block nobody references: offer to under-replicated
+            # peers, then delete (ref resync.rs:376-455)
+            who = [n for n in mgr.replication.write_nodes(h) if n != mgr.system.id]
+            needy = []
+            for node in who:
+                resp = await mgr.endpoint.call(
+                    node,
+                    {"t": "need_block", "h": bytes(h)},
+                    prio=PRIO_BACKGROUND,
+                    timeout=60.0,
+                )
+                if resp.get("needed"):
+                    needy.append(node)
+            if needy:
+                block = await mgr.read_block(h)
+                from .manager import _chunks
+
+                for node in needy:
+                    await mgr.endpoint.call(
+                        node,
+                        {
+                            "t": "put_block",
+                            "h": bytes(h),
+                            "hdr": block.header().pack(),
+                        },
+                        prio=PRIO_BACKGROUND,
+                        timeout=60.0,
+                        body=_chunks(block.inner),
+                    )
+                logger.info(
+                    "offloaded block %s to %d nodes", bytes(h).hex()[:16], len(needy)
+                )
+            await mgr.delete_if_unneeded(h)
+
+        elif rc.is_needed() and not present:
+            # we should have it but don't: fetch from a replica
+            # (ref resync.rs:457-468)
+            block = await mgr.rpc_get_raw_block(h)
+            await mgr.write_block(h, block)
+            logger.info("resynced missing block %s", bytes(h).hex()[:16])
+
+    async def next_due_in(self) -> float:
+        first = self.queue.first()
+        if first is None:
+            return 10.0
+        when = struct.unpack(">Q", first[0][:8])[0]
+        return max(0.05, min((when - now_msec()) / 1000.0, 10.0))
+
+
+class ResyncWorker(Worker):
+    """ref resync.rs:481-567; spawn `n_workers` of these."""
+
+    def __init__(self, resync: BlockResyncManager, index: int = 0):
+        self.resync = resync
+        self.index = index
+        self.tranquilizer = Tranquilizer()
+
+    def name(self) -> str:
+        return f"Block resync worker #{self.index + 1}"
+
+    async def work(self) -> WorkerState:
+        if self.index >= self.resync.n_workers:
+            await asyncio.sleep(1.0)
+            return WorkerState.IDLE
+        st = self.status()
+        st.queue_length = self.resync.queue_len()
+        st.persistent_errors = self.resync.errors_len()
+        st.tranquility = self.resync.tranquility
+        self.tranquilizer.reset()
+        state = await self.resync.resync_iter()
+        if state == WorkerState.BUSY:
+            return await self.tranquilizer.tranquilize_worker(
+                self.resync.tranquility
+            )
+        return state
+
+    async def wait_for_work(self) -> None:
+        self.resync.notify.clear()
+        delay = await self.resync.next_due_in()
+        try:
+            await asyncio.wait_for(self.resync.notify.wait(), timeout=delay)
+        except asyncio.TimeoutError:
+            pass

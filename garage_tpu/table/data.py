@@ -79,7 +79,7 @@ class TableData:
         if reverse:
             # descending from the start sort key *inclusive* (ref
             # data.rs range_rev(..=first)); no start key = whole partition
-            rev_end = first + b"\x00" if start_sort_key else end
+            rev_end = first + b"\x00" if start_sort_key is not None else end
             it = self.store.items_rev(bytes(partition_hash), rev_end)
         else:
             it = self.store.items(first, end)
@@ -198,8 +198,15 @@ class TableData:
     def queue_insert(self, tx: Transaction, entry: Entry) -> None:
         """Defer an insert from inside another transaction: the entry is
         written to the insert queue and pushed to replicas asynchronously
-        by the InsertQueueWorker (ref data.rs:57-90, queue.rs)."""
-        key = struct.pack(">Q", now_msec()) + entry.tree_key()
+        by the InsertQueueWorker (ref data.rs:323-341, queue.rs).  Keyed by
+        tree_key alone; a second queued update for the same entry is CRDT-
+        merged into the pending one, never overwritten."""
+        key = entry.tree_key()
+        cur = tx.get(self.insert_queue.tree, key)
+        if cur is not None:
+            pending = self.decode_entry(cur)
+            pending.merge(entry)
+            entry = pending
         self.insert_queue.tx_insert(tx, key, entry.encode())
         tx.on_commit(self.insert_queue_notify.set)
 

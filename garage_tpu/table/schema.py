@@ -29,11 +29,12 @@ def hash_partition_key(p: Any) -> Hash:
             return Hash(p)
         return blake2sum(p)
     if isinstance(p, tuple):
-        parts = b"".join(
-            bytes(x) if isinstance(x, (bytes, FixedBytes32)) else str(x).encode()
-            for x in p
-        )
-        return blake2sum(parts)
+        # length-prefix each part so ("a","bc") and ("ab","c") can't collide
+        buf = b""
+        for x in p:
+            part = bytes(x) if isinstance(x, (bytes, FixedBytes32)) else str(x).encode()
+            buf += len(part).to_bytes(4, "big") + part
+        return blake2sum(buf)
     raise TypeError(f"unsupported partition key type {type(p)!r}")
 
 

@@ -140,8 +140,12 @@ class TableSyncer:
     ) -> None:
         """We hold data for a partition that is no longer ours: send all of
         it to the real replicas (quorum = all), then delete locally."""
-        begin = bytes([partition])
-        end = bytes([partition + 1]) if partition < 255 else None
+        if len(self.data.replication.partitions()) == 1:
+            # single-partition replication (full-copy): the whole keyspace
+            begin, end = None, None
+        else:
+            begin = bytes([partition])
+            end = bytes([partition + 1]) if partition < 255 else None
         while True:
             batch = []
             for k, v in self.data.store.items(begin, end):

@@ -54,6 +54,7 @@ class Table:
         # attached by Garage.spawn_workers: syncer/gc refs for admin RPC
         self.syncer = None
         self.gc = None
+        self._repair_tasks: set = set()  # strong refs: loop holds tasks weakly
 
     # --- client operations ---
 
@@ -236,7 +237,9 @@ class Table:
                     "%s: read repair failed: %s", self.schema.TABLE_NAME, e
                 )
 
-        asyncio.get_running_loop().create_task(repair())
+        task = asyncio.get_running_loop().create_task(repair())
+        self._repair_tasks.add(task)
+        task.add_done_callback(self._repair_tasks.discard)
 
     # --- server side (ref table.rs:426-461) ---
 

@@ -31,7 +31,11 @@ class CorruptData(GarageError):
 
     def __init__(self, expected_hash):
         self.expected_hash = expected_hash
-        super().__init__(f"corrupt data for block {bytes(expected_hash).hex()[:16]}")
+        if isinstance(expected_hash, (bytes, bytearray)):
+            msg = f"corrupt data for block {bytes(expected_hash).hex()[:16]}"
+        else:
+            msg = str(expected_hash)
+        super().__init__(msg)
 
 
 class NoSuchBlock(GarageError):

@@ -1,0 +1,240 @@
+"""Block store tests: DataBlock codec, DataLayout, BlockManager RPC
+get/put on a real 3-node loopback cluster, refcounting, resync
+(missing-fetch and offload), and the batch-first scrub worker."""
+
+import asyncio
+import os
+
+import pytest
+
+from garage_tpu.block import (
+    BlockManager,
+    BlockResyncManager,
+    DataBlock,
+    DataLayout,
+    ScrubWorker,
+)
+from garage_tpu.block.layout import DRIVE_NPART, drive_partition
+from garage_tpu.block.repair import BlockStoreIterator, RebalanceWorker
+from garage_tpu.db import open_db
+from garage_tpu.rpc.replication_mode import parse_replication_mode
+from garage_tpu.table import TableShardedReplication
+from garage_tpu.utils.data import Hash, blake2s_sum, gen_uuid
+from garage_tpu.utils.error import CorruptData, GarageError
+
+from tests.test_table import make_cluster, shutdown
+
+pytestmark = pytest.mark.asyncio
+
+
+# --- DataBlock ---
+
+
+def test_datablock_plain_verify():
+    data = os.urandom(4096)
+    h = blake2s_sum(data)
+    b = DataBlock.plain(data)
+    b.verify(h)  # ok
+    with pytest.raises(CorruptData):
+        DataBlock.plain(data[:-1] + b"\x00").verify(h)
+
+
+def test_datablock_compression_roundtrip():
+    data = b"a" * 100_000  # compressible
+    b = DataBlock.from_buffer(data, compression_level=3)
+    assert b.compressed and len(b) < len(data)
+    assert b.decompressed() == data
+    b.verify(blake2s_sum(data))  # zstd checksum path
+    # corrupted frame fails
+    bad = DataBlock(b.inner[:-2] + b"\x00\x00", compressed=True)
+    with pytest.raises(CorruptData):
+        bad.verify(blake2s_sum(data))
+    # incompressible data stays plain
+    rnd = os.urandom(100_000)
+    assert not DataBlock.from_buffer(rnd, compression_level=3).compressed
+    assert not DataBlock.from_buffer(rnd, compression_level=None).compressed
+
+
+# --- DataLayout ---
+
+
+def test_data_layout_assignment(tmp_path):
+    d1, d2 = str(tmp_path / "d1"), str(tmp_path / "d2")
+    lay = DataLayout.initialize([{"path": d1, "capacity": 100}, {"path": d2, "capacity": 300}])
+    counts = [0, 0]
+    for p in lay.part_prim:
+        counts[p] += 1
+    assert sum(counts) == DRIVE_NPART
+    assert abs(counts[1] - 3 * counts[0]) <= 4  # ∝ capacity
+    # deterministic: same config → same assignment
+    lay2 = DataLayout.initialize([{"path": d1, "capacity": 100}, {"path": d2, "capacity": 300}])
+    assert lay.part_prim == lay2.part_prim
+    # update: moved partitions keep old dir as secondary
+    lay3 = lay.update([{"path": d1, "capacity": 300}, {"path": d2, "capacity": 100}])
+    moved = [p for p in range(DRIVE_NPART) if lay3.part_prim[p] != lay.part_prim[p]]
+    assert moved, "capacity flip must move partitions"
+    for p in moved:
+        assert lay.part_prim[p] in lay3.part_sec[p]
+    # persistence roundtrip
+    enc = lay3.encode()
+    assert DataLayout.decode(enc).part_prim == lay3.part_prim
+
+
+# --- cluster harness ---
+
+
+async def make_block_cluster(tmp_path, n=3, mode="3"):
+    systems = await make_cluster(tmp_path, n=n, mode=mode)
+    m = parse_replication_mode(mode)
+    managers = []
+    for i, s in enumerate(systems):
+        db = open_db("memory")
+        repl = TableShardedReplication(s, m.replication_factor, 1, m.write_quorum)
+        s.config.data_dir = [{"path": str(tmp_path / f"n{i}" / "data")}]
+        mgr = BlockManager(s.config, db, s, repl)
+        mgr.resync = BlockResyncManager(mgr, db)
+        managers.append(mgr)
+    return systems, managers
+
+
+async def test_block_put_get_roundtrip(tmp_path):
+    systems, managers = await make_block_cluster(tmp_path)
+    data = os.urandom(200_000)
+    h = blake2s_sum(data)
+    await managers[0].rpc_put_block(h, data)
+    await asyncio.sleep(0.1)  # straggler drain
+    stored = sum(1 for m in managers if m.is_block_present(h))
+    assert stored == 3
+    for m in managers:
+        got = await m.rpc_get_block(h)
+        assert got == data
+    await shutdown(systems)
+
+
+async def test_block_get_tries_other_nodes(tmp_path):
+    systems, managers = await make_block_cluster(tmp_path)
+    data = os.urandom(50_000)
+    h = blake2s_sum(data)
+    await managers[0].rpc_put_block(h, data)
+    await asyncio.sleep(0.1)
+    # delete the local copy on one node; its reads must hit the network
+    victim = managers[1]
+    found = victim.find_block(h)
+    if found:
+        os.remove(found[0])
+    got = await victim.rpc_get_block(h)
+    assert got == data
+    await shutdown(systems)
+
+
+async def test_corrupt_block_detected_and_requeued(tmp_path):
+    systems, managers = await make_block_cluster(tmp_path)
+    data = os.urandom(150_000)
+    h = blake2s_sum(data)
+    await managers[0].rpc_put_block(h, data)
+    await asyncio.sleep(0.1)
+    m = next(m for m in managers if m.is_block_present(h))
+    path, _ = m.find_block(h)
+    with open(path, "r+b") as f:
+        f.seek(100)
+        f.write(b"\xff\xff\xff\xff")
+    with pytest.raises(CorruptData):
+        await m.read_block(h)
+    assert not m.is_block_present(h)  # moved aside
+    assert os.path.exists(path + ".corrupted")
+    assert m.resync.queue_len() == 1  # requeued for re-fetch
+    await shutdown(systems)
+
+
+async def test_resync_fetches_missing_block(tmp_path):
+    systems, managers = await make_block_cluster(tmp_path)
+    data = os.urandom(80_000)
+    h = blake2s_sum(data)
+    await managers[0].rpc_put_block(h, data)
+    await asyncio.sleep(0.1)
+    victim = managers[1]
+    # mark needed (rc>0) then delete local file → resync must re-fetch
+    victim.db.transaction(lambda tx: victim.rc.block_incref(tx, h))
+    found = victim.find_block(h)
+    if found:
+        os.remove(found[0])
+    assert await victim.need_block(h)
+    await victim.resync.resync_block(h)
+    assert victim.is_block_present(h)
+    assert (await victim.rpc_get_block(h)) == data
+    await shutdown(systems)
+
+
+async def test_resync_offloads_and_deletes_unneeded(tmp_path):
+    systems, managers = await make_block_cluster(tmp_path)
+    data = os.urandom(60_000)
+    h = blake2s_sum(data)
+    # only node 0 has the block; rc=0 there (deletable immediately)
+    await managers[0].write_block(h, DataBlock.plain(data))
+    import garage_tpu.block.rc as rc_mod
+
+    # force the deletion timer into the past
+    managers[0].rc.tree.insert(
+        bytes(h), rc_mod.pack([0, 1])
+    )
+    # other replicas need it: rc>0, no file
+    for m in managers[1:]:
+        m.db.transaction(lambda tx, m=m: m.rc.block_incref(tx, h))
+    await managers[0].resync.resync_block(h)
+    await asyncio.sleep(0.1)
+    assert not managers[0].is_block_present(h)  # deleted locally
+    for m in managers[1:]:
+        if bytes(m.system.id) in [bytes(x) for x in managers[0].replication.write_nodes(h)]:
+            assert m.is_block_present(h)
+    await shutdown(systems)
+
+
+# --- scrub ---
+
+
+async def test_scrub_batch_detects_corruption(tmp_path):
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    datas = [os.urandom(30_000) for _ in range(20)]
+    hashes = [blake2s_sum(d) for d in datas]
+    for h, d in zip(hashes, datas):
+        await m.write_block(h, DataBlock.plain(d))
+    # corrupt 3 of them on disk
+    for h in hashes[:3]:
+        path, _ = m.find_block(h)
+        with open(path, "r+b") as f:
+            f.seek(10)
+            f.write(b"\x00\x01\x02\x03")
+    scrub = ScrubWorker(m)
+    scrub.send_command("start")
+    while (await scrub.work()).name in ("BUSY", "THROTTLED"):
+        pass
+    assert scrub.state.corruptions == 3
+    assert m.resync.queue_len() == 3
+    present = sum(1 for h in hashes if m.is_block_present(h))
+    assert present == 17
+    await shutdown(systems)
+
+
+async def test_block_store_iterator_resumable(tmp_path):
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    hashes = []
+    for _ in range(30):
+        d = os.urandom(1000)
+        h = blake2s_sum(d)
+        hashes.append(h)
+        await m.write_block(h, DataBlock.plain(d))
+    roots = [dd.path for dd in m.data_layout.data_dirs]
+    it = BlockStoreIterator(roots)
+    seen = []
+    # stop midway, then resume from the persisted position
+    while len(seen) < 15:
+        batch = it.next_prefix()
+        assert batch is not None
+        seen.extend(h for h, _p, _c in batch)
+    it2 = BlockStoreIterator(roots, position=it.position)
+    while (batch := it2.next_prefix()) is not None:
+        seen.extend(h for h, _p, _c in batch)
+    assert sorted(bytes(h) for h in seen) == sorted(bytes(h) for h in hashes)
+    await shutdown(systems)
