@@ -1,0 +1,188 @@
+"""Garage — the god object wiring every subsystem of one node.
+
+Equivalent of reference src/model/garage.rs:36-379 (SURVEY.md §2.6):
+opens the metadata DB engine, builds `System` (membership/ring/rpc), the
+three replication parameter sets (data: read quorum 1; meta: read+write
+quorums; control: full copy — garage.rs:231-248), the BlockManager +
+resync manager, and all replicated tables with their cross-table
+`updated()` hooks (object → version → block_ref → rc), then spawns all
+background workers (garage.rs:358-379).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional
+
+from ..block.manager import BlockManager
+from ..block.repair import RebalanceWorker, RepairWorker, ScrubWorker
+from ..block.resync import BlockResyncManager, ResyncWorker
+from ..db import Db, open_db
+from ..rpc.replication_mode import parse_replication_mode
+from ..rpc.system import System
+from ..table import (
+    InsertQueueWorker,
+    MerkleWorker,
+    Table,
+    TableFullReplication,
+    TableGc,
+    TableShardedReplication,
+    TableSyncer,
+)
+from ..table.gc import GcWorker
+from ..table.sync import SyncWorker
+from ..utils.background import BackgroundRunner, BgVars
+from ..utils.config import Config
+from .bucket_alias_table import BucketAliasTableSchema
+from .bucket_table import BucketTableSchema
+from .index_counter import IndexCounter, counter_table_schema
+from .key_table import KeyTableSchema
+from .s3.block_ref_table import BlockRefTableSchema
+from .s3.mpu_table import MpuTableSchema
+from .s3.object_table import ObjectTableSchema
+from .s3.version_table import VersionTableSchema
+
+logger = logging.getLogger("garage_tpu.model.garage")
+
+
+class Garage:
+    """ref model/garage.rs:36-77."""
+
+    def __init__(self, config: Config, db: Optional[Db] = None):
+        self.config = config
+        self.replication_mode = parse_replication_mode(config.replication_mode)
+
+        os.makedirs(config.metadata_dir, exist_ok=True)
+        self._owns_db = db is None
+        if db is not None:
+            self.db = db
+        else:
+            self.db = open_db(
+                config.db_engine,
+                path=os.path.join(config.metadata_dir, "db.sqlite"),
+            )
+
+        self.system = System(config, self.replication_mode)
+
+        factor = self.replication_mode.replication_factor
+        # ref garage.rs:231-248: data reads need only one copy (content-
+        # addressed, self-verifying); metadata reads/writes use quorums;
+        # control tables (buckets/keys/aliases) are fully replicated
+        self.data_rep = TableShardedReplication(
+            self.system, factor, 1, self.replication_mode.write_quorum
+        )
+        self.meta_rep = TableShardedReplication(
+            self.system,
+            factor,
+            self.replication_mode.read_quorum,
+            self.replication_mode.write_quorum,
+        )
+        self.control_rep = TableFullReplication(self.system)
+
+        self.block_manager = BlockManager(
+            config, self.db, self.system, self.data_rep
+        )
+        self.block_resync = BlockResyncManager(self.block_manager, self.db)
+        self.block_manager.resync = self.block_resync
+
+        # --- tables, wired bottom-up so hooks can reach lower tables ---
+        self.bucket_table = Table(
+            self.system, BucketTableSchema(), self.control_rep, self.db
+        )
+        self.bucket_alias_table = Table(
+            self.system, BucketAliasTableSchema(), self.control_rep, self.db
+        )
+        self.key_table = Table(
+            self.system, KeyTableSchema(), self.control_rep, self.db
+        )
+
+        self.object_counter_table = Table(
+            self.system,
+            counter_table_schema("bucket_object_counter"),
+            self.meta_rep,
+            self.db,
+        )
+        self.object_counter = IndexCounter(
+            self.system, self.object_counter_table, self.db
+        )
+        self.mpu_counter_table = Table(
+            self.system,
+            counter_table_schema("bucket_mpu_counter"),
+            self.meta_rep,
+            self.db,
+        )
+        self.mpu_counter = IndexCounter(
+            self.system, self.mpu_counter_table, self.db
+        )
+
+        block_ref_schema = BlockRefTableSchema(self.block_manager)
+        self.block_ref_table = Table(
+            self.system, block_ref_schema, self.meta_rep, self.db
+        )
+
+        version_schema = VersionTableSchema(self.block_ref_table)
+        self.version_table = Table(
+            self.system, version_schema, self.meta_rep, self.db
+        )
+
+        mpu_schema = MpuTableSchema(self.version_table, self.mpu_counter)
+        self.mpu_table = Table(self.system, mpu_schema, self.meta_rep, self.db)
+
+        object_schema = ObjectTableSchema(
+            self.version_table, self.mpu_table, self.object_counter
+        )
+        self.object_table = Table(
+            self.system, object_schema, self.meta_rep, self.db
+        )
+
+        self.tables: List[Table] = [
+            self.bucket_table,
+            self.bucket_alias_table,
+            self.key_table,
+            self.object_counter_table,
+            self.mpu_counter_table,
+            self.block_ref_table,
+            self.version_table,
+            self.mpu_table,
+            self.object_table,
+        ]
+
+        self.bg = BackgroundRunner()
+        self.bg_vars = BgVars()
+        self.scrub_worker: Optional[ScrubWorker] = None
+
+    # --- workers (ref garage.rs:358-379, block/manager.rs:192-227) ---
+
+    def spawn_workers(self) -> None:
+        for t in self.tables:
+            t.syncer = TableSyncer(self.system, t.data, t.merkle)
+            t.gc = TableGc(self.system, t.data)
+            self.bg.spawn(MerkleWorker(t.merkle))
+            self.bg.spawn(SyncWorker(t.syncer))
+            self.bg.spawn(GcWorker(t.gc))
+            self.bg.spawn(InsertQueueWorker(t))
+        n_resync = int(os.environ.get("GARAGE_TPU_RESYNC_WORKERS", "1"))
+        for i in range(n_resync):
+            self.bg.spawn(ResyncWorker(self.block_resync, index=i))
+        self.scrub_worker = ScrubWorker(self.block_manager)
+        self.bg.spawn(self.scrub_worker)
+        self.bg_vars.register_rw(
+            "resync-tranquility",
+            lambda: self.block_resync.tranquility,
+            lambda v: setattr(self.block_resync, "tranquility", int(v)),
+        )
+
+    def helper(self):
+        from .helper import GarageHelper
+
+        return GarageHelper(self)
+
+    async def run(self) -> None:
+        await self.system.run()
+
+    async def shutdown(self) -> None:
+        await self.bg.shutdown()
+        await self.system.shutdown()
+        if self._owns_db:
+            self.db.close()

@@ -1,0 +1,271 @@
+"""Object table — the S3 object metadata rows.
+
+Equivalent of reference src/model/s3/object_table.rs (SURVEY.md §2.6):
+an object row (P = bucket uuid, S = object key) holds a list of versions
+sorted by (timestamp, uuid); each version's state machine is
+Uploading{multipart, headers} → Complete(Inline | FirstBlock) | Aborted
+(object_table.rs:20-213).  The CRDT merge unions version lists, merges
+states pointwise (Aborted wins; Complete wins over Uploading), then prunes
+every version strictly older than the most recent Complete one
+(object_table.rs:324-355).  The transactional `updated()` hook propagates
+disappearing/aborted versions as Version-table tombstones and feeds the
+bucket object counters (object_table.rs:357-518).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...table.schema import Entry, TableSchema
+from ...utils.data import Uuid
+
+# counter names (ref object_table.rs:480-518)
+OBJECTS = "objects"
+UNFINISHED_UPLOADS = "unfinished_uploads"
+BYTES = "bytes"
+
+
+class ObjectVersionHeaders:
+    """Headers stored with a version: content-type + other meta headers
+    (ref object_table.rs ObjectVersionHeaders). Plain dict carrier."""
+
+    @staticmethod
+    def new(content_type: str = "application/octet-stream", other: Optional[Dict[str, str]] = None) -> Dict:
+        return {"content_type": content_type, "other": other or {}}
+
+
+class ObjectVersionMeta:
+    """{headers, size, etag} (ref object_table.rs:106-115). Dict carrier."""
+
+    @staticmethod
+    def new(headers: Dict, size: int, etag: str) -> Dict:
+        return {"headers": headers, "size": size, "etag": etag}
+
+
+class ObjectVersionData:
+    """Inline(meta, bytes) | FirstBlock(meta, hash) (object_table.rs:117-131)."""
+
+    @staticmethod
+    def inline(meta: Dict, data: bytes) -> List:
+        return ["inline", meta, data]
+
+    @staticmethod
+    def first_block(meta: Dict, hash32: bytes) -> List:
+        return ["first_block", meta, bytes(hash32)]
+
+
+class ObjectVersion:
+    """One version of an object (ref object_table.rs:85-213)."""
+
+    __slots__ = ("uuid", "timestamp", "state")
+
+    def __init__(self, uuid: Uuid, timestamp: int, state: List):
+        self.uuid = uuid
+        self.timestamp = timestamp
+        # state: ["uploading", multipart(bool), headers(dict)]
+        #      | ["complete", data]   | ["aborted"]
+        self.state = state
+
+    @staticmethod
+    def uploading(uuid: Uuid, timestamp: int, multipart: bool, headers: Dict) -> "ObjectVersion":
+        return ObjectVersion(uuid, timestamp, ["uploading", multipart, headers])
+
+    def sort_key_tuple(self) -> Tuple[int, bytes]:
+        # versions are ordered by (timestamp, uuid) (ref object_table.rs:189-198)
+        return (self.timestamp, bytes(self.uuid))
+
+    def is_uploading(self, check_multipart: Optional[bool] = None) -> bool:
+        return self.state[0] == "uploading" and (
+            check_multipart is None or bool(self.state[1]) == check_multipart
+        )
+
+    def is_aborted(self) -> bool:
+        return self.state[0] == "aborted"
+
+    def is_complete(self) -> bool:
+        return self.state[0] == "complete"
+
+    def is_data(self) -> bool:
+        """Has actual stored data (complete and not a delete marker)."""
+        return self.is_complete()
+
+    def data(self) -> Optional[List]:
+        return self.state[1] if self.is_complete() else None
+
+    def meta(self) -> Optional[Dict]:
+        d = self.data()
+        return d[1] if d is not None else None
+
+    def size(self) -> int:
+        m = self.meta()
+        return int(m["size"]) if m else 0
+
+    def etag(self) -> str:
+        m = self.meta()
+        return str(m["etag"]) if m else ""
+
+    def merge_state(self, other: "ObjectVersion") -> None:
+        """ref object_table.rs:133-160 ObjectVersionState::merge."""
+        a, b = self.state, other.state
+        if a[0] == "aborted":
+            return
+        if b[0] == "aborted":
+            self.state = ["aborted"]
+        elif b[0] == "complete" and a[0] == "uploading":
+            self.state = b
+        # complete+complete / uploading+uploading: deterministic content, keep
+
+    def pack(self) -> List:
+        return [bytes(self.uuid), self.timestamp, self.state]
+
+    @classmethod
+    def unpack(cls, v: List) -> "ObjectVersion":
+        st = list(v[2])
+        if st[0] == "complete":
+            d = list(st[1])
+            if d[0] == "inline":
+                st[1] = ["inline", dict(d[1]), bytes(d[2])]
+            else:
+                st[1] = ["first_block", dict(d[1]), bytes(d[2])]
+        return cls(Uuid(bytes(v[0])), int(v[1]), st)
+
+
+class Object(Entry):
+    """ref object_table.rs:20-83: P = bucket uuid, S = key."""
+
+    VERSION_MARKER = b"GT01object"
+
+    def __init__(self, bucket_id: Uuid, key: str, versions: Optional[List[ObjectVersion]] = None):
+        self.bucket_id = bucket_id
+        self.key = key
+        self._versions: List[ObjectVersion] = versions or []
+        self._versions.sort(key=lambda v: v.sort_key_tuple())
+
+    @property
+    def partition_key(self) -> Uuid:
+        return self.bucket_id
+
+    @property
+    def sort_key(self) -> str:
+        return self.key
+
+    def versions(self) -> List[ObjectVersion]:
+        return self._versions
+
+    def add_version(self, v: ObjectVersion) -> None:
+        """Insert preserving (timestamp, uuid) order; merge state if the
+        same uuid already exists (ref object_table.rs:60-77)."""
+        for mine in self._versions:
+            if mine.uuid == v.uuid:
+                mine.merge_state(v)
+                return
+        keys = [x.sort_key_tuple() for x in self._versions]
+        self._versions.insert(bisect.bisect_left(keys, v.sort_key_tuple()), v)
+
+    def last_complete_version(self) -> Optional[ObjectVersion]:
+        for v in reversed(self._versions):
+            if v.is_complete():
+                return v
+        return None
+
+    def is_tombstone(self) -> bool:
+        # an object row with no versions (or only aborted ones that will be
+        # pruned) never happens post-merge; a row whose only complete data
+        # is absent and has no uploads is still kept (delete is modeled by
+        # pruning to zero versions — ref object_table.rs is_tombstone)
+        return len(self._versions) == 0
+
+    def merge(self, other: "Object") -> None:
+        """ref object_table.rs:324-355."""
+        for v in other._versions:
+            self.add_version(v)
+        # prune: drop everything strictly older than the last complete
+        last_complete_i = None
+        for i in range(len(self._versions) - 1, -1, -1):
+            if self._versions[i].is_complete():
+                last_complete_i = i
+                break
+        if last_complete_i is not None:
+            self._versions = self._versions[last_complete_i:]
+        # aborted versions are kept only while nothing newer is complete
+        # (they still need to propagate); merge of two aborted-only lists
+        # keeps them all, which is fine — they carry no data
+
+    def counts(self) -> List[Tuple[str, int]]:
+        """Counter contributions of this row (ref object_table.rs:480-518)."""
+        last = self.last_complete_version()
+        objects = 1 if last is not None else 0
+        nbytes = last.size() if last is not None else 0
+        unfinished = sum(1 for v in self._versions if v.is_uploading())
+        return [(OBJECTS, objects), (BYTES, nbytes), (UNFINISHED_UPLOADS, unfinished)]
+
+    def fields(self) -> Any:
+        return [bytes(self.bucket_id), self.key, [v.pack() for v in self._versions]]
+
+    @classmethod
+    def from_fields(cls, b: Any) -> "Object":
+        return cls(
+            Uuid(bytes(b[0])), b[1], [ObjectVersion.unpack(v) for v in b[2]]
+        )
+
+
+class ObjectTableSchema(TableSchema):
+    """ref object_table.rs:357-478 — the updated() hook chain start."""
+
+    TABLE_NAME = "object"
+    ENTRY = Object
+
+    def __init__(self, version_table=None, mpu_table=None, counter=None):
+        # set post-construction by Garage (circular wiring)
+        self.version_table = version_table
+        self.mpu_table = mpu_table
+        self.counter = counter
+
+    def updated(self, tx, old: Optional[Object], new: Optional[Object]) -> None:
+        from .version_table import Version
+
+        if self.counter is not None:
+            # counters aggregate per bucket (CP = bucket id, CS = empty —
+            # ref object_table.rs CountedItem impl)
+            self.counter.count(
+                tx,
+                bytes((old or new).bucket_id),
+                "",
+                old.counts() if old is not None else [],
+                new.counts() if new is not None else [],
+            )
+        if old is None:
+            return
+        new_by_uuid = (
+            {bytes(v.uuid): v for v in new.versions()} if new is not None else {}
+        )
+        for ov in old.versions():
+            nv = new_by_uuid.get(bytes(ov.uuid))
+            # a version that was active and is now gone or aborted must be
+            # deleted from the version table (object_table.rs:420-460)
+            became_deleted = (nv is None and not ov.is_aborted()) or (
+                nv is not None and nv.is_aborted() and not ov.is_aborted()
+            )
+            if not became_deleted:
+                continue
+            if ov.is_uploading(check_multipart=True):
+                # multipart: ov.uuid is the *upload id*; tombstone the MPU
+                # row, whose own hook tombstones every part version
+                # (ref object_table.rs routes multipart versions to MPU)
+                if self.mpu_table is not None:
+                    from .mpu_table import MultipartUpload
+
+                    mdel = MultipartUpload(
+                        ov.uuid, ov.timestamp, bytes(old.bucket_id),
+                        old.key, deleted=True,
+                    )
+                    self.mpu_table.data.queue_insert(tx, mdel)
+            elif self.version_table is not None:
+                vdel = Version.new(ov.uuid, bytes(old.bucket_id), old.key, deleted=True)
+                self.version_table.data.queue_insert(tx, vdel)
+
+    def matches_filter(self, entry: Object, filter: Any) -> bool:
+        if filter is None:
+            return entry.last_complete_version() is not None
+        return True
