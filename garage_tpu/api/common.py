@@ -1,0 +1,142 @@
+"""Shared API error model + helpers.
+
+Equivalent of reference src/api/common_error.rs + helpers.rs + encoding.rs
+(SURVEY.md §2.7): a typed error enum rendered uniformly to S3-style XML
+error bodies, host→bucket parsing for vhost-style requests, and URI
+encoding helpers.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import Dict, Optional, Tuple
+
+from ..utils.error import GarageError
+
+
+class ApiError(GarageError):
+    status = 500
+    code = "InternalError"
+
+    def __init__(self, message: str = "", status: Optional[int] = None,
+                 code: Optional[str] = None):
+        super().__init__(message)
+        if status is not None:
+            self.status = status
+        if code is not None:
+            self.code = code
+        self.message = message
+
+
+class NoSuchBucketError(ApiError):
+    status = 404
+    code = "NoSuchBucket"
+
+
+class NoSuchKeyError(ApiError):
+    status = 404
+    code = "NoSuchKey"
+
+
+class NoSuchUploadError(ApiError):
+    status = 404
+    code = "NoSuchUpload"
+
+
+class BucketNotEmptyError(ApiError):
+    status = 409
+    code = "BucketNotEmpty"
+
+
+class BucketAlreadyExistsError(ApiError):
+    status = 409
+    code = "BucketAlreadyExists"
+
+
+class AccessDeniedError(ApiError):
+    status = 403
+    code = "AccessDenied"
+
+
+class BadRequestError(ApiError):
+    status = 400
+    code = "InvalidRequest"
+
+
+class EntityTooSmallError(ApiError):
+    status = 400
+    code = "EntityTooSmall"
+
+
+class InvalidPartError(ApiError):
+    status = 400
+    code = "InvalidPart"
+
+
+class PreconditionFailedError(ApiError):
+    status = 412
+    code = "PreconditionFailed"
+
+
+class InvalidRangeError(ApiError):
+    status = 416
+    code = "InvalidRange"
+
+
+class NotImplementedError_(ApiError):
+    status = 501
+    code = "NotImplemented"
+
+
+def error_xml(err: Exception, resource: str = "", request_id: str = "") -> bytes:
+    """S3 error body (ref common_error.rs rendering)."""
+    code = getattr(err, "code", "InternalError")
+    root = ET.Element("Error")
+    ET.SubElement(root, "Code").text = code
+    ET.SubElement(root, "Message").text = str(err)
+    ET.SubElement(root, "Resource").text = resource
+    ET.SubElement(root, "RequestId").text = request_id
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+
+
+def xml_to_bytes(root: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+
+
+S3_XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def s3_xml_root(tag: str) -> ET.Element:
+    return ET.Element(tag, {"xmlns": S3_XMLNS})
+
+
+def host_to_bucket(host: str, root_domain: Optional[str]) -> Optional[str]:
+    """vhost-style bucket extraction (ref helpers.rs host_to_bucket):
+    `bucket.root_domain` → bucket; bare root_domain or unrelated host →
+    None (path-style)."""
+    if root_domain is None:
+        return None
+    host = host.split(":")[0].lower()
+    rd = root_domain.lstrip(".").lower()
+    if host == rd:
+        return None
+    suffix = "." + rd
+    if host.endswith(suffix):
+        return host[: -len(suffix)]
+    return None
+
+
+def parse_bucket_key(path: str, vhost_bucket: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    """(bucket, key) from the URI path (ref api_server.rs:79-103).
+    Key of "" (trailing slash) is a valid S3 key distinct from None."""
+    path = urllib.parse.unquote(path)
+    if not path.startswith("/"):
+        path = "/" + path
+    if vhost_bucket is not None:
+        key = path[1:]
+        return vhost_bucket, (key if key != "" else None)
+    parts = path[1:].split("/", 1)
+    bucket = parts[0] if parts[0] != "" else None
+    key = parts[1] if len(parts) > 1 else None
+    return bucket, key

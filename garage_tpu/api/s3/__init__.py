@@ -1,0 +1,1 @@
+"""S3-compatible API (ref src/api/s3/)."""

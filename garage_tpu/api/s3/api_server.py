@@ -1,0 +1,276 @@
+"""S3 API server — request routing, auth, and dispatch.
+
+Equivalent of reference src/api/s3/api_server.rs + generic_server.rs
+(SURVEY.md §2.7): an aiohttp server (the hyper analogue) that parses
+vhost- or path-style bucket addressing, verifies the SigV4 signature
+against the key table, resolves the bucket and checks the endpoint's
+required permission level, then dispatches to the per-endpoint handler.
+Errors render as S3 XML bodies (generic_server.rs:165-266).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ...model.helper import (
+    BucketAlreadyExists,
+    BucketNotEmpty,
+    NoSuchBucket,
+    NoSuchKey,
+)
+from ...utils.data import gen_uuid
+from ..common import (
+    AccessDeniedError,
+    ApiError,
+    BadRequestError,
+    BucketAlreadyExistsError,
+    BucketNotEmptyError,
+    NoSuchBucketError,
+    error_xml,
+    host_to_bucket,
+    parse_bucket_key,
+)
+from ..signature import (
+    AuthError,
+    GarageError,
+    InvalidRequest,
+    check_signature,
+)
+from .router import NONE, OWNER, READ, WRITE, parse_endpoint
+
+logger = logging.getLogger("garage_tpu.api.s3")
+
+
+class S3ApiServer:
+    def __init__(self, garage):
+        self.garage = garage
+        self.helper = garage.helper()
+        self.region = garage.config.s3_region
+        self.root_domain = garage.config.root_domain
+        self._runner: Optional[web.AppRunner] = None
+        # metrics (ref generic_server.rs:63-95)
+        self.request_counter = 0
+        self.error_counter = 0
+
+    # --- server lifecycle ---
+
+    async def start(self, bind_addr: str) -> None:
+        app = web.Application(client_max_size=1024**4)
+        app.router.add_route("*", "/{tail:.*}", self.handle_request)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        host, port = bind_addr.rsplit(":", 1)
+        self._site = web.TCPSite(self._runner, host, int(port))
+        await self._site.start()
+        logger.info("S3 API listening on %s", bind_addr)
+
+    @property
+    def port(self) -> int:
+        return self._site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # --- request handling (ref generic_server.rs:165-266) ---
+
+    async def handle_request(self, request: web.Request) -> web.StreamResponse:
+        self.request_counter += 1
+        try:
+            return await self._handle(request)
+        except (ApiError, GarageError, NoSuchBucket, NoSuchKey) as e:
+            self.error_counter += 1
+            status = getattr(e, "status", 500)
+            if status >= 500:
+                logger.exception("S3 API internal error")
+            else:
+                logger.debug("S3 API error %s: %s", status, e)
+            return web.Response(
+                status=status,
+                body=error_xml(e, request.path, bytes(gen_uuid()).hex()[:16]),
+                content_type="application/xml",
+            )
+        except Exception as e:  # noqa: BLE001 — uniform 500 rendering
+            self.error_counter += 1
+            logger.exception("S3 API unexpected error")
+            return web.Response(
+                status=500,
+                body=error_xml(e, request.path, ""),
+                content_type="application/xml",
+            )
+
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        vhost_bucket = host_to_bucket(headers.get("host", ""), self.root_domain)
+        # bucket/key come from the RAW (still-encoded) path, decoded exactly
+        # once in parse_bucket_key; request.path is already decoded and
+        # would double-decode keys containing %XX sequences
+        bucket_name, key_name = parse_bucket_key(
+            request.rel_url.raw_path, vhost_bucket
+        )
+        query = [(k, v) for k, v in request.query.items()]
+        endpoint = parse_endpoint(
+            request.method, bucket_name, key_name, query, headers
+        )
+
+        # authentication (ref api_server.rs:105-130 + signature/)
+        async def get_key(key_id: str):
+            k = await self.garage.key_table.get(key_id, "")
+            if k is None or k.is_deleted():
+                return None
+            return k
+
+        verified = await check_signature(
+            get_key, self.region, request.method, request.path, query, headers
+        )
+        api_key = verified.key
+
+        ctx = RequestContext(
+            self, request, verified, endpoint, bucket_name, key_name
+        )
+
+        try:
+            return await self._dispatch(ctx, endpoint, bucket_name, api_key)
+        except BucketAlreadyExists as e:
+            raise BucketAlreadyExistsError(str(e))
+        except BucketNotEmpty as e:
+            raise BucketNotEmptyError(str(e))
+        except NoSuchBucket as e:
+            raise NoSuchBucketError(str(e))
+
+    async def _dispatch(self, ctx, endpoint, bucket_name, api_key):
+        from . import bucket as bucket_ops
+        from . import delete as delete_ops
+        from . import get as get_ops
+        from . import list as list_ops
+        from . import multipart as multipart_ops
+        from . import put as put_ops
+
+        if endpoint.name == "ListBuckets":
+            return await bucket_ops.handle_list_buckets(ctx)
+        if endpoint.name == "CreateBucket":
+            return await bucket_ops.handle_create_bucket(ctx)
+
+        # all other endpoints address an existing bucket
+        bucket_id = await self.helper.resolve_bucket(bucket_name, api_key)
+        bucket = await self.helper.get_existing_bucket(bucket_id)
+        ctx.bucket_id, ctx.bucket = bucket_id, bucket
+
+        allowed = {
+            READ: api_key.allow_read(bucket_id),
+            WRITE: api_key.allow_write(bucket_id),
+            OWNER: api_key.allow_owner(bucket_id),
+            NONE: True,
+        }[endpoint.authorization]
+        if not allowed:
+            raise AccessDeniedError(
+                f"key {api_key.key_id} lacks {endpoint.authorization} on bucket"
+            )
+
+        h = {
+            "HeadBucket": bucket_ops.handle_head_bucket,
+            "DeleteBucket": bucket_ops.handle_delete_bucket,
+            "GetBucketLocation": bucket_ops.handle_get_location,
+            "GetBucketVersioning": bucket_ops.handle_get_versioning,
+            "GetBucketAcl": bucket_ops.handle_get_acl,
+            "ListObjects": list_ops.handle_list_objects,
+            "ListObjectsV2": list_ops.handle_list_objects_v2,
+            "ListMultipartUploads": list_ops.handle_list_multipart_uploads,
+            "ListParts": list_ops.handle_list_parts,
+            "PutObject": put_ops.handle_put_object,
+            "GetObject": get_ops.handle_get_object,
+            "HeadObject": get_ops.handle_head_object,
+            "DeleteObject": delete_ops.handle_delete_object,
+            "DeleteObjects": delete_ops.handle_delete_objects,
+            "CreateMultipartUpload": multipart_ops.handle_create_mpu,
+            "UploadPart": multipart_ops.handle_upload_part,
+            "CompleteMultipartUpload": multipart_ops.handle_complete_mpu,
+            "AbortMultipartUpload": multipart_ops.handle_abort_mpu,
+            "CopyObject": None,
+            "UploadPartCopy": None,
+        }.get(endpoint.name)
+        if h is None:
+            if endpoint.name in ("CopyObject", "UploadPartCopy"):
+                from . import copy as copy_ops
+
+                h = (
+                    copy_ops.handle_copy_object
+                    if endpoint.name == "CopyObject"
+                    else copy_ops.handle_upload_part_copy
+                )
+            else:
+                from . import bucket_config
+
+                h = bucket_config.HANDLERS.get(endpoint.name)
+        if h is None:
+            raise BadRequestError(f"endpoint {endpoint.name} not implemented")
+        return await h(ctx)
+
+
+class RequestContext:
+    """Per-request state handed to endpoint handlers."""
+
+    __slots__ = (
+        "server", "request", "verified", "endpoint",
+        "bucket_name", "key_name", "bucket_id", "bucket",
+    )
+
+    def __init__(self, server, request, verified, endpoint, bucket_name, key_name):
+        self.server = server
+        self.request = request
+        self.verified = verified
+        self.endpoint = endpoint
+        self.bucket_name = bucket_name
+        self.key_name = key_name
+        self.bucket_id = None
+        self.bucket = None
+
+    @property
+    def garage(self):
+        return self.server.garage
+
+    @property
+    def api_key(self):
+        return self.verified.key
+
+    async def read_body_verified(self) -> bytes:
+        """Read the whole body and check it against the signed
+        x-amz-content-sha256 (ref signature verify_signed_content) —
+        required for XML-body endpoints so a tampered body can't ride a
+        valid header signature."""
+        import hashlib
+
+        body = await self.request.read()
+        sha = self.verified.content_sha256
+        if sha not in (None, "STREAMING"):
+            if hashlib.sha256(body).hexdigest() != sha:
+                from ..common import ApiError
+
+                raise ApiError(
+                    "body does not match signed x-amz-content-sha256",
+                    status=403, code="SignatureDoesNotMatch",
+                )
+        return body
+
+    def body_stream(self):
+        """The (possibly chunk-signed) request body as an async byte
+        iterator (ref signature/streaming.rs wrapping)."""
+        from ..signature import decode_streaming_body
+
+        async def raw():
+            async for chunk in self.request.content.iter_any():
+                yield chunk
+
+        if self.verified.content_sha256 == "STREAMING":
+            return decode_streaming_body(
+                raw(),
+                self.api_key.params().secret_key,
+                self.verified.credential,
+                self.verified.signature,
+                self.verified.timestamp,
+            )
+        return raw()

@@ -1,0 +1,242 @@
+"""Bucket configuration endpoints: website, CORS, lifecycle.
+
+Equivalent of reference src/api/s3/website.rs + cors.rs + lifecycle.rs
+(SURVEY.md §2.7): XML get/put/delete of per-bucket configs stored as LWW
+CRDTs in the bucket params, plus `find_matching_cors_rule` used by both
+the S3 server and the static web server.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+from ..common import ApiError, BadRequestError, s3_xml_root, xml_to_bytes
+
+
+def _ns(root) -> str:
+    return root.tag[: root.tag.index("}") + 1] if root.tag.startswith("{") else ""
+
+
+async def _update_bucket(ctx, mutate) -> None:
+    bucket = await ctx.server.helper.get_existing_bucket(ctx.bucket_id)
+    mutate(bucket.params())
+    await ctx.garage.bucket_table.insert(bucket)
+
+
+# --- website ---------------------------------------------------------------
+
+
+async def handle_get_website(ctx) -> web.Response:
+    wc = ctx.bucket.params().website_config.value
+    if wc is None:
+        raise ApiError(
+            "no website configuration", status=404,
+            code="NoSuchWebsiteConfiguration",
+        )
+    out = s3_xml_root("WebsiteConfiguration")
+    idx = ET.SubElement(out, "IndexDocument")
+    ET.SubElement(idx, "Suffix").text = wc.get("index_document", "index.html")
+    if wc.get("error_document"):
+        err = ET.SubElement(out, "ErrorDocument")
+        ET.SubElement(err, "Key").text = wc["error_document"]
+    return web.Response(status=200, body=xml_to_bytes(out), content_type="application/xml")
+
+
+async def handle_put_website(ctx) -> web.Response:
+    body = await ctx.read_body_verified()
+    try:
+        root = ET.fromstring(body.decode())
+    except ET.ParseError as e:
+        raise BadRequestError(f"malformed WebsiteConfiguration: {e}")
+    ns = _ns(root)
+    suffix = root.findtext(f"{ns}IndexDocument/{ns}Suffix")
+    if suffix is None:
+        raise BadRequestError("IndexDocument.Suffix is required")
+    error_doc = root.findtext(f"{ns}ErrorDocument/{ns}Key")
+    wc = {"index_document": suffix, "error_document": error_doc}
+    await _update_bucket(ctx, lambda p: p.website_config.update(wc))
+    return web.Response(status=200)
+
+
+async def handle_delete_website(ctx) -> web.Response:
+    await _update_bucket(ctx, lambda p: p.website_config.update(None))
+    return web.Response(status=204)
+
+
+# --- CORS ------------------------------------------------------------------
+
+
+async def handle_get_cors(ctx) -> web.Response:
+    rules = ctx.bucket.params().cors_config.value
+    if rules is None:
+        raise ApiError(
+            "no CORS configuration", status=404, code="NoSuchCORSConfiguration"
+        )
+    out = s3_xml_root("CORSConfiguration")
+    for r in rules:
+        el = ET.SubElement(out, "CORSRule")
+        if r.get("id"):
+            ET.SubElement(el, "ID").text = r["id"]
+        for o in r.get("allow_origins", []):
+            ET.SubElement(el, "AllowedOrigin").text = o
+        for m in r.get("allow_methods", []):
+            ET.SubElement(el, "AllowedMethod").text = m
+        for hh in r.get("allow_headers", []):
+            ET.SubElement(el, "AllowedHeader").text = hh
+        for e in r.get("expose_headers", []):
+            ET.SubElement(el, "ExposeHeader").text = e
+        if r.get("max_age_seconds") is not None:
+            ET.SubElement(el, "MaxAgeSeconds").text = str(r["max_age_seconds"])
+    return web.Response(status=200, body=xml_to_bytes(out), content_type="application/xml")
+
+
+async def handle_put_cors(ctx) -> web.Response:
+    body = await ctx.read_body_verified()
+    try:
+        root = ET.fromstring(body.decode())
+    except ET.ParseError as e:
+        raise BadRequestError(f"malformed CORSConfiguration: {e}")
+    ns = _ns(root)
+    rules = []
+    for el in root.findall(f"{ns}CORSRule"):
+        rule = {
+            "id": el.findtext(f"{ns}ID"),
+            "allow_origins": [x.text or "" for x in el.findall(f"{ns}AllowedOrigin")],
+            "allow_methods": [x.text or "" for x in el.findall(f"{ns}AllowedMethod")],
+            "allow_headers": [x.text or "" for x in el.findall(f"{ns}AllowedHeader")],
+            "expose_headers": [x.text or "" for x in el.findall(f"{ns}ExposeHeader")],
+        }
+        ma = el.findtext(f"{ns}MaxAgeSeconds")
+        rule["max_age_seconds"] = int(ma) if ma is not None else None
+        rules.append(rule)
+    await _update_bucket(ctx, lambda p: p.cors_config.update(rules))
+    return web.Response(status=200)
+
+
+async def handle_delete_cors(ctx) -> web.Response:
+    await _update_bucket(ctx, lambda p: p.cors_config.update(None))
+    return web.Response(status=204)
+
+
+def find_matching_cors_rule(
+    rules: Optional[List[Dict]], method: str, origin: Optional[str],
+    request_headers: List[str],
+) -> Optional[Dict]:
+    """ref cors.rs find_matching_cors_rule."""
+    if not rules or origin is None:
+        return None
+    for r in rules:
+        if method not in r.get("allow_methods", []) and "*" not in r.get("allow_methods", []):
+            continue
+        origins = r.get("allow_origins", [])
+        ok = any(
+            o == "*" or o == origin
+            or (o.count("*") == 1 and _glob_match(o, origin))
+            for o in origins
+        )
+        if not ok:
+            continue
+        allowed = [h.lower() for h in r.get("allow_headers", [])]
+        if "*" not in allowed and any(h.lower() not in allowed for h in request_headers):
+            continue
+        return r
+    return None
+
+
+def _glob_match(pattern: str, s: str) -> bool:
+    pre, _, post = pattern.partition("*")
+    return s.startswith(pre) and s.endswith(post) and len(s) >= len(pre) + len(post)
+
+
+def apply_cors_headers(resp_headers: Dict[str, str], rule: Dict, origin: str) -> None:
+    resp_headers["Access-Control-Allow-Origin"] = (
+        "*" if "*" in rule.get("allow_origins", []) else origin
+    )
+    if rule.get("expose_headers"):
+        resp_headers["Access-Control-Expose-Headers"] = ", ".join(rule["expose_headers"])
+
+
+# --- lifecycle -------------------------------------------------------------
+
+
+async def handle_get_lifecycle(ctx) -> web.Response:
+    rules = ctx.bucket.params().lifecycle_config.value
+    if rules is None:
+        raise ApiError(
+            "no lifecycle configuration", status=404,
+            code="NoSuchLifecycleConfiguration",
+        )
+    out = s3_xml_root("LifecycleConfiguration")
+    for r in rules:
+        el = ET.SubElement(out, "Rule")
+        if r.get("id"):
+            ET.SubElement(el, "ID").text = r["id"]
+        ET.SubElement(el, "Status").text = "Enabled" if r.get("enabled", True) else "Disabled"
+        f = ET.SubElement(el, "Filter")
+        if r.get("prefix"):
+            ET.SubElement(f, "Prefix").text = r["prefix"]
+        if r.get("expiration_days") is not None or r.get("expiration_date"):
+            ex = ET.SubElement(el, "Expiration")
+            if r.get("expiration_days") is not None:
+                ET.SubElement(ex, "Days").text = str(r["expiration_days"])
+            if r.get("expiration_date"):
+                ET.SubElement(ex, "Date").text = r["expiration_date"]
+        if r.get("abort_incomplete_days") is not None:
+            ab = ET.SubElement(el, "AbortIncompleteMultipartUpload")
+            ET.SubElement(ab, "DaysAfterInitiation").text = str(r["abort_incomplete_days"])
+    return web.Response(status=200, body=xml_to_bytes(out), content_type="application/xml")
+
+
+async def handle_put_lifecycle(ctx) -> web.Response:
+    body = await ctx.read_body_verified()
+    try:
+        root = ET.fromstring(body.decode())
+    except ET.ParseError as e:
+        raise BadRequestError(f"malformed LifecycleConfiguration: {e}")
+    ns = _ns(root)
+    rules = []
+    for el in root.findall(f"{ns}Rule"):
+        status = el.findtext(f"{ns}Status") or "Enabled"
+        prefix = (
+            el.findtext(f"{ns}Filter/{ns}Prefix")
+            or el.findtext(f"{ns}Prefix")  # legacy top-level form
+            or ""
+        )
+        days = el.findtext(f"{ns}Expiration/{ns}Days")
+        date = el.findtext(f"{ns}Expiration/{ns}Date")
+        abort_days = el.findtext(
+            f"{ns}AbortIncompleteMultipartUpload/{ns}DaysAfterInitiation"
+        )
+        if days is not None and int(days) <= 0:
+            raise BadRequestError("Expiration Days must be positive")
+        rules.append({
+            "id": el.findtext(f"{ns}ID"),
+            "enabled": status == "Enabled",
+            "prefix": prefix,
+            "expiration_days": int(days) if days is not None else None,
+            "expiration_date": date,
+            "abort_incomplete_days": int(abort_days) if abort_days is not None else None,
+        })
+    await _update_bucket(ctx, lambda p: p.lifecycle_config.update(rules))
+    return web.Response(status=200)
+
+
+async def handle_delete_lifecycle(ctx) -> web.Response:
+    await _update_bucket(ctx, lambda p: p.lifecycle_config.update(None))
+    return web.Response(status=204)
+
+
+HANDLERS = {
+    "GetBucketWebsite": handle_get_website,
+    "PutBucketWebsite": handle_put_website,
+    "DeleteBucketWebsite": handle_delete_website,
+    "GetBucketCors": handle_get_cors,
+    "PutBucketCors": handle_put_cors,
+    "DeleteBucketCors": handle_delete_cors,
+    "GetBucketLifecycle": handle_get_lifecycle,
+    "PutBucketLifecycle": handle_put_lifecycle,
+    "DeleteBucketLifecycle": handle_delete_lifecycle,
+}

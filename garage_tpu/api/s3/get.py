@@ -1,0 +1,242 @@
+"""GetObject / HeadObject — the S3 read path.
+
+Equivalent of reference src/api/s3/get.rs (SURVEY.md §3.3): quorum read
+of the object row, conditional headers (If-None-Match / If-Modified-Since
+→ 304, get.rs:27-89), range and partNumber reads touching only the
+intersecting blocks (get.rs:432-512), and a streaming body assembled from
+per-block RPC streams with order tags and prefetch of the next block.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import email.utils
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+from ...utils.data import Hash, Uuid
+from ..common import (
+    ApiError,
+    BadRequestError,
+    InvalidRangeError,
+    NoSuchKeyError,
+    PreconditionFailedError,
+)
+
+PREFETCH = 2  # buffered(2) block prefetch (ref get.rs:458-466)
+
+
+async def get_object_version(ctx, key: str):
+    """Object row → newest complete data version, else NoSuchKey."""
+    obj = await ctx.garage.object_table.get(ctx.bucket_id, key)
+    if obj is None:
+        raise NoSuchKeyError(f"no such key: {key}")
+    last = obj.last_complete_version()
+    if last is None or not last.is_data():
+        raise NoSuchKeyError(f"no such key: {key}")
+    return obj, last
+
+
+def object_headers(version, meta: Dict) -> Dict[str, str]:
+    """Response headers from stored meta (ref get.rs:60-90)."""
+    hdrs = {
+        "Content-Type": meta["headers"].get("content_type", "application/octet-stream"),
+        "ETag": f'"{meta["etag"]}"',
+        "Last-Modified": email.utils.formatdate(version.timestamp / 1000, usegmt=True),
+        "Accept-Ranges": "bytes",
+        "x-amz-version-id": bytes(version.uuid).hex(),
+    }
+    for k, v in meta["headers"].get("other", {}).items():
+        hdrs[k] = v
+    return hdrs
+
+
+def check_conditions(ctx, version, meta) -> Optional[int]:
+    """Conditional request handling; returns an HTTP status to short-
+    circuit with, or None (ref get.rs:27-58 try_answer_cached)."""
+    req = ctx.request
+    etag = f'"{meta["etag"]}"'
+    inm = req.headers.get("If-None-Match")
+    if inm is not None:
+        tags = [t.strip() for t in inm.split(",")]
+        if etag in tags or "*" in tags:
+            return 304
+    ims = req.headers.get("If-Modified-Since")
+    if ims is not None and inm is None:
+        t = email.utils.parsedate_to_datetime(ims)
+        if t is not None and version.timestamp / 1000 <= t.timestamp():
+            return 304
+    im = req.headers.get("If-Match")
+    if im is not None:
+        tags = [t.strip() for t in im.split(",")]
+        if etag not in tags and "*" not in tags:
+            raise PreconditionFailedError("If-Match failed")
+    ius = req.headers.get("If-Unmodified-Since")
+    if ius is not None:
+        t = email.utils.parsedate_to_datetime(ius)
+        if t is not None and version.timestamp / 1000 > t.timestamp():
+            raise PreconditionFailedError("If-Unmodified-Since failed")
+    return None
+
+
+def parse_range(header: str, size: int) -> Tuple[int, int]:
+    """'bytes=a-b' → (begin, end_exclusive) (ref get.rs range parsing)."""
+    if not header.startswith("bytes="):
+        raise InvalidRangeError(f"unsupported range unit: {header}")
+    spec = header[len("bytes="):]
+    if "," in spec:
+        raise InvalidRangeError("multiple ranges not supported")
+    a, _, b = spec.partition("-")
+    if a == "":
+        # suffix range: last N bytes
+        n = int(b)
+        if n == 0:
+            raise InvalidRangeError("zero suffix range")
+        return max(0, size - n), size
+    begin = int(a)
+    end = int(b) + 1 if b != "" else size
+    if begin >= size or end > size or begin >= end:
+        raise InvalidRangeError(f"range {header} out of bounds for size {size}")
+    return begin, end
+
+
+async def handle_head_object(ctx) -> web.Response:
+    _obj, version = await get_object_version(ctx, ctx.key_name)
+    meta = version.meta()
+    status = check_conditions(ctx, version, meta)
+    if status is not None:
+        return web.Response(status=status)
+    hdrs = object_headers(version, meta)
+
+    part_number = ctx.request.query.get("partNumber")
+    if part_number is not None and version.data()[0] == "inline":
+        if int(part_number) != 1:
+            raise BadRequestError(f"no such part {part_number}")
+        hdrs["Content-Length"] = str(meta["size"])
+        hdrs["x-amz-mp-parts-count"] = "1"
+        return web.Response(status=206, headers=hdrs)
+    if part_number is not None and version.data()[0] == "first_block":
+        ver_row = await ctx.garage.version_table.get(version.uuid, "")
+        if ver_row is not None:
+            pn = int(part_number)
+            blocks = [(k, v) for k, v in ver_row.sorted_blocks() if k[0] == pn]
+            if not blocks:
+                raise BadRequestError(f"no such part {pn}")
+            psize = sum(sz for (_k, (_h, sz)) in blocks)
+            nparts = len({k[0] for k, _ in ver_row.sorted_blocks()})
+            hdrs["Content-Length"] = str(psize)
+            hdrs["x-amz-mp-parts-count"] = str(nparts)
+            return web.Response(status=206, headers=hdrs)
+    hdrs["Content-Length"] = str(meta["size"])
+    return web.Response(status=200, headers=hdrs)
+
+
+async def handle_get_object(ctx) -> web.StreamResponse:
+    garage = ctx.garage
+    _obj, version = await get_object_version(ctx, ctx.key_name)
+    meta = version.meta()
+    status = check_conditions(ctx, version, meta)
+    if status is not None:
+        return web.Response(status=status)
+    hdrs = object_headers(version, meta)
+    size = meta["size"]
+    data = version.data()
+
+    # range / partNumber selection
+    rng = ctx.request.headers.get("Range")
+    part_number = ctx.request.query.get("partNumber")
+    if rng is not None and part_number is not None:
+        raise BadRequestError("cannot combine Range and partNumber")
+
+    if data[0] == "inline":
+        body = bytes(data[2])
+        if part_number is not None:
+            # inline objects behave as a single part
+            if int(part_number) != 1:
+                raise BadRequestError(f"no such part {part_number}")
+            hdrs["Content-Range"] = f"bytes 0-{max(0, len(body)-1)}/{len(body)}"
+            hdrs["x-amz-mp-parts-count"] = "1"
+            return web.Response(status=206, headers=hdrs, body=body)
+        if rng is not None:
+            begin, end = parse_range(rng, len(body))
+            hdrs["Content-Range"] = f"bytes {begin}-{end-1}/{len(body)}"
+            return web.Response(status=206, headers=hdrs, body=body[begin:end])
+        return web.Response(status=200, headers=hdrs, body=body)
+
+    ver_row = await garage.version_table.get(version.uuid, "")
+    if ver_row is None:
+        raise NoSuchKeyError("version metadata missing")
+    blocks = ver_row.sorted_blocks()  # [((part, off), (hash, size))]
+
+    if part_number is not None:
+        pn = int(part_number)
+        pblocks = [(k, v) for k, v in blocks if k[0] == pn]
+        if not pblocks:
+            raise BadRequestError(f"no such part {pn}")
+        begin = _part_offset(blocks, pn)
+        plen = sum(sz for (_k, (_h, sz)) in pblocks)
+        end = begin + plen
+        hdrs["Content-Range"] = f"bytes {begin}-{end-1}/{size}"
+        hdrs["x-amz-mp-parts-count"] = str(len({k[0] for k, _ in blocks}))
+        return await _stream_blocks_range(ctx, hdrs, 206, blocks, begin, end)
+
+    if rng is not None:
+        begin, end = parse_range(rng, size)
+        hdrs["Content-Range"] = f"bytes {begin}-{end-1}/{size}"
+        return await _stream_blocks_range(ctx, hdrs, 206, blocks, begin, end)
+
+    return await _stream_blocks_range(ctx, hdrs, 200, blocks, 0, size)
+
+
+def _part_offset(blocks, pn: int) -> int:
+    off = 0
+    for (p, _o), (_h, sz) in blocks:
+        if p < pn:
+            off += sz
+    return off
+
+
+async def _stream_blocks_range(
+    ctx, hdrs: Dict[str, str], status: int, blocks, begin: int, end: int
+) -> web.StreamResponse:
+    """Stream the [begin, end) byte range assembled from its intersecting
+    blocks, prefetching ahead (ref get.rs:432-512 body_from_blocks_range)."""
+    garage = ctx.garage
+    hdrs["Content-Length"] = str(end - begin)
+    resp = web.StreamResponse(status=status, headers=hdrs)
+    await resp.prepare(ctx.request)
+
+    # compute absolute offsets + the intersecting slice per block
+    todo: List[Tuple[Hash, int, int]] = []  # (hash, slice_begin, slice_end)
+    abs_off = 0
+    for (_pk, (h, sz)) in blocks:
+        b0, b1 = abs_off, abs_off + sz
+        abs_off = b1
+        if b1 <= begin or b0 >= end:
+            continue
+        todo.append((Hash(h), max(0, begin - b0), min(sz, end - b0)))
+
+    async def fetch(i_h):
+        i, h = i_h
+        return await garage.block_manager.rpc_get_block(h, order_tag=i)
+
+    # prefetch pipeline: keep PREFETCH+1 block fetches in flight
+    tasks: List[asyncio.Task] = []
+    try:
+        n = len(todo)
+        for i in range(min(PREFETCH + 1, n)):
+            tasks.append(asyncio.ensure_future(fetch((i, todo[i][0]))))
+        for i in range(n):
+            data = await tasks[i]
+            nxt = i + PREFETCH + 1
+            if nxt < n:
+                tasks.append(asyncio.ensure_future(fetch((nxt, todo[nxt][0]))))
+            s0, s1 = todo[i][1], todo[i][2]
+            await resp.write(data[s0:s1])
+        await resp.write_eof()
+    finally:
+        for t in tasks:
+            if not t.done():
+                t.cancel()
+    return resp

@@ -1,0 +1,271 @@
+"""List endpoints: ListObjects v1/v2, ListMultipartUploads, ListParts.
+
+Equivalent of reference src/api/s3/list.rs (1286 LoC, SURVEY.md §2.7):
+iterative quorum range-reads over the object table with prefix/delimiter
+aggregation into common prefixes (jumping past a completed common prefix
+instead of scanning its contents), marker/continuation-token pagination.
+Multipart uploads are listed from uploading object versions; parts come
+from the MPU row.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import xml.etree.ElementTree as ET
+from typing import List, Optional, Tuple
+
+from aiohttp import web
+
+from ..common import BadRequestError, s3_xml_root, xml_to_bytes
+
+PAGE = 1000
+
+
+def _iso(ts_ms: int) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts_ms / 1000, tz=datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+
+
+def _after_prefix(p: str) -> str:
+    """Smallest string greater than every string with prefix p (valid in
+    both str and utf-8 byte order: increment the last code point)."""
+    for i in range(len(p) - 1, -1, -1):
+        c = ord(p[i])
+        if c < 0x10FFFF:
+            return p[:i] + chr(c + 1)
+    return p + "\x00"
+
+
+async def _collect(
+    ctx,
+    prefix: str,
+    delimiter: Optional[str],
+    pos: Optional[str],
+    max_keys: int,
+    marker: Optional[str] = None,
+    uploads: bool = False,
+):
+    """Enumeration core (ref list.rs).  `pos` = inclusive resume position
+    (None → start of prefix); `marker` = last key/prefix already returned
+    to the client (v1 semantics — suppresses a re-emitted common prefix).
+    Returns (entries, prefixes, truncated, last_returned) where entries =
+    [(key, version…)] in key order."""
+    garage = ctx.garage
+    entries: List[Tuple[str, object]] = []
+    prefixes: List[str] = []
+    last_returned: Optional[str] = None
+    if pos is None:
+        pos = prefix
+
+    while True:
+        batch = await garage.object_table.get_range(
+            ctx.bucket_id, pos, filter="any", limit=PAGE
+        )
+        jumped = False
+        for obj in batch:
+            k = obj.key
+            if k < pos:
+                continue
+            if not k.startswith(prefix):
+                if k > prefix:
+                    return entries, prefixes, False, last_returned
+                continue
+            if uploads:
+                relevant = [v for v in obj.versions() if v.is_uploading(True)]
+            else:
+                lv = obj.last_data_version()
+                relevant = [lv] if lv is not None else []
+            if not relevant:
+                continue
+            if delimiter:
+                rest = k[len(prefix):]
+                di = rest.find(delimiter)
+                if di >= 0:
+                    cp = prefix + rest[: di + len(delimiter)]
+                    if marker is not None and cp <= marker:
+                        # already returned on a previous page — skip it
+                        pos, jumped = _after_prefix(cp), True
+                        break
+                    if len(entries) + len(prefixes) >= max_keys:
+                        return entries, prefixes, True, last_returned
+                    prefixes.append(cp)
+                    last_returned = cp
+                    pos, jumped = _after_prefix(cp), True
+                    break
+            if len(entries) + len(prefixes) >= max_keys:
+                return entries, prefixes, True, last_returned
+            for v in relevant:
+                entries.append((k, v))
+            last_returned = k
+        if jumped:
+            continue
+        if len(batch) < PAGE:
+            return entries, prefixes, False, last_returned
+        pos = batch[-1].key + "\x00"
+
+
+async def handle_list_objects(ctx) -> web.Response:
+    q = ctx.request.query
+    prefix = q.get("prefix", "")
+    delimiter = q.get("delimiter") or None
+    marker = q.get("marker") or None
+    max_keys = max(0, min(int(q.get("max-keys", "1000")), 1000))
+    pos = (marker + "\x00") if marker is not None else None
+
+    entries, prefixes, truncated, last = await _collect(
+        ctx, prefix, delimiter, pos, max_keys, marker=marker
+    )
+    out = s3_xml_root("ListBucketResult")
+    ET.SubElement(out, "Name").text = ctx.bucket_name
+    ET.SubElement(out, "Prefix").text = prefix
+    if marker is not None:
+        ET.SubElement(out, "Marker").text = marker
+    if delimiter:
+        ET.SubElement(out, "Delimiter").text = delimiter
+    ET.SubElement(out, "MaxKeys").text = str(max_keys)
+    ET.SubElement(out, "IsTruncated").text = "true" if truncated else "false"
+    if truncated and last is not None:
+        ET.SubElement(out, "NextMarker").text = last
+    _append_contents(out, entries, prefixes)
+    return web.Response(
+        status=200, body=xml_to_bytes(out), content_type="application/xml"
+    )
+
+
+async def handle_list_objects_v2(ctx) -> web.Response:
+    q = ctx.request.query
+    prefix = q.get("prefix", "")
+    delimiter = q.get("delimiter") or None
+    max_keys = max(0, min(int(q.get("max-keys", "1000")), 1000))
+    token = q.get("continuation-token")
+    start_after = q.get("start-after")
+    marker = None
+    if token is not None:
+        try:
+            # token encodes (last_returned) — resume exclusively after it
+            marker = base64.urlsafe_b64decode(token.encode()).decode()
+        except Exception:
+            raise BadRequestError("bad continuation-token")
+        pos = marker + "\x00"
+        # a common-prefix marker means resume past the whole prefix
+        if delimiter and marker.endswith(delimiter):
+            pos = _after_prefix(marker)
+    elif start_after is not None:
+        marker = start_after
+        pos = start_after + "\x00"
+    else:
+        pos = None
+
+    entries, prefixes, truncated, last = await _collect(
+        ctx, prefix, delimiter, pos, max_keys, marker=marker
+    )
+    out = s3_xml_root("ListBucketResult")
+    ET.SubElement(out, "Name").text = ctx.bucket_name
+    ET.SubElement(out, "Prefix").text = prefix
+    if delimiter:
+        ET.SubElement(out, "Delimiter").text = delimiter
+    ET.SubElement(out, "MaxKeys").text = str(max_keys)
+    ET.SubElement(out, "KeyCount").text = str(len(entries) + len(prefixes))
+    ET.SubElement(out, "IsTruncated").text = "true" if truncated else "false"
+    if token is not None:
+        ET.SubElement(out, "ContinuationToken").text = token
+    if start_after is not None:
+        ET.SubElement(out, "StartAfter").text = start_after
+    if truncated and last is not None:
+        ET.SubElement(out, "NextContinuationToken").text = (
+            base64.urlsafe_b64encode(last.encode()).decode()
+        )
+    _append_contents(out, entries, prefixes)
+    return web.Response(
+        status=200, body=xml_to_bytes(out), content_type="application/xml"
+    )
+
+
+def _append_contents(out, entries, prefixes):
+    for key, v in entries:
+        c = ET.SubElement(out, "Contents")
+        ET.SubElement(c, "Key").text = key
+        ET.SubElement(c, "LastModified").text = _iso(v.timestamp)
+        ET.SubElement(c, "ETag").text = f'"{v.etag()}"'
+        ET.SubElement(c, "Size").text = str(v.size())
+        ET.SubElement(c, "StorageClass").text = "STANDARD"
+    for cp in prefixes:
+        p = ET.SubElement(out, "CommonPrefixes")
+        ET.SubElement(p, "Prefix").text = cp
+
+
+async def handle_list_multipart_uploads(ctx) -> web.Response:
+    q = ctx.request.query
+    prefix = q.get("prefix", "")
+    delimiter = q.get("delimiter") or None
+    max_uploads = max(0, min(int(q.get("max-uploads", "1000")), 1000))
+    key_marker = q.get("key-marker") or None
+    pos = (key_marker + "\x00") if key_marker is not None else None
+
+    entries, prefixes, truncated, last = await _collect(
+        ctx, prefix, delimiter, pos, max_uploads, marker=key_marker, uploads=True
+    )
+    out = s3_xml_root("ListMultipartUploadsResult")
+    ET.SubElement(out, "Bucket").text = ctx.bucket_name
+    ET.SubElement(out, "Prefix").text = prefix
+    if key_marker is not None:
+        ET.SubElement(out, "KeyMarker").text = key_marker
+    if delimiter:
+        ET.SubElement(out, "Delimiter").text = delimiter
+    ET.SubElement(out, "MaxUploads").text = str(max_uploads)
+    ET.SubElement(out, "IsTruncated").text = "true" if truncated else "false"
+    if truncated and last is not None:
+        ET.SubElement(out, "NextKeyMarker").text = last
+    for key, v in entries:
+        u = ET.SubElement(out, "Upload")
+        ET.SubElement(u, "Key").text = key
+        ET.SubElement(u, "UploadId").text = bytes(v.uuid).hex()
+        ET.SubElement(u, "Initiated").text = _iso(v.timestamp)
+        ET.SubElement(u, "StorageClass").text = "STANDARD"
+    for cp in prefixes:
+        p = ET.SubElement(out, "CommonPrefixes")
+        ET.SubElement(p, "Prefix").text = cp
+    return web.Response(
+        status=200, body=xml_to_bytes(out), content_type="application/xml"
+    )
+
+
+async def handle_list_parts(ctx) -> web.Response:
+    from .multipart import get_existing_mpu
+
+    q = ctx.request.query
+    upload_id = q.get("uploadId", "")
+    max_parts = max(0, min(int(q.get("max-parts", "1000")), 1000))
+    pmarker = int(q.get("part-number-marker", "0"))
+
+    mpu = await get_existing_mpu(ctx, upload_id)
+    out = s3_xml_root("ListPartsResult")
+    ET.SubElement(out, "Bucket").text = ctx.bucket_name
+    ET.SubElement(out, "Key").text = ctx.key_name
+    ET.SubElement(out, "UploadId").text = upload_id
+    ET.SubElement(out, "MaxParts").text = str(max_parts)
+    if pmarker:
+        ET.SubElement(out, "PartNumberMarker").text = str(pmarker)
+
+    # newest registration per part number, completed parts only
+    per_part = {}
+    for (pn, ts), p in mpu.sorted_parts():
+        if p.get("etag") is not None:
+            per_part[pn] = (ts, p)
+    items = sorted((pn, tp) for pn, tp in per_part.items() if pn > pmarker)
+    truncated = len(items) > max_parts
+    items = items[:max_parts]
+    ET.SubElement(out, "IsTruncated").text = "true" if truncated else "false"
+    if truncated:
+        ET.SubElement(out, "NextPartNumberMarker").text = str(items[-1][0])
+    for pn, (ts, p) in items:
+        el = ET.SubElement(out, "Part")
+        ET.SubElement(el, "PartNumber").text = str(pn)
+        ET.SubElement(el, "ETag").text = f'"{p["etag"]}"'
+        ET.SubElement(el, "Size").text = str(p["size"] or 0)
+        ET.SubElement(el, "LastModified").text = _iso(ts)
+    return web.Response(
+        status=200, body=xml_to_bytes(out), content_type="application/xml"
+    )

@@ -1,0 +1,233 @@
+"""PutObject — the S3 write path.
+
+Equivalent of reference src/api/s3/put.rs (SURVEY.md §3.2): the body is
+chunked into `block_size` blocks (put.rs:392-426); payloads under the
+inline threshold are stored directly in the object row (put.rs:84-119);
+larger objects create an Uploading version + Version row, then per block
+pipeline {put-block RPC, version-meta insert, next-chunk read} with
+running md5/sha256 hashing (put.rs:286-360), finishing with the
+Complete{FirstBlock} object row.  Block refs are created by the version
+table's updated() hook.  On failure the version is aborted and a cleanup
+tombstone inserted (put.rs:436-466).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import binascii
+import hashlib
+from typing import AsyncIterator, Dict, Optional, Tuple
+
+from aiohttp import web
+
+from ...block.manager import INLINE_THRESHOLD
+from ...model.s3.object_table import (
+    Object,
+    ObjectVersion,
+    ObjectVersionData,
+    ObjectVersionHeaders,
+    ObjectVersionMeta,
+)
+from ...model.s3.version_table import Version
+from ...utils.crdt import now_msec
+from ...utils.data import Hash, block_hash, gen_uuid
+from ..common import ApiError, BadRequestError
+
+
+class Chunker:
+    """Re-chunk an async byte stream into fixed-size blocks
+    (ref put.rs:392-426 StreamChunker)."""
+
+    def __init__(self, stream: AsyncIterator[bytes], block_size: int):
+        self.stream = stream.__aiter__()
+        self.block_size = block_size
+        self.buf = bytearray()
+        self.eof = False
+
+    async def next(self) -> Optional[bytes]:
+        while not self.eof and len(self.buf) < self.block_size:
+            try:
+                self.buf.extend(await self.stream.__anext__())
+            except StopAsyncIteration:
+                self.eof = True
+        if not self.buf:
+            return None
+        out = bytes(self.buf[: self.block_size])
+        del self.buf[: self.block_size]
+        return out
+
+
+def headers_from_request(ctx) -> Dict:
+    """Collect stored headers (ref put.rs get_headers)."""
+    req = ctx.request
+    other = {}
+    for h in (
+        "cache-control", "content-disposition", "content-encoding",
+        "content-language", "expires",
+    ):
+        if h in req.headers:
+            other[h] = req.headers[h]
+    for k, v in req.headers.items():
+        if k.lower().startswith("x-amz-meta-"):
+            other[k.lower()] = v
+    return ObjectVersionHeaders.new(
+        req.headers.get("Content-Type", "application/octet-stream"), other
+    )
+
+
+async def check_quotas(ctx, add_size: int, key: Optional[str] = None) -> None:
+    """ref put.rs check_quotas: max_size/max_objects from bucket params,
+    crediting back the object being overwritten."""
+    quotas = ctx.bucket.params().quotas.value or {}
+    if not (quotas.get("max_size") or quotas.get("max_objects")):
+        return
+    counters = await ctx.garage.object_counter.get_totals(bytes(ctx.bucket_id))
+    prev_objects, prev_size = 0, 0
+    if key is not None:
+        cur = await ctx.garage.object_table.get(ctx.bucket_id, key)
+        lv = cur.last_data_version() if cur is not None else None
+        if lv is not None:
+            prev_objects, prev_size = 1, lv.size()
+    if quotas.get("max_objects") is not None:
+        if counters.get("objects", 0) - prev_objects + 1 > quotas["max_objects"]:
+            raise ApiError("object quota exceeded", status=403, code="QuotaExceeded")
+    if quotas.get("max_size") is not None:
+        if counters.get("bytes", 0) - prev_size + add_size > quotas["max_size"]:
+            raise ApiError("size quota exceeded", status=403, code="QuotaExceeded")
+
+
+async def save_stream(
+    ctx,
+    stream: AsyncIterator[bytes],
+    headers: Dict,
+    key: str,
+    content_md5: Optional[str] = None,
+    content_sha256: Optional[str] = None,
+) -> Tuple[str, int]:
+    """Store a full object body; returns (etag, size) (ref put.rs:66-199)."""
+    garage = ctx.garage
+    bucket_id = ctx.bucket_id
+    chunker = Chunker(stream, garage.config.block_size)
+    first = await chunker.next() or b""
+
+    md5 = hashlib.md5()
+    sha256 = hashlib.sha256()
+
+    # small payload: store inline in the object row (put.rs:84-119)
+    if len(first) < INLINE_THRESHOLD and chunker.eof and not chunker.buf:
+        md5.update(first)
+        sha256.update(first)
+        etag = md5.hexdigest()
+        _check_digests(etag, sha256.hexdigest(), content_md5, content_sha256)
+        await check_quotas(ctx, len(first), key)
+        meta = ObjectVersionMeta.new(headers, len(first), etag)
+        ov = ObjectVersion(
+            gen_uuid(), now_msec(), ["complete", ObjectVersionData.inline(meta, first)]
+        )
+        await garage.object_table.insert(Object(bucket_id, key, [ov]))
+        return etag, len(first)
+
+    # large payload: streaming multi-block write (put.rs:120-199)
+    version_uuid = gen_uuid()
+    ts = now_msec()
+    ov = ObjectVersion.uploading(version_uuid, ts, False, headers)
+    await garage.object_table.insert(Object(bucket_id, key, [ov]))
+    version = Version.new(version_uuid, bytes(bucket_id), key)
+    await garage.version_table.insert(version)
+
+    try:
+        total_size, first_hash = await read_and_put_blocks(
+            ctx, version, 0, first, chunker, md5, sha256
+        )
+        etag = md5.hexdigest()
+        _check_digests(etag, sha256.hexdigest(), content_md5, content_sha256)
+        await check_quotas(ctx, total_size, key)
+        meta = ObjectVersionMeta.new(headers, total_size, etag)
+        ov_done = ObjectVersion(
+            version_uuid, ts,
+            ["complete", ObjectVersionData.first_block(meta, first_hash)],
+        )
+        await garage.object_table.insert(Object(bucket_id, key, [ov_done]))
+        return etag, total_size
+    except BaseException:
+        # cleanup: mark the version aborted (put.rs:436-466); the object
+        # hook will tombstone the version row → drop block refs
+        try:
+            ov_abort = ObjectVersion(version_uuid, ts, ["aborted"])
+            await garage.object_table.insert(
+                Object(bucket_id, key, [ov_abort])
+            )
+        except Exception:
+            pass
+        raise
+
+
+async def read_and_put_blocks(
+    ctx, version: Version, part_number: int, first_block: bytes,
+    chunker: Chunker, md5, sha256,
+) -> Tuple[int, Hash]:
+    """Pipelined per-block loop (ref put.rs:286-360): overlap the block
+    quorum-write + version-meta insert with reading/hashing the next
+    chunk.  Returns (total_size, first_block_hash)."""
+    garage = ctx.garage
+    algo = garage.block_manager.hash_algo
+    offset = 0
+    block = first_block
+    first_hash: Optional[Hash] = None
+    put_task: Optional[asyncio.Task] = None
+
+    async def put_one(h: Hash, data: bytes, off: int):
+        version.add_block(part_number, off, bytes(h), len(data))
+        # insert updated version row (hook creates the block ref) in
+        # parallel with the block quorum write (put.rs:362-390)
+        await asyncio.gather(
+            garage.block_manager.rpc_put_block(h, data),
+            garage.version_table.insert(version),
+        )
+
+    try:
+        while block:
+            md5.update(block)
+            sha256.update(block)
+            h = block_hash(block, algo)
+            if first_hash is None:
+                first_hash = h
+            if put_task is not None:
+                await put_task
+            put_task = asyncio.ensure_future(put_one(h, block, offset))
+            offset += len(block)
+            block = await chunker.next()
+        if put_task is not None:
+            await put_task
+    except BaseException:
+        if put_task is not None:
+            put_task.cancel()
+            try:
+                await put_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        raise
+    return offset, first_hash if first_hash is not None else Hash(b"\x00" * 32)
+
+
+def _check_digests(md5_hex, sha256_hex, content_md5, content_sha256):
+    """ref put.rs:200-240 ensure_checksum_matches."""
+    if content_md5 is not None:
+        expected = binascii.hexlify(binascii.a2b_base64(content_md5)).decode()
+        if expected != md5_hex:
+            raise ApiError("Content-MD5 mismatch", status=400, code="BadDigest")
+    if content_sha256 is not None and content_sha256 != sha256_hex:
+        raise ApiError("x-amz-content-sha256 mismatch", status=400, code="BadDigest")
+
+
+async def handle_put_object(ctx) -> web.Response:
+    key = ctx.key_name
+    headers = headers_from_request(ctx)
+    content_md5 = ctx.request.headers.get("Content-MD5")
+    content_sha256 = ctx.verified.content_sha256
+    if content_sha256 in (None, "STREAMING"):
+        content_sha256 = None
+    etag, _size = await save_stream(
+        ctx, ctx.body_stream(), headers, key, content_md5, content_sha256
+    )
+    return web.Response(status=200, headers={"ETag": f'"{etag}"'})

@@ -44,7 +44,8 @@ class ObjectVersionMeta:
 
 
 class ObjectVersionData:
-    """Inline(meta, bytes) | FirstBlock(meta, hash) (object_table.rs:117-131)."""
+    """DeleteMarker | Inline(meta, bytes) | FirstBlock(meta, hash)
+    (object_table.rs:117-131)."""
 
     @staticmethod
     def inline(meta: Dict, data: bytes) -> List:
@@ -53,6 +54,10 @@ class ObjectVersionData:
     @staticmethod
     def first_block(meta: Dict, hash32: bytes) -> List:
         return ["first_block", meta, bytes(hash32)]
+
+    @staticmethod
+    def delete_marker() -> List:
+        return ["delete_marker"]
 
 
 class ObjectVersion:
@@ -88,14 +93,14 @@ class ObjectVersion:
 
     def is_data(self) -> bool:
         """Has actual stored data (complete and not a delete marker)."""
-        return self.is_complete()
+        return self.is_complete() and self.state[1][0] != "delete_marker"
 
     def data(self) -> Optional[List]:
         return self.state[1] if self.is_complete() else None
 
     def meta(self) -> Optional[Dict]:
         d = self.data()
-        return d[1] if d is not None else None
+        return d[1] if d is not None and d[0] != "delete_marker" else None
 
     def size(self) -> int:
         m = self.meta()
@@ -124,7 +129,9 @@ class ObjectVersion:
         st = list(v[2])
         if st[0] == "complete":
             d = list(st[1])
-            if d[0] == "inline":
+            if d[0] == "delete_marker":
+                st[1] = ["delete_marker"]
+            elif d[0] == "inline":
                 st[1] = ["inline", dict(d[1]), bytes(d[2])]
             else:
                 st[1] = ["first_block", dict(d[1]), bytes(d[2])]
@@ -170,11 +177,13 @@ class Object(Entry):
         return None
 
     def is_tombstone(self) -> bool:
-        # an object row with no versions (or only aborted ones that will be
-        # pruned) never happens post-merge; a row whose only complete data
-        # is absent and has no uploads is still kept (delete is modeled by
-        # pruning to zero versions — ref object_table.rs is_tombstone)
-        return len(self._versions) == 0
+        # a row whose only remaining version is a delete marker carries no
+        # data and is GC-able (ref object_table.rs is_tombstone)
+        return len(self._versions) == 0 or (
+            len(self._versions) == 1
+            and self._versions[0].is_complete()
+            and not self._versions[0].is_data()
+        )
 
     def merge(self, other: "Object") -> None:
         """ref object_table.rs:324-355."""
@@ -192,9 +201,14 @@ class Object(Entry):
         # (they still need to propagate); merge of two aborted-only lists
         # keeps them all, which is fine — they carry no data
 
+    def last_data_version(self) -> Optional[ObjectVersion]:
+        """Newest complete version that is real data (not a delete marker)."""
+        last = self.last_complete_version()
+        return last if last is not None and last.is_data() else None
+
     def counts(self) -> List[Tuple[str, int]]:
         """Counter contributions of this row (ref object_table.rs:480-518)."""
-        last = self.last_complete_version()
+        last = self.last_data_version()
         objects = 1 if last is not None else 0
         nbytes = last.size() if last is not None else 0
         unfinished = sum(1 for v in self._versions if v.is_uploading())
@@ -243,17 +257,23 @@ class ObjectTableSchema(TableSchema):
         for ov in old.versions():
             nv = new_by_uuid.get(bytes(ov.uuid))
             # a version that was active and is now gone or aborted must be
-            # deleted from the version table (object_table.rs:420-460)
+            # deleted from the version table (object_table.rs:398-429);
+            # for multipart uploads ov.uuid doubles as the upload id and
+            # the *final* version uuid, so this also reaps the final
+            # version when a completed MPU object is later deleted
             became_deleted = (nv is None and not ov.is_aborted()) or (
                 nv is not None and nv.is_aborted() and not ov.is_aborted()
             )
-            if not became_deleted:
-                continue
-            if ov.is_uploading(check_multipart=True):
-                # multipart: ov.uuid is the *upload id*; tombstone the MPU
-                # row, whose own hook tombstones every part version
-                # (ref object_table.rs routes multipart versions to MPU)
-                if self.mpu_table is not None:
+            if became_deleted and self.version_table is not None:
+                vdel = Version.new(ov.uuid, bytes(old.bucket_id), old.key, deleted=True)
+                self.version_table.data.queue_insert(tx, vdel)
+            # independently: once a multipart upload stops Uploading
+            # (aborted, completed, or pruned), its MPU row is tombstoned,
+            # cascading to all part versions (object_table.rs:431-460);
+            # after completion the final version carries its own refs
+            if ov.is_uploading(check_multipart=True) and self.mpu_table is not None:
+                mpu_done = nv is None or not nv.is_uploading()
+                if mpu_done:
                     from .mpu_table import MultipartUpload
 
                     mdel = MultipartUpload(
@@ -261,11 +281,8 @@ class ObjectTableSchema(TableSchema):
                         old.key, deleted=True,
                     )
                     self.mpu_table.data.queue_insert(tx, mdel)
-            elif self.version_table is not None:
-                vdel = Version.new(ov.uuid, bytes(old.bucket_id), old.key, deleted=True)
-                self.version_table.data.queue_insert(tx, vdel)
 
     def matches_filter(self, entry: Object, filter: Any) -> bool:
         if filter is None:
-            return entry.last_complete_version() is not None
+            return entry.last_data_version() is not None
         return True
