@@ -1,0 +1,432 @@
+"""AdminRpcHandler — cluster administration over the RPC fabric.
+
+Equivalent of reference src/garage/admin/mod.rs:37-99 + bucket.rs +
+key.rs + block.rs (SURVEY.md §2.9): status, layout staging/apply, bucket
+and key CRUD with permission grants, worker introspection and runtime
+variables, repair launchers, and node statistics.  Commands arrive as
+msgpack dicts {"cmd": ..., ...} on the "garage/admin" endpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..model.permission import BucketKeyPerm
+from ..rpc.layout import NodeRole
+from ..utils.data import Uuid
+from ..utils.error import GarageError
+
+logger = logging.getLogger("garage_tpu.admin")
+
+
+class AdminRpcHandler:
+    def __init__(self, garage):
+        self.garage = garage
+        self.helper = garage.helper()
+        self.endpoint = garage.system.netapp.endpoint("garage/admin")
+        self.endpoint.set_handler(self._handle)
+
+    async def _handle(self, remote, msg, body):
+        cmd = msg.get("cmd")
+        fn = getattr(self, f"_cmd_{cmd}", None)
+        if fn is None:
+            return {"err": f"unknown admin command {cmd!r}"}, None
+        try:
+            return {"ok": await fn(msg)}, None
+        except GarageError as e:
+            return {"err": str(e)}, None
+        except Exception as e:  # noqa: BLE001 — report to CLI
+            logger.exception("admin command %s failed", cmd)
+            return {"err": f"{type(e).__name__}: {e}"}, None
+
+    # --- status / layout ---------------------------------------------------
+
+    async def _cmd_status(self, msg) -> Dict:
+        sys = self.garage.system
+        h = sys.health()
+        return {
+            "node_id": bytes(sys.id).hex(),
+            "hostname": sys._local_status().hostname,
+            "known_nodes": sys.get_known_nodes(),
+            "layout_version": sys.layout.version,
+            "roles": {
+                nid.hex(): [r.zone, r.capacity, r.tags]
+                for nid, r in sys.layout.node_roles().items()
+            },
+            "staged": {
+                nid.hex(): ([r.zone, r.capacity, r.tags] if r else None)
+                for nid, r in sys.layout.staged_roles().items()
+            },
+            "health": {
+                "status": h.status,
+                "known_nodes": h.known_nodes,
+                "connected_nodes": h.connected_nodes,
+                "storage_nodes": h.storage_nodes,
+                "storage_nodes_ok": h.storage_nodes_ok,
+                "partitions": h.partitions,
+                "partitions_quorum": h.partitions_quorum,
+                "partitions_all_ok": h.partitions_all_ok,
+            },
+        }
+
+    async def _cmd_connect(self, msg) -> str:
+        addr = msg["addr"]
+        expected = bytes.fromhex(msg["node_id"]) if msg.get("node_id") else None
+        await self.garage.system.netapp.connect(addr, expected_id=expected)
+        self.garage.system.peering.add_peer(addr, expected)
+        return "connected"
+
+    async def _cmd_layout_assign(self, msg) -> str:
+        sys = self.garage.system
+        node_hex = msg["node"]
+        nid = self._resolve_node(node_hex)
+        if msg.get("remove"):
+            sys.layout.stage_role(nid, None)
+        else:
+            role = NodeRole(
+                zone=msg["zone"],
+                capacity=msg.get("capacity"),
+                tags=list(msg.get("tags", [])),
+            )
+            sys.layout.stage_role(nid, role)
+        sys.save_layout()
+        return "staged"
+
+    async def _cmd_layout_apply(self, msg) -> List[str]:
+        sys = self.garage.system
+        version = msg.get("version")
+        messages = sys.layout.apply_staged_changes(version)
+        sys.save_layout()
+        sys._rebuild_ring()
+        await sys.broadcast_layout()
+        return messages
+
+    async def _cmd_layout_revert(self, msg) -> str:
+        sys = self.garage.system
+        sys.layout.revert_staged_changes(msg.get("version"))
+        sys.save_layout()
+        return "reverted"
+
+    def _resolve_node(self, node_hex: str) -> bytes:
+        """Accept unambiguous hex prefixes of known node ids."""
+        sys = self.garage.system
+        candidates = {bytes(sys.id)}
+        candidates.update(bytes(n) for n in sys.peering.connected_nodes())
+        candidates.update(sys.layout.node_roles().keys())
+        matches = [n for n in candidates if n.hex().startswith(node_hex.lower())]
+        if len(matches) != 1:
+            raise GarageError(
+                f"node id prefix {node_hex!r} matches {len(matches)} nodes"
+            )
+        return matches[0]
+
+    # --- buckets -----------------------------------------------------------
+
+    async def _cmd_bucket_list(self, msg) -> List[Dict]:
+        out = []
+        for b in await self.helper.list_buckets():
+            p = b.params()
+            out.append({
+                "id": bytes(b.id).hex(),
+                "aliases": [n for n, l in p.aliases.items.items() if l.value],
+                "keys": len([1 for _k, l in p.authorized_keys.items.items() if l.value.is_any()]),
+            })
+        return out
+
+    async def _cmd_bucket_info(self, msg) -> Dict:
+        bid = await self._bucket_id(msg["bucket"])
+        b = await self.helper.get_existing_bucket(bid)
+        p = b.params()
+        counters = await self.garage.object_counter.get_totals(bytes(bid))
+        mpu_counters = await self.garage.mpu_counter.get_totals(bytes(bid))
+        return {
+            "id": bytes(bid).hex(),
+            "aliases": [n for n, l in p.aliases.items.items() if l.value],
+            "website": p.website_config.value,
+            "quotas": p.quotas.value,
+            "keys": {
+                k: [l.value.allow_read, l.value.allow_write, l.value.allow_owner]
+                for k, l in p.authorized_keys.items.items()
+                if l.value.is_any()
+            },
+            "objects": counters.get("objects", 0),
+            "bytes": counters.get("bytes", 0),
+            "unfinished_uploads": counters.get("unfinished_uploads", 0),
+            "mpu_uploads": mpu_counters.get("uploads", 0),
+        }
+
+    async def _cmd_bucket_create(self, msg) -> str:
+        b = await self.helper.create_bucket(msg["name"])
+        return bytes(b.id).hex()
+
+    async def _cmd_bucket_delete(self, msg) -> str:
+        bid = await self._bucket_id(msg["bucket"])
+        await self.helper.delete_bucket(bid)
+        return "deleted"
+
+    async def _cmd_bucket_alias(self, msg) -> str:
+        from ..model.bucket_alias_table import BucketAlias
+
+        bid = await self._bucket_id(msg["bucket"])
+        name = msg["alias"]
+        existing = await self.helper.resolve_global_bucket_name(name)
+        if existing is not None:
+            raise GarageError(f"alias {name!r} already in use")
+        b = await self.helper.get_existing_bucket(bid)
+        b.params().aliases.update(name, True)
+        await self.garage.bucket_table.insert(b)
+        await self.garage.bucket_alias_table.insert(BucketAlias.new(name, bid))
+        return "aliased"
+
+    async def _cmd_bucket_unalias(self, msg) -> str:
+        name = msg["alias"]
+        alias = await self.garage.bucket_alias_table.get(name, "")
+        if alias is None or alias.bucket_id() is None:
+            raise GarageError(f"no such alias {name!r}")
+        bid = alias.bucket_id()
+        b = await self.helper.get_existing_bucket(bid)
+        if len([1 for _n, l in b.params().aliases.items.items() if l.value]) <= 1:
+            raise GarageError("cannot remove the last alias of a bucket")
+        b.params().aliases.update(name, False)
+        alias.state.update(None)
+        await self.garage.bucket_table.insert(b)
+        await self.garage.bucket_alias_table.insert(alias)
+        return "unaliased"
+
+    async def _cmd_bucket_allow(self, msg) -> str:
+        bid = await self._bucket_id(msg["bucket"])
+        key = await self._find_key(msg["key"])
+        cur = key.bucket_permissions(bid)
+        perm = BucketKeyPerm(
+            cur.allow_read or bool(msg.get("read")),
+            cur.allow_write or bool(msg.get("write")),
+            cur.allow_owner or bool(msg.get("owner")),
+        )
+        await self.helper.set_bucket_key_permissions(bid, key.key_id, perm)
+        return "allowed"
+
+    async def _cmd_bucket_deny(self, msg) -> str:
+        bid = await self._bucket_id(msg["bucket"])
+        key = await self._find_key(msg["key"])
+        cur = key.bucket_permissions(bid)
+        perm = BucketKeyPerm(
+            cur.allow_read and not msg.get("read"),
+            cur.allow_write and not msg.get("write"),
+            cur.allow_owner and not msg.get("owner"),
+        )
+        await self.helper.set_bucket_key_permissions(bid, key.key_id, perm)
+        return "denied"
+
+    async def _cmd_bucket_website(self, msg) -> str:
+        bid = await self._bucket_id(msg["bucket"])
+        b = await self.helper.get_existing_bucket(bid)
+        if msg.get("allow"):
+            b.params().website_config.update({
+                "index_document": msg.get("index_document", "index.html"),
+                "error_document": msg.get("error_document"),
+            })
+        else:
+            b.params().website_config.update(None)
+        await self.garage.bucket_table.insert(b)
+        return "updated"
+
+    async def _cmd_bucket_set_quotas(self, msg) -> str:
+        bid = await self._bucket_id(msg["bucket"])
+        b = await self.helper.get_existing_bucket(bid)
+        b.params().quotas.update({
+            "max_size": msg.get("max_size"),
+            "max_objects": msg.get("max_objects"),
+        })
+        await self.garage.bucket_table.insert(b)
+        return "updated"
+
+    async def _bucket_id(self, name_or_id: str) -> Uuid:
+        return await self.helper.resolve_bucket(name_or_id)
+
+    # --- keys --------------------------------------------------------------
+
+    async def _find_key(self, pattern: str):
+        """key id or unambiguous prefix or name (ref cli key search)."""
+        k = await self.garage.key_table.get(pattern, "")
+        if k is not None and not k.is_deleted():
+            return k
+        matches = [
+            k for k in await self.helper.list_keys()
+            if k.key_id.startswith(pattern) or k.params().name.value == pattern
+        ]
+        if len(matches) != 1:
+            raise GarageError(f"key {pattern!r} matches {len(matches)} keys")
+        return matches[0]
+
+    async def _cmd_key_list(self, msg) -> List[Dict]:
+        return [
+            {"id": k.key_id, "name": k.params().name.value}
+            for k in await self.helper.list_keys()
+        ]
+
+    async def _cmd_key_info(self, msg) -> Dict:
+        k = await self._find_key(msg["key"])
+        p = k.params()
+        return {
+            "id": k.key_id,
+            "name": p.name.value,
+            "secret": p.secret_key if msg.get("show_secret") else None,
+            "allow_create_bucket": p.allow_create_bucket.value,
+            "buckets": {
+                bid.hex(): [l.value.allow_read, l.value.allow_write, l.value.allow_owner]
+                for bid, l in p.authorized_buckets.items.items()
+                if l.value.is_any()
+            },
+        }
+
+    async def _cmd_key_create(self, msg) -> Dict:
+        k = await self.helper.create_key(msg.get("name", "unnamed"))
+        return {"id": k.key_id, "secret": k.params().secret_key}
+
+    async def _cmd_key_delete(self, msg) -> str:
+        k = await self._find_key(msg["key"])
+        await self.helper.delete_key(k)
+        return "deleted"
+
+    async def _cmd_key_import(self, msg) -> str:
+        from ..model.key_table import Key
+
+        existing = await self.garage.key_table.get(msg["id"], "")
+        if existing is not None and not existing.is_deleted():
+            raise GarageError("key id already exists")
+        k = Key.import_key(msg["id"], msg["secret"], msg.get("name", "imported"))
+        await self.garage.key_table.insert(k)
+        return "imported"
+
+    async def _cmd_key_set(self, msg) -> str:
+        k = await self._find_key(msg["key"])
+        if "allow_create_bucket" in msg:
+            k.params().allow_create_bucket.update(bool(msg["allow_create_bucket"]))
+        if msg.get("name"):
+            k.params().name.update(msg["name"])
+        await self.garage.key_table.insert(k)
+        return "updated"
+
+    # --- workers / repair / stats -----------------------------------------
+
+    async def _cmd_worker_list(self, msg) -> List[Dict]:
+        out = []
+        for wid, w in self.garage.bg.workers.items():
+            st = w.status()
+            out.append({"id": wid, "name": w.name(), **st.to_dict()})
+        return out
+
+    async def _cmd_worker_get_var(self, msg) -> Dict:
+        if msg.get("var"):
+            return {msg["var"]: self.garage.bg_vars.get(msg["var"])}
+        return self.garage.bg_vars.all()
+
+    async def _cmd_worker_set_var(self, msg) -> str:
+        self.garage.bg_vars.set(msg["var"], msg["value"])
+        return "set"
+
+    async def _cmd_launch_repair(self, msg) -> str:
+        what = msg.get("what", "tables")
+        g = self.garage
+        if what == "tables":
+            for t in g.tables:
+                if t.syncer is not None:
+                    t.syncer.add_full_sync()
+            return "table full sync launched"
+        if what == "blocks":
+            from ..block.repair import RepairWorker
+
+            g.bg.spawn(RepairWorker(g.block_manager))
+            return "block repair launched"
+        if what == "scrub":
+            cmd = msg.get("scrub_cmd", "start")
+            if g.scrub_worker is not None:
+                g.scrub_worker.send_command(cmd)
+                return f"scrub {cmd} ok"
+            return "no scrub worker"
+        if what == "rebalance":
+            from ..block.repair import RebalanceWorker
+
+            g.bg.spawn(RebalanceWorker(g.block_manager))
+            return "rebalance launched"
+        if what == "versions":
+            n = await self._repair_versions()
+            return f"version repair: {n} orphans reaped"
+        if what == "block_refs":
+            n = await self._repair_block_refs()
+            return f"block_ref repair: {n} orphans reaped"
+        raise GarageError(f"unknown repair {what!r}")
+
+    async def _repair_versions(self) -> int:
+        """Tombstone versions whose object no longer references them
+        (ref repair/online.rs repair_versions)."""
+        from ..model.s3.version_table import Version
+        from ..utils.data import Hash, Uuid
+
+        g = self.garage
+        n = 0
+        data = g.version_table.data
+        for _k, raw in list(data.store.items(b"", None)):
+            v = data.decode_entry(raw)
+            if v.deleted.value:
+                continue
+            if v.mpu_upload_id is not None:
+                mpu = await g.mpu_table.get(Uuid(v.mpu_upload_id), "")
+                ok = mpu is not None and not mpu.deleted.value
+            else:
+                obj = await g.object_table.get(Uuid(bytes(v.bucket_id)), v.key)
+                ok = obj is not None and any(
+                    bytes(ov.uuid) == bytes(v.uuid)
+                    and (ov.is_complete() or ov.is_uploading())
+                    for ov in obj.versions()
+                )
+            if not ok:
+                vdel = Version(
+                    v.uuid, v.bucket_id, v.key, deleted=True,
+                    mpu_upload_id=v.mpu_upload_id,
+                )
+                await g.version_table.insert(vdel)
+                n += 1
+        return n
+
+    async def _repair_block_refs(self) -> int:
+        """Delete block refs whose version is gone (ref online.rs)."""
+        from ..model.s3.block_ref_table import BlockRef
+
+        g = self.garage
+        n = 0
+        data = g.block_ref_table.data
+        for _k, raw in list(data.store.items(b"", None)):
+            br = data.decode_entry(raw)
+            if br.deleted.value:
+                continue
+            v = await g.version_table.get(br.version, "")
+            if v is None or v.deleted.value:
+                await g.block_ref_table.insert(
+                    BlockRef(br.block, br.version, deleted=True)
+                )
+                n += 1
+        return n
+
+    async def _cmd_stats(self, msg) -> Dict:
+        g = self.garage
+        table_stats = {}
+        for t in g.tables:
+            table_stats[t.schema.TABLE_NAME] = {
+                "merkle_todo": t.data.merkle_todo_len(),
+                "gc_todo": t.data.gc_todo_len(),
+                "insert_queue": len(t.data.insert_queue),
+            }
+        return {
+            "node_id": bytes(g.system.id).hex(),
+            "tables": table_stats,
+            "block": {
+                "rc_entries": g.block_manager.rc_len(),
+                "resync_queue": g.block_resync.queue_len(),
+                "resync_errors": g.block_resync.errors_len(),
+                "bytes_read": g.block_manager.bytes_read,
+                "bytes_written": g.block_manager.bytes_written,
+                "corruptions": g.block_manager.corruptions,
+            },
+        }

@@ -1,0 +1,225 @@
+"""Admin HTTP API — health, metrics, cluster/bucket/key REST.
+
+Equivalent of reference src/api/admin/ (SURVEY.md §2.7): `/health` (no
+auth), `/metrics` (Prometheus text format, guarded by the metrics token),
+and the v1 REST endpoints for status/layout/buckets/keys guarded by the
+admin token (api_server.rs:32-60,271-335).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+logger = logging.getLogger("garage_tpu.api.admin")
+
+
+class AdminApiServer:
+    def __init__(self, garage):
+        self.garage = garage
+        self.helper = garage.helper()
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self, bind_addr: str) -> None:
+        app = web.Application()
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/v1/status", self.handle_status)
+        app.router.add_get("/v1/health", self.handle_health_detailed)
+        app.router.add_post("/v1/layout", self.handle_layout_update)
+        app.router.add_get("/v1/layout", self.handle_layout_get)
+        app.router.add_post("/v1/layout/apply", self.handle_layout_apply)
+        app.router.add_get("/v1/bucket", self.handle_bucket_list)
+        app.router.add_post("/v1/bucket", self.handle_bucket_create)
+        app.router.add_get("/v1/key", self.handle_key_list)
+        app.router.add_post("/v1/key", self.handle_key_create)
+        app.router.add_get("/check", self.handle_check_domain)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        host, port = bind_addr.rsplit(":", 1)
+        self._site = web.TCPSite(self._runner, host, int(port))
+        await self._site.start()
+        logger.info("Admin API listening on %s", bind_addr)
+
+    @property
+    def port(self) -> int:
+        return self._site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # --- auth ---
+
+    def _check_token(self, request: web.Request, token: Optional[str]) -> None:
+        if token is None:
+            raise web.HTTPForbidden(text="admin token not configured")
+        auth = request.headers.get("Authorization", "")
+        if auth != f"Bearer {token}":
+            raise web.HTTPForbidden(text="invalid bearer token")
+
+    def _admin(self, request) -> None:
+        self._check_token(request, self.garage.config.admin_token)
+
+    # --- handlers ---
+
+    async def handle_health(self, request) -> web.Response:
+        """Quick liveness: 200 if we can serve quorum ops (ref
+        api_server.rs /health)."""
+        h = self.garage.system.health()
+        status = 200 if h.status in ("healthy", "degraded") else 503
+        return web.Response(status=status, text=h.status)
+
+    async def handle_health_detailed(self, request) -> web.Response:
+        self._admin(request)
+        h = self.garage.system.health()
+        return web.json_response({
+            "status": h.status,
+            "knownNodes": h.known_nodes,
+            "connectedNodes": h.connected_nodes,
+            "storageNodes": h.storage_nodes,
+            "storageNodesOk": h.storage_nodes_ok,
+            "partitions": h.partitions,
+            "partitionsQuorum": h.partitions_quorum,
+            "partitionsAllOk": h.partitions_all_ok,
+        })
+
+    async def handle_metrics(self, request) -> web.Response:
+        """Prometheus exposition (ref api_server.rs:271-335)."""
+        tok = self.garage.config.admin_metrics_token
+        if tok is not None:
+            self._check_token(request, tok)
+        g = self.garage
+        lines = []
+
+        def gauge(name, value, help_=""):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+
+        h = g.system.health()
+        gauge("cluster_healthy", 1 if h.status == "healthy" else 0)
+        gauge("cluster_available", 1 if h.status != "unavailable" else 0)
+        gauge("cluster_connected_nodes", h.connected_nodes)
+        gauge("cluster_known_nodes", h.known_nodes)
+        for t in g.tables:
+            n = t.schema.TABLE_NAME
+            gauge(f'table_merkle_todo{{table_name="{n}"}}', t.data.merkle_todo_len())
+            gauge(f'table_gc_todo{{table_name="{n}"}}', t.data.gc_todo_len())
+        gauge("block_resync_queue_length", g.block_resync.queue_len())
+        gauge("block_resync_errored_blocks", g.block_resync.errors_len())
+        gauge("block_rc_entries", g.block_manager.rc_len())
+        gauge("block_bytes_read_total", g.block_manager.bytes_read)
+        gauge("block_bytes_written_total", g.block_manager.bytes_written)
+        gauge("block_corruptions_total", g.block_manager.corruptions)
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def handle_status(self, request) -> web.Response:
+        self._admin(request)
+        sys = self.garage.system
+        return web.json_response({
+            "node": bytes(sys.id).hex(),
+            "garageVersion": "garage-tpu-0.1",
+            "layoutVersion": sys.layout.version,
+            "knownNodes": sys.get_known_nodes(),
+            "roles": {
+                nid.hex(): {"zone": r.zone, "capacity": r.capacity, "tags": r.tags}
+                for nid, r in sys.layout.node_roles().items()
+            },
+        })
+
+    async def handle_layout_get(self, request) -> web.Response:
+        self._admin(request)
+        sys = self.garage.system
+        return web.json_response({
+            "version": sys.layout.version,
+            "roles": {
+                nid.hex(): {"zone": r.zone, "capacity": r.capacity, "tags": r.tags}
+                for nid, r in sys.layout.node_roles().items()
+            },
+            "stagedRoleChanges": {
+                nid.hex(): (
+                    {"zone": r.zone, "capacity": r.capacity, "tags": r.tags}
+                    if r is not None else None
+                )
+                for nid, r in sys.layout.staged_roles().items()
+            },
+        })
+
+    async def handle_layout_update(self, request) -> web.Response:
+        self._admin(request)
+        from ..rpc.layout import NodeRole
+
+        body = json.loads(await request.read())
+        sys = self.garage.system
+        for nid_hex, role in body.get("roles", {}).items():
+            nid = bytes.fromhex(nid_hex)
+            if role is None:
+                sys.layout.stage_role(nid, None)
+            else:
+                sys.layout.stage_role(nid, NodeRole(
+                    zone=role["zone"], capacity=role.get("capacity"),
+                    tags=role.get("tags", []),
+                ))
+        sys.save_layout()
+        return web.json_response({"ok": True})
+
+    async def handle_layout_apply(self, request) -> web.Response:
+        self._admin(request)
+        body = json.loads(await request.read() or b"{}")
+        sys = self.garage.system
+        msgs = sys.layout.apply_staged_changes(body.get("version"))
+        sys.save_layout()
+        sys._rebuild_ring()
+        await sys.broadcast_layout()
+        return web.json_response({"messages": msgs})
+
+    async def handle_bucket_list(self, request) -> web.Response:
+        self._admin(request)
+        out = []
+        for b in await self.helper.list_buckets():
+            p = b.params()
+            out.append({
+                "id": bytes(b.id).hex(),
+                "globalAliases": [n for n, l in p.aliases.items.items() if l.value],
+            })
+        return web.json_response(out)
+
+    async def handle_bucket_create(self, request) -> web.Response:
+        self._admin(request)
+        body = json.loads(await request.read())
+        b = await self.helper.create_bucket(body["globalAlias"])
+        return web.json_response({"id": bytes(b.id).hex()})
+
+    async def handle_key_list(self, request) -> web.Response:
+        self._admin(request)
+        return web.json_response([
+            {"id": k.key_id, "name": k.params().name.value}
+            for k in await self.helper.list_keys()
+        ])
+
+    async def handle_key_create(self, request) -> web.Response:
+        self._admin(request)
+        body = json.loads(await request.read() or b"{}")
+        k = await self.helper.create_key(body.get("name", "unnamed"))
+        return web.json_response({
+            "accessKeyId": k.key_id,
+            "secretAccessKey": k.params().secret_key,
+        })
+
+    async def handle_check_domain(self, request) -> web.Response:
+        """/check?domain= — used by reverse proxies to validate website
+        domains (ref api_server.rs handle_check_website)."""
+        domain = request.query.get("domain", "")
+        from .common import host_to_bucket
+
+        bucket_name = host_to_bucket(domain, self.garage.config.web_root_domain) or domain
+        bid = await self.helper.resolve_global_bucket_name(bucket_name)
+        if bid is None:
+            return web.Response(status=404, text="no such bucket")
+        b = await self.helper.get_existing_bucket(bid)
+        if b.params().website_config.value is None:
+            return web.Response(status=404, text="website not enabled")
+        return web.Response(status=200, text="ok")

@@ -111,6 +111,29 @@ def s3_xml_root(tag: str) -> ET.Element:
     return ET.Element(tag, {"xmlns": S3_XMLNS})
 
 
+def iso_timestamp(ts_ms: int) -> str:
+    """ms epoch → S3-style ISO8601 (shared by list/bucket/copy XML)."""
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        ts_ms / 1000, tz=datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+
+
+def int_param(value, name: str, default: Optional[int] = None) -> Optional[int]:
+    """Parse an integer query parameter; malformed → 400 InvalidArgument
+    (not a 500) — S3 clients fuzz these freely."""
+    if value is None:
+        return default
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise ApiError(
+            f"invalid integer for {name}: {value!r}",
+            status=400, code="InvalidArgument",
+        )
+
+
 def host_to_bucket(host: str, root_domain: Optional[str]) -> Optional[str]:
     """vhost-style bucket extraction (ref helpers.rs host_to_bucket):
     `bucket.root_domain` → bucket; bare root_domain or unrelated host →

@@ -143,17 +143,11 @@ class S3ApiServer:
             raise NoSuchBucketError(str(e))
 
     async def _dispatch(self, ctx, endpoint, bucket_name, api_key):
-        from . import bucket as bucket_ops
-        from . import delete as delete_ops
-        from . import get as get_ops
-        from . import list as list_ops
-        from . import multipart as multipart_ops
-        from . import put as put_ops
-
+        handlers = _handlers()
         if endpoint.name == "ListBuckets":
-            return await bucket_ops.handle_list_buckets(ctx)
+            return await handlers["ListBuckets"](ctx)
         if endpoint.name == "CreateBucket":
-            return await bucket_ops.handle_create_bucket(ctx)
+            return await handlers["CreateBucket"](ctx)
 
         # all other endpoints address an existing bucket
         bucket_id = await self.helper.resolve_bucket(bucket_name, api_key)
@@ -171,44 +165,55 @@ class S3ApiServer:
                 f"key {api_key.key_id} lacks {endpoint.authorization} on bucket"
             )
 
-        h = {
-            "HeadBucket": bucket_ops.handle_head_bucket,
-            "DeleteBucket": bucket_ops.handle_delete_bucket,
-            "GetBucketLocation": bucket_ops.handle_get_location,
-            "GetBucketVersioning": bucket_ops.handle_get_versioning,
-            "GetBucketAcl": bucket_ops.handle_get_acl,
-            "ListObjects": list_ops.handle_list_objects,
-            "ListObjectsV2": list_ops.handle_list_objects_v2,
-            "ListMultipartUploads": list_ops.handle_list_multipart_uploads,
-            "ListParts": list_ops.handle_list_parts,
-            "PutObject": put_ops.handle_put_object,
-            "GetObject": get_ops.handle_get_object,
-            "HeadObject": get_ops.handle_head_object,
-            "DeleteObject": delete_ops.handle_delete_object,
-            "DeleteObjects": delete_ops.handle_delete_objects,
-            "CreateMultipartUpload": multipart_ops.handle_create_mpu,
-            "UploadPart": multipart_ops.handle_upload_part,
-            "CompleteMultipartUpload": multipart_ops.handle_complete_mpu,
-            "AbortMultipartUpload": multipart_ops.handle_abort_mpu,
-            "CopyObject": None,
-            "UploadPartCopy": None,
-        }.get(endpoint.name)
-        if h is None:
-            if endpoint.name in ("CopyObject", "UploadPartCopy"):
-                from . import copy as copy_ops
-
-                h = (
-                    copy_ops.handle_copy_object
-                    if endpoint.name == "CopyObject"
-                    else copy_ops.handle_upload_part_copy
-                )
-            else:
-                from . import bucket_config
-
-                h = bucket_config.HANDLERS.get(endpoint.name)
+        h = handlers.get(endpoint.name)
         if h is None:
             raise BadRequestError(f"endpoint {endpoint.name} not implemented")
         return await h(ctx)
+
+
+_HANDLERS = None
+
+
+def _handlers():
+    """Endpoint-name → handler table, built once on first request (the
+    handler modules import api_server, so module-level would cycle)."""
+    global _HANDLERS
+    if _HANDLERS is None:
+        from . import bucket as b
+        from . import bucket_config
+        from . import copy as c
+        from . import delete as d
+        from . import get as g
+        from . import list as l
+        from . import multipart as m
+        from . import put as p
+
+        _HANDLERS = {
+            "ListBuckets": b.handle_list_buckets,
+            "CreateBucket": b.handle_create_bucket,
+            "HeadBucket": b.handle_head_bucket,
+            "DeleteBucket": b.handle_delete_bucket,
+            "GetBucketLocation": b.handle_get_location,
+            "GetBucketVersioning": b.handle_get_versioning,
+            "GetBucketAcl": b.handle_get_acl,
+            "ListObjects": l.handle_list_objects,
+            "ListObjectsV2": l.handle_list_objects_v2,
+            "ListMultipartUploads": l.handle_list_multipart_uploads,
+            "ListParts": l.handle_list_parts,
+            "PutObject": p.handle_put_object,
+            "GetObject": g.handle_get_object,
+            "HeadObject": g.handle_head_object,
+            "DeleteObject": d.handle_delete_object,
+            "DeleteObjects": d.handle_delete_objects,
+            "CreateMultipartUpload": m.handle_create_mpu,
+            "UploadPart": m.handle_upload_part,
+            "CompleteMultipartUpload": m.handle_complete_mpu,
+            "AbortMultipartUpload": m.handle_abort_mpu,
+            "CopyObject": c.handle_copy_object,
+            "UploadPartCopy": c.handle_upload_part_copy,
+            **bucket_config.HANDLERS,
+        }
+    return _HANDLERS
 
 
 class RequestContext:

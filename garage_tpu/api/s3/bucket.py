@@ -8,19 +8,17 @@ applies the key's permissions immediately; deletion requires emptiness
 
 from __future__ import annotations
 
-import datetime
 import xml.etree.ElementTree as ET
 
 from aiohttp import web
 
 from ...model.permission import BucketKeyPerm
-from ..common import AccessDeniedError, s3_xml_root, xml_to_bytes
-
-
-def _iso(ts_ms: int) -> str:
-    return datetime.datetime.fromtimestamp(
-        ts_ms / 1000, tz=datetime.timezone.utc
-    ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+from ..common import (
+    AccessDeniedError,
+    iso_timestamp as _iso,
+    s3_xml_root,
+    xml_to_bytes,
+)
 
 
 async def handle_list_buckets(ctx) -> web.Response:

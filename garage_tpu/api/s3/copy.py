@@ -26,6 +26,7 @@ from ...model.s3.version_table import Version
 from ...utils.crdt import now_msec
 from ...utils.data import Hash, Uuid, block_hash, gen_uuid
 from ..common import (
+    iso_timestamp as _iso,
     AccessDeniedError,
     BadRequestError,
     NoSuchKeyError,
@@ -33,7 +34,6 @@ from ..common import (
     xml_to_bytes,
 )
 from .get import parse_range
-from .list import _iso
 from .multipart import decode_upload_id, get_upload
 
 
@@ -102,8 +102,12 @@ async def handle_copy_object(ctx) -> web.Response:
 
 async def handle_upload_part_copy(ctx) -> web.Response:
     garage = ctx.garage
+    from ..common import int_param
+
     q = ctx.request.query
-    part_number = int(q["partNumber"])
+    part_number = int_param(q.get("partNumber"), "partNumber")
+    if part_number is None or not 1 <= part_number <= 10000:
+        raise BadRequestError("partNumber must be in [1, 10000]")
     upload_id = decode_upload_id(q["uploadId"])
     _ov, mpu = await get_upload(ctx, ctx.key_name, upload_id)
 
@@ -114,7 +118,10 @@ async def handle_upload_part_copy(ctx) -> web.Response:
 
     rng_header = ctx.request.headers.get("x-amz-copy-source-range")
     if rng_header is not None:
-        begin, end = parse_range(rng_header, size)
+        r = parse_range(rng_header, size)
+        if r is None:
+            raise BadRequestError(f"bad x-amz-copy-source-range {rng_header!r}")
+        begin, end = r
     else:
         begin, end = 0, size
 
@@ -164,7 +171,9 @@ async def handle_upload_part_copy(ctx) -> web.Response:
                 await garage.block_manager.rpc_put_block(nh, piece)
                 version.add_block(part_number, out_off, bytes(nh), len(piece))
                 out_off += len(piece)
-            await garage.version_table.insert(version)
+        # single metadata write with the complete block map (a per-block
+        # insert would quorum-write the whole growing map O(n²) times)
+        await garage.version_table.insert(version)
 
     etag = md5.hexdigest()
     mpu.parts[(part_number, ts)] = MpuPart.new(

@@ -30,10 +30,8 @@ PREFETCH = 2  # buffered(2) block prefetch (ref get.rs:458-466)
 async def get_object_version(ctx, key: str):
     """Object row → newest complete data version, else NoSuchKey."""
     obj = await ctx.garage.object_table.get(ctx.bucket_id, key)
-    if obj is None:
-        raise NoSuchKeyError(f"no such key: {key}")
-    last = obj.last_complete_version()
-    if last is None or not last.is_data():
+    last = obj.last_data_version() if obj is not None else None
+    if last is None:
         raise NoSuchKeyError(f"no such key: {key}")
     return obj, last
 
@@ -80,22 +78,28 @@ def check_conditions(ctx, version, meta) -> Optional[int]:
     return None
 
 
-def parse_range(header: str, size: int) -> Tuple[int, int]:
-    """'bytes=a-b' → (begin, end_exclusive) (ref get.rs range parsing)."""
+def parse_range(header: str, size: int) -> Optional[Tuple[int, int]]:
+    """'bytes=a-b' → (begin, end_exclusive).  Returns None for a
+    syntactically malformed header (S3 ignores those and serves the full
+    object); raises InvalidRangeError (416) only for unsatisfiable
+    in-bounds syntax (ref get.rs range parsing)."""
     if not header.startswith("bytes="):
-        raise InvalidRangeError(f"unsupported range unit: {header}")
+        return None
     spec = header[len("bytes="):]
     if "," in spec:
-        raise InvalidRangeError("multiple ranges not supported")
+        return None
     a, _, b = spec.partition("-")
-    if a == "":
-        # suffix range: last N bytes
-        n = int(b)
-        if n == 0:
-            raise InvalidRangeError("zero suffix range")
-        return max(0, size - n), size
-    begin = int(a)
-    end = int(b) + 1 if b != "" else size
+    try:
+        if a == "":
+            # suffix range: last N bytes
+            n = int(b)
+            if n == 0:
+                raise InvalidRangeError("zero suffix range")
+            return max(0, size - n), size
+        begin = int(a)
+        end = int(b) + 1 if b != "" else size
+    except ValueError:
+        return None
     if begin >= size or end > size or begin >= end:
         raise InvalidRangeError(f"range {header} out of bounds for size {size}")
     return begin, end
@@ -109,25 +113,27 @@ async def handle_head_object(ctx) -> web.Response:
         return web.Response(status=status)
     hdrs = object_headers(version, meta)
 
-    part_number = ctx.request.query.get("partNumber")
+    from ..common import int_param
+
+    part_number = int_param(ctx.request.query.get("partNumber"), "partNumber")
     if part_number is not None and version.data()[0] == "inline":
-        if int(part_number) != 1:
+        if part_number != 1:
             raise BadRequestError(f"no such part {part_number}")
         hdrs["Content-Length"] = str(meta["size"])
         hdrs["x-amz-mp-parts-count"] = "1"
         return web.Response(status=206, headers=hdrs)
     if part_number is not None and version.data()[0] == "first_block":
         ver_row = await ctx.garage.version_table.get(version.uuid, "")
-        if ver_row is not None:
-            pn = int(part_number)
-            blocks = [(k, v) for k, v in ver_row.sorted_blocks() if k[0] == pn]
-            if not blocks:
-                raise BadRequestError(f"no such part {pn}")
-            psize = sum(sz for (_k, (_h, sz)) in blocks)
-            nparts = len({k[0] for k, _ in ver_row.sorted_blocks()})
-            hdrs["Content-Length"] = str(psize)
-            hdrs["x-amz-mp-parts-count"] = str(nparts)
-            return web.Response(status=206, headers=hdrs)
+        if ver_row is None:
+            raise NoSuchKeyError("version metadata missing")
+        blocks = [(k, v) for k, v in ver_row.sorted_blocks() if k[0] == part_number]
+        if not blocks:
+            raise BadRequestError(f"no such part {part_number}")
+        psize = sum(sz for (_k, (_h, sz)) in blocks)
+        nparts = len({k[0] for k, _ in ver_row.sorted_blocks()})
+        hdrs["Content-Length"] = str(psize)
+        hdrs["x-amz-mp-parts-count"] = str(nparts)
+        return web.Response(status=206, headers=hdrs)
     hdrs["Content-Length"] = str(meta["size"])
     return web.Response(status=200, headers=hdrs)
 
@@ -144,8 +150,10 @@ async def handle_get_object(ctx) -> web.StreamResponse:
     data = version.data()
 
     # range / partNumber selection
+    from ..common import int_param
+
     rng = ctx.request.headers.get("Range")
-    part_number = ctx.request.query.get("partNumber")
+    part_number = int_param(ctx.request.query.get("partNumber"), "partNumber")
     if rng is not None and part_number is not None:
         raise BadRequestError("cannot combine Range and partNumber")
 
@@ -153,15 +161,17 @@ async def handle_get_object(ctx) -> web.StreamResponse:
         body = bytes(data[2])
         if part_number is not None:
             # inline objects behave as a single part
-            if int(part_number) != 1:
+            if part_number != 1:
                 raise BadRequestError(f"no such part {part_number}")
             hdrs["Content-Range"] = f"bytes 0-{max(0, len(body)-1)}/{len(body)}"
             hdrs["x-amz-mp-parts-count"] = "1"
             return web.Response(status=206, headers=hdrs, body=body)
         if rng is not None:
-            begin, end = parse_range(rng, len(body))
-            hdrs["Content-Range"] = f"bytes {begin}-{end-1}/{len(body)}"
-            return web.Response(status=206, headers=hdrs, body=body[begin:end])
+            r = parse_range(rng, len(body))
+            if r is not None:
+                begin, end = r
+                hdrs["Content-Range"] = f"bytes {begin}-{end-1}/{len(body)}"
+                return web.Response(status=206, headers=hdrs, body=body[begin:end])
         return web.Response(status=200, headers=hdrs, body=body)
 
     ver_row = await garage.version_table.get(version.uuid, "")
@@ -170,11 +180,10 @@ async def handle_get_object(ctx) -> web.StreamResponse:
     blocks = ver_row.sorted_blocks()  # [((part, off), (hash, size))]
 
     if part_number is not None:
-        pn = int(part_number)
-        pblocks = [(k, v) for k, v in blocks if k[0] == pn]
+        pblocks = [(k, v) for k, v in blocks if k[0] == part_number]
         if not pblocks:
-            raise BadRequestError(f"no such part {pn}")
-        begin = _part_offset(blocks, pn)
+            raise BadRequestError(f"no such part {part_number}")
+        begin = _part_offset(blocks, part_number)
         plen = sum(sz for (_k, (_h, sz)) in pblocks)
         end = begin + plen
         hdrs["Content-Range"] = f"bytes {begin}-{end-1}/{size}"
@@ -182,9 +191,11 @@ async def handle_get_object(ctx) -> web.StreamResponse:
         return await _stream_blocks_range(ctx, hdrs, 206, blocks, begin, end)
 
     if rng is not None:
-        begin, end = parse_range(rng, size)
-        hdrs["Content-Range"] = f"bytes {begin}-{end-1}/{size}"
-        return await _stream_blocks_range(ctx, hdrs, 206, blocks, begin, end)
+        r = parse_range(rng, size)
+        if r is not None:
+            begin, end = r
+            hdrs["Content-Range"] = f"bytes {begin}-{end-1}/{size}"
+            return await _stream_blocks_range(ctx, hdrs, 206, blocks, begin, end)
 
     return await _stream_blocks_range(ctx, hdrs, 200, blocks, 0, size)
 

@@ -11,21 +11,20 @@ from the MPU row.
 from __future__ import annotations
 
 import base64
-import datetime
 import xml.etree.ElementTree as ET
 from typing import List, Optional, Tuple
 
 from aiohttp import web
 
-from ..common import BadRequestError, s3_xml_root, xml_to_bytes
+from ..common import (
+    BadRequestError,
+    int_param,
+    iso_timestamp as _iso,
+    s3_xml_root,
+    xml_to_bytes,
+)
 
 PAGE = 1000
-
-
-def _iso(ts_ms: int) -> str:
-    return datetime.datetime.fromtimestamp(
-        ts_ms / 1000, tz=datetime.timezone.utc
-    ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
 
 
 def _after_prefix(p: str) -> str:
@@ -91,14 +90,14 @@ async def _collect(
                     if len(entries) + len(prefixes) >= max_keys:
                         return entries, prefixes, True, last_returned
                     prefixes.append(cp)
-                    last_returned = cp
+                    last_returned = ("cp", cp)
                     pos, jumped = _after_prefix(cp), True
                     break
             if len(entries) + len(prefixes) >= max_keys:
                 return entries, prefixes, True, last_returned
             for v in relevant:
                 entries.append((k, v))
-            last_returned = k
+            last_returned = ("key", k)
         if jumped:
             continue
         if len(batch) < PAGE:
@@ -111,7 +110,7 @@ async def handle_list_objects(ctx) -> web.Response:
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter") or None
     marker = q.get("marker") or None
-    max_keys = max(0, min(int(q.get("max-keys", "1000")), 1000))
+    max_keys = max(0, min(int_param(q.get("max-keys"), "max-keys", 1000), 1000))
     pos = (marker + "\x00") if marker is not None else None
 
     entries, prefixes, truncated, last = await _collect(
@@ -127,7 +126,7 @@ async def handle_list_objects(ctx) -> web.Response:
     ET.SubElement(out, "MaxKeys").text = str(max_keys)
     ET.SubElement(out, "IsTruncated").text = "true" if truncated else "false"
     if truncated and last is not None:
-        ET.SubElement(out, "NextMarker").text = last
+        ET.SubElement(out, "NextMarker").text = last[1]
     _append_contents(out, entries, prefixes)
     return web.Response(
         status=200, body=xml_to_bytes(out), content_type="application/xml"
@@ -138,20 +137,21 @@ async def handle_list_objects_v2(ctx) -> web.Response:
     q = ctx.request.query
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter") or None
-    max_keys = max(0, min(int(q.get("max-keys", "1000")), 1000))
+    max_keys = max(0, min(int_param(q.get("max-keys"), "max-keys", 1000), 1000))
     token = q.get("continuation-token")
     start_after = q.get("start-after")
     marker = None
     if token is not None:
         try:
-            # token encodes (last_returned) — resume exclusively after it
-            marker = base64.urlsafe_b64decode(token.encode()).decode()
+            decoded = base64.urlsafe_b64decode(token.encode()).decode()
+            kind, sep, marker = decoded.partition(":")
+            if kind not in ("key", "cp") or not sep:
+                raise ValueError(decoded)
         except Exception:
             raise BadRequestError("bad continuation-token")
-        pos = marker + "\x00"
-        # a common-prefix marker means resume past the whole prefix
-        if delimiter and marker.endswith(delimiter):
-            pos = _after_prefix(marker)
+        # resume exclusively after the last returned item: past the whole
+        # prefix if it was a common prefix, just after the key otherwise
+        pos = _after_prefix(marker) if kind == "cp" else marker + "\x00"
     elif start_after is not None:
         marker = start_after
         pos = start_after + "\x00"
@@ -174,8 +174,12 @@ async def handle_list_objects_v2(ctx) -> web.Response:
     if start_after is not None:
         ET.SubElement(out, "StartAfter").text = start_after
     if truncated and last is not None:
+        # the token records WHAT the last item was (key vs common prefix)
+        # so resumption can't conflate a key that merely ends with the
+        # delimiter with a completed prefix
+        kind, value = last
         ET.SubElement(out, "NextContinuationToken").text = (
-            base64.urlsafe_b64encode(last.encode()).decode()
+            base64.urlsafe_b64encode(f"{kind}:{value}".encode()).decode()
         )
     _append_contents(out, entries, prefixes)
     return web.Response(
@@ -200,7 +204,7 @@ async def handle_list_multipart_uploads(ctx) -> web.Response:
     q = ctx.request.query
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter") or None
-    max_uploads = max(0, min(int(q.get("max-uploads", "1000")), 1000))
+    max_uploads = max(0, min(int_param(q.get("max-uploads"), "max-uploads", 1000), 1000))
     key_marker = q.get("key-marker") or None
     pos = (key_marker + "\x00") if key_marker is not None else None
 
@@ -217,7 +221,7 @@ async def handle_list_multipart_uploads(ctx) -> web.Response:
     ET.SubElement(out, "MaxUploads").text = str(max_uploads)
     ET.SubElement(out, "IsTruncated").text = "true" if truncated else "false"
     if truncated and last is not None:
-        ET.SubElement(out, "NextKeyMarker").text = last
+        ET.SubElement(out, "NextKeyMarker").text = last[1]
     for key, v in entries:
         u = ET.SubElement(out, "Upload")
         ET.SubElement(u, "Key").text = key
@@ -237,8 +241,8 @@ async def handle_list_parts(ctx) -> web.Response:
 
     q = ctx.request.query
     upload_id = q.get("uploadId", "")
-    max_parts = max(0, min(int(q.get("max-parts", "1000")), 1000))
-    pmarker = int(q.get("part-number-marker", "0"))
+    max_parts = max(0, min(int_param(q.get("max-parts"), "max-parts", 1000), 1000))
+    pmarker = int_param(q.get("part-number-marker"), "part-number-marker", 0)
 
     mpu = await get_existing_mpu(ctx, upload_id)
     out = s3_xml_root("ListPartsResult")

@@ -96,9 +96,11 @@ async def handle_create_mpu(ctx) -> web.Response:
 async def handle_upload_part(ctx) -> web.Response:
     garage = ctx.garage
     key = ctx.key_name
+    from ..common import int_param
+
     q = ctx.request.query
-    part_number = int(q["partNumber"])
-    if not 1 <= part_number <= 10000:
+    part_number = int_param(q.get("partNumber"), "partNumber")
+    if part_number is None or not 1 <= part_number <= 10000:
         raise BadRequestError("partNumber must be in [1, 10000]")
     upload_id = decode_upload_id(q["uploadId"])
     _ov, mpu = await get_upload(ctx, key, upload_id)
@@ -179,6 +181,12 @@ async def handle_complete_mpu(ctx) -> web.Response:
             raise InvalidPartError(f"part {pn} not found or etag mismatch")
         chosen.append((pn, p))
 
+    total_size = sum(p["size"] for _pn, p in chosen)
+    # quota check FIRST, before any final-version metadata exists: on
+    # failure the upload stays intact and retryable (the reference aborts
+    # the whole upload here, destroying all parts — deliberately kinder)
+    await check_quotas(ctx, total_size, key)
+
     # splice part blocks into the final version, renumbered 1..N
     # (multipart.rs:286-309)
     final_version = Version(upload_id, bytes(ctx.bucket_id), key)
@@ -200,14 +208,6 @@ async def handle_complete_mpu(ctx) -> web.Response:
         except ValueError:
             md5.update(p["etag"].encode())
     etag = f"{md5.hexdigest()}-{len(chosen)}"
-    total_size = sum(p["size"] for _pn, p in chosen)
-
-    try:
-        await check_quotas(ctx, total_size, key)
-    except ApiError:
-        ov_abort = ObjectVersion(upload_id, ov.timestamp, ["aborted"])
-        await garage.object_table.insert(Object(ctx.bucket_id, key, [ov_abort]))
-        raise
 
     blocks = final_version.sorted_blocks()
     meta = ObjectVersionMeta.new(ov.state[2], total_size, etag)

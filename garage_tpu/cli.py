@@ -1,0 +1,364 @@
+"""Command-line interface.
+
+Equivalent of reference src/garage/main.rs + cli/ (SURVEY.md §2.9):
+`server` runs the daemon; every other command connects to a running node
+over the RPC fabric with a temporary keypair + the rpc secret from the
+config file (main.rs:194-263) and drives the AdminRpcHandler.
+
+Usage:  python -m garage_tpu <command> [args]  (-c/--config garage.toml)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+from typing import Any, Dict, Optional
+
+from .utils.format_table import format_table
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="garage_tpu")
+    p.add_argument("-c", "--config", default=os.environ.get(
+        "GARAGE_TPU_CONFIG", "./garage.toml"
+    ))
+    p.add_argument("--rpc-host", default=None,
+                   help="node RPC address (default: rpc_bind_addr from config)")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("server", help="run the storage daemon")
+    sub.add_parser("node-id", help="print this node's id")
+    sub.add_parser("status", help="cluster status")
+    sub.add_parser("stats", help="node statistics")
+
+    pc = sub.add_parser("connect", help="connect to a peer (id@host:port)")
+    pc.add_argument("peer")
+
+    pl = sub.add_parser("layout", help="cluster layout operations")
+    ls = pl.add_subparsers(dest="layout_cmd", required=True)
+    la = ls.add_parser("assign")
+    la.add_argument("node")
+    la.add_argument("-z", "--zone", required=True)
+    la.add_argument("-c", "--capacity", default=None,
+                    help="storage capacity (e.g. 10G); omit for gateway")
+    la.add_argument("-t", "--tags", default="")
+    lr = ls.add_parser("remove")
+    lr.add_argument("node")
+    ls.add_parser("show")
+    lap = ls.add_parser("apply")
+    lap.add_argument("--version", type=int, default=None)
+    lrv = ls.add_parser("revert")
+    lrv.add_argument("--version", type=int, default=None)
+
+    pb = sub.add_parser("bucket", help="bucket operations")
+    bs = pb.add_subparsers(dest="bucket_cmd", required=True)
+    bs.add_parser("list")
+    for name, extra in [
+        ("info", []), ("create", []), ("delete", []),
+    ]:
+        x = bs.add_parser(name)
+        x.add_argument("bucket")
+    ba = bs.add_parser("alias")
+    ba.add_argument("bucket")
+    ba.add_argument("alias")
+    bu = bs.add_parser("unalias")
+    bu.add_argument("alias")
+    for name in ("allow", "deny"):
+        x = bs.add_parser(name)
+        x.add_argument("bucket")
+        x.add_argument("--key", required=True)
+        x.add_argument("--read", action="store_true")
+        x.add_argument("--write", action="store_true")
+        x.add_argument("--owner", action="store_true")
+    bw = bs.add_parser("website")
+    bw.add_argument("bucket")
+    bw.add_argument("--allow", action="store_true")
+    bw.add_argument("--deny", action="store_true")
+    bw.add_argument("--index-document", default="index.html")
+    bw.add_argument("--error-document", default=None)
+    bq = bs.add_parser("set-quotas")
+    bq.add_argument("bucket")
+    bq.add_argument("--max-size", default=None)
+    bq.add_argument("--max-objects", type=int, default=None)
+
+    pk = sub.add_parser("key", help="API key operations")
+    ks = pk.add_subparsers(dest="key_cmd", required=True)
+    ks.add_parser("list")
+    ki = ks.add_parser("info")
+    ki.add_argument("key")
+    ki.add_argument("--show-secret", action="store_true")
+    kc = ks.add_parser("create")
+    kc.add_argument("name", nargs="?", default="unnamed")
+    kd = ks.add_parser("delete")
+    kd.add_argument("key")
+    km = ks.add_parser("import")
+    km.add_argument("id")
+    km.add_argument("secret")
+    km.add_argument("--name", default="imported")
+    kset = ks.add_parser("set")
+    kset.add_argument("key")
+    kset.add_argument("--allow-create-bucket", dest="acb", action="store_true")
+    kset.add_argument("--deny-create-bucket", dest="dcb", action="store_true")
+    kset.add_argument("--name", default=None)
+
+    pr = sub.add_parser("repair", help="launch repair operations")
+    pr.add_argument("what", choices=[
+        "tables", "blocks", "versions", "block_refs", "rebalance", "scrub",
+    ])
+    pr.add_argument("--cmd", default="start",
+                    choices=["start", "pause", "resume", "cancel"])
+
+    pw = sub.add_parser("worker", help="background worker operations")
+    ws = pw.add_subparsers(dest="worker_cmd", required=True)
+    ws.add_parser("list")
+    wg = ws.add_parser("get")
+    wg.add_argument("var", nargs="?", default=None)
+    wst = ws.add_parser("set")
+    wst.add_argument("var")
+    wst.add_argument("value")
+    return p
+
+
+class AdminClient:
+    """CLI-side RPC client: temp keypair + rpc secret (ref main.rs:194-263)."""
+
+    def __init__(self, config_path: str, rpc_host: Optional[str]):
+        from .utils.config import read_config
+
+        self.config = read_config(config_path)
+        addr = rpc_host or self.config.rpc_bind_addr
+        host, port = addr.rsplit(":", 1)
+        if host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"
+        self.addr = f"{host}:{port}"
+
+    async def call(self, msg: Dict[str, Any]) -> Any:
+        from .net import NetApp
+        from .net.netapp import gen_node_key
+
+        netapp = NetApp(gen_node_key(), self.config.rpc_secret)
+        endpoint = netapp.endpoint("garage/admin")
+        try:
+            conn = await netapp.connect(self.addr)
+            resp = await endpoint.call(conn.remote_id, msg, timeout=60.0)
+            if resp.get("err"):
+                print(f"error: {resp['err']}", file=sys.stderr)
+                sys.exit(1)
+            return resp.get("ok")
+        finally:
+            await netapp.shutdown()
+
+
+def _perm_str(p) -> str:
+    return "".join(c if f else "-" for c, f in zip("RWO", p))
+
+
+async def _amain(args) -> None:
+    if args.command == "server":
+        from .server import run_server
+
+        await run_server(args.config)
+        return
+
+    if args.command == "node-id":
+        from .utils.config import read_config
+        from .net.netapp import load_or_gen_node_key, node_id_of
+
+        cfg = read_config(args.config)
+        key = load_or_gen_node_key(os.path.join(cfg.metadata_dir, "node_key"))
+        nid = node_id_of(key).hex()
+        addr = cfg.rpc_public_addr or cfg.rpc_bind_addr
+        print(f"{nid}@{addr}")
+        return
+
+    client = AdminClient(args.config, args.rpc_host)
+
+    if args.command == "status":
+        st = await client.call({"cmd": "status"})
+        h = st["health"]
+        print(f"==== Node: {st['node_id'][:16]}… ({st['hostname']}) ====")
+        print(f"Cluster health: {h['status']}  "
+              f"(nodes {h['connected_nodes']}/{h['known_nodes']} connected, "
+              f"partitions {h['partitions_all_ok']}/{h['partitions']} all-ok, "
+              f"{h['partitions_quorum']}/{h['partitions']} quorum)")
+        print(f"Layout version: {st['layout_version']}")
+        rows = ["ID\tZONE\tCAPACITY\tTAGS\tSTATUS"]
+        known = {n["id"]: n for n in st["known_nodes"]}
+        for nid, (zone, cap, tags) in sorted(st["roles"].items()):
+            k = known.get(nid, {})
+            up = "up" if k.get("is_up") else "down"
+            cap_s = str(cap) if cap is not None else "gateway"
+            rows.append(f"{nid[:16]}…\t{zone}\t{cap_s}\t{','.join(tags)}\t{up}")
+        print(format_table(rows))
+        if any(v is not None for v in st["staged"].values()) or st["staged"]:
+            staged = {k: v for k, v in st["staged"].items()}
+            if staged:
+                print("\n==== Staged changes ====")
+                for nid, r in staged.items():
+                    print(f"  {nid[:16]}… → {r}")
+                print("Use `layout apply` to activate.")
+        return
+
+    if args.command == "stats":
+        print(json.dumps(await client.call({"cmd": "stats"}), indent=2))
+        return
+
+    if args.command == "connect":
+        if "@" in args.peer:
+            nid, addr = args.peer.split("@", 1)
+        else:
+            nid, addr = None, args.peer
+        print(await client.call({"cmd": "connect", "addr": addr, "node_id": nid}))
+        return
+
+    if args.command == "layout":
+        lc = args.layout_cmd
+        if lc == "assign":
+            from .utils.config import parse_capacity
+
+            cap = parse_capacity(args.capacity) if args.capacity else None
+            tags = [t for t in args.tags.split(",") if t]
+            print(await client.call({
+                "cmd": "layout_assign", "node": args.node,
+                "zone": args.zone, "capacity": cap, "tags": tags,
+            }))
+        elif lc == "remove":
+            print(await client.call({
+                "cmd": "layout_assign", "node": args.node, "remove": True,
+            }))
+        elif lc == "show":
+            st = await client.call({"cmd": "status"})
+            print(json.dumps({"roles": st["roles"], "staged": st["staged"],
+                              "version": st["layout_version"]}, indent=2))
+        elif lc == "apply":
+            for m in await client.call({"cmd": "layout_apply", "version": args.version}):
+                print(m)
+        elif lc == "revert":
+            print(await client.call({"cmd": "layout_revert", "version": args.version}))
+        return
+
+    if args.command == "bucket":
+        bc = args.bucket_cmd
+        if bc == "list":
+            rows = ["ID\tALIASES\tKEYS"]
+            for b in await client.call({"cmd": "bucket_list"}):
+                rows.append(f"{b['id'][:16]}…\t{','.join(b['aliases'])}\t{b['keys']}")
+            print(format_table(rows))
+        elif bc == "info":
+            print(json.dumps(await client.call(
+                {"cmd": "bucket_info", "bucket": args.bucket}), indent=2))
+        elif bc == "create":
+            print(await client.call({"cmd": "bucket_create", "name": args.bucket}))
+        elif bc == "delete":
+            print(await client.call({"cmd": "bucket_delete", "bucket": args.bucket}))
+        elif bc == "alias":
+            print(await client.call({
+                "cmd": "bucket_alias", "bucket": args.bucket, "alias": args.alias,
+            }))
+        elif bc == "unalias":
+            print(await client.call({"cmd": "bucket_unalias", "alias": args.alias}))
+        elif bc in ("allow", "deny"):
+            print(await client.call({
+                "cmd": f"bucket_{bc}", "bucket": args.bucket, "key": args.key,
+                "read": args.read, "write": args.write, "owner": args.owner,
+            }))
+        elif bc == "website":
+            print(await client.call({
+                "cmd": "bucket_website", "bucket": args.bucket,
+                "allow": args.allow and not args.deny,
+                "index_document": args.index_document,
+                "error_document": args.error_document,
+            }))
+        elif bc == "set-quotas":
+            from .utils.config import parse_capacity
+
+            print(await client.call({
+                "cmd": "bucket_set_quotas", "bucket": args.bucket,
+                "max_size": parse_capacity(args.max_size) if args.max_size else None,
+                "max_objects": args.max_objects,
+            }))
+        return
+
+    if args.command == "key":
+        kc = args.key_cmd
+        if kc == "list":
+            rows = ["ID\tNAME"]
+            for k in await client.call({"cmd": "key_list"}):
+                rows.append(f"{k['id']}\t{k['name']}")
+            print(format_table(rows))
+        elif kc == "info":
+            print(json.dumps(await client.call({
+                "cmd": "key_info", "key": args.key,
+                "show_secret": args.show_secret,
+            }), indent=2))
+        elif kc == "create":
+            k = await client.call({"cmd": "key_create", "name": args.name})
+            print(f"Key ID:     {k['id']}\nSecret key: {k['secret']}")
+        elif kc == "delete":
+            print(await client.call({"cmd": "key_delete", "key": args.key}))
+        elif kc == "import":
+            print(await client.call({
+                "cmd": "key_import", "id": args.id, "secret": args.secret,
+                "name": args.name,
+            }))
+        elif kc == "set":
+            msg = {"cmd": "key_set", "key": args.key}
+            if args.acb:
+                msg["allow_create_bucket"] = True
+            if args.dcb:
+                msg["allow_create_bucket"] = False
+            if args.name:
+                msg["name"] = args.name
+            print(await client.call(msg))
+        return
+
+    if args.command == "repair":
+        print(await client.call({
+            "cmd": "launch_repair", "what": args.what, "scrub_cmd": args.cmd,
+        }))
+        return
+
+    if args.command == "worker":
+        wc = args.worker_cmd
+        if wc == "list":
+            rows = ["ID\tNAME\tSTATE\tERRORS\tQUEUE\tPROGRESS"]
+            for w in await client.call({"cmd": "worker_list"}):
+                rows.append(
+                    f"{w['id']}\t{w['name']}\t{w['state']}\t{w['errors']}"
+                    f"\t{w['queue_length'] if w['queue_length'] is not None else '-'}"
+                    f"\t{w['progress'] or '-'}"
+                )
+            print(format_table(rows))
+        elif wc == "get":
+            print(json.dumps(await client.call(
+                {"cmd": "worker_get_var", "var": args.var}), indent=2))
+        elif wc == "set":
+            v: Any = args.value
+            try:
+                v = int(args.value)
+            except ValueError:
+                pass
+            print(await client.call({
+                "cmd": "worker_set_var", "var": args.var, "value": v,
+            }))
+        return
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=os.environ.get("GARAGE_TPU_LOG", "INFO"),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    args = _build_parser().parse_args()
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

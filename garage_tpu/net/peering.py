@@ -109,6 +109,12 @@ class FullMeshPeering:
 
     def _on_disconnected(self, node: NodeID):
         logger.debug("disconnected from %s", node.hex_short())
+        # an inbound peer we know no address for (e.g. a CLI client with a
+        # temp keypair) can never be redialed — forget it so transient
+        # connections don't accumulate in the peer book / health counts
+        st = self.peers.get(node)
+        if st is not None and st.addr is None:
+            del self.peers[node]
 
     async def _run(self):
         """Main loop: every PING_INTERVAL, (re)dial missing peers and ping

@@ -165,6 +165,13 @@ class System:
         msg = {"t": "advertise_layout", "layout": self.layout.encode()}
         await self.rpc.broadcast(self.endpoint, msg, prio=PRIO_HIGH, timeout=10.0)
 
+    def save_layout(self):
+        """Persist the current (possibly staged) layout (admin path)."""
+        self._layout_persister.save(self.layout)
+
+    async def broadcast_layout(self):
+        await self._push_layout()
+
     # --- status gossip ---
 
     def _local_status(self) -> NodeStatus:
@@ -281,24 +288,25 @@ class System:
         )
 
     def get_known_nodes(self) -> List[dict]:
+        """Peer list for status displays (ids as hex, JSON-safe)."""
         out = [{
-            "id": bytes(self.id),
+            "id": bytes(self.id).hex(),
             "addr": self.config.rpc_public_addr or self.config.rpc_bind_addr,
             "is_up": True,
             "last_seen_secs_ago": 0,
-            "status": self._local_status().pack(),
+            "hostname": self._local_status().hostname,
         }]
         now = time.monotonic()
         for nid, st in self.peering.peers.items():
             status = self.node_status.get(nid)
             out.append({
-                "id": bytes(nid),
+                "id": bytes(nid).hex(),
                 "addr": st.addr,
                 "is_up": st.is_up,
                 "last_seen_secs_ago": (
                     int(now - st.last_seen) if st.last_seen else None
                 ),
-                "status": status.pack() if status else None,
+                "hostname": status.hostname if status else None,
             })
         return out
 

@@ -1,0 +1,146 @@
+"""Static website server.
+
+Equivalent of reference src/web/web_server.rs (SURVEY.md §2.8): the Host
+header maps to a bucket via `web_root_domain` (or is used verbatim as a
+bucket name); objects are served through the same GetObject path with the
+bucket's index/error documents and CORS rules applied
+(web_server.rs:70-75+).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..api.common import host_to_bucket
+from ..api.s3.bucket_config import apply_cors_headers, find_matching_cors_rule
+
+logger = logging.getLogger("garage_tpu.web")
+
+
+class WebServer:
+    def __init__(self, garage):
+        self.garage = garage
+        self.helper = garage.helper()
+        self.root_domain = garage.config.web_root_domain
+        self._runner: Optional[web.AppRunner] = None
+        self.request_counter = 0
+        self.error_counter = 0
+
+    async def start(self, bind_addr: str) -> None:
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self.handle_request)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        host, port = bind_addr.rsplit(":", 1)
+        self._site = web.TCPSite(self._runner, host, int(port))
+        await self._site.start()
+        logger.info("web server listening on %s", bind_addr)
+
+    @property
+    def port(self) -> int:
+        return self._site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def handle_request(self, request: web.Request) -> web.StreamResponse:
+        self.request_counter += 1
+        host = request.headers.get("Host", "")
+        bucket_name = host_to_bucket(host, self.root_domain) or host.split(":")[0]
+        try:
+            return await self._serve(request, bucket_name)
+        except web.HTTPException:
+            raise
+        except Exception:
+            self.error_counter += 1
+            logger.exception("web request failed")
+            return web.Response(status=500, text="internal error")
+
+    async def _serve(self, request, bucket_name: str) -> web.StreamResponse:
+        bid = await self.helper.resolve_global_bucket_name(bucket_name)
+        if bid is None:
+            return web.Response(status=404, text="no such website")
+        bucket = await self.helper.get_existing_bucket(bid)
+        wc = bucket.params().website_config.value
+        if wc is None:
+            return web.Response(status=404, text="website not enabled on this bucket")
+
+        key = request.path.lstrip("/")
+        # directory-style keys resolve to the index document
+        if key == "" or key.endswith("/"):
+            key = key + wc.get("index_document", "index.html")
+
+        cors_rules = bucket.params().cors_config.value
+        origin = request.headers.get("Origin")
+
+        if request.method == "OPTIONS":
+            req_method = request.headers.get(
+                "Access-Control-Request-Method", "GET"
+            )
+            req_headers = [
+                h.strip()
+                for h in request.headers.get(
+                    "Access-Control-Request-Headers", ""
+                ).split(",")
+                if h.strip()
+            ]
+            rule = find_matching_cors_rule(cors_rules, req_method, origin, req_headers)
+            if rule is None:
+                return web.Response(status=403, text="CORS forbidden")
+            hdrs = {
+                "Access-Control-Allow-Methods": ", ".join(rule["allow_methods"]),
+                "Access-Control-Allow-Headers": ", ".join(rule.get("allow_headers", [])) or "*",
+            }
+            if rule.get("max_age_seconds"):
+                hdrs["Access-Control-Max-Age"] = str(rule["max_age_seconds"])
+            apply_cors_headers(hdrs, rule, origin)
+            return web.Response(status=200, headers=hdrs)
+
+        if request.method not in ("GET", "HEAD"):
+            return web.Response(status=405, text="method not allowed")
+
+        resp = await self._get_object(request, bid, key)
+        if resp.status == 404:
+            # error document, still with 404 status (web_server.rs)
+            err_key = wc.get("error_document")
+            if err_key:
+                err_resp = await self._get_object(request, bid, err_key)
+                if err_resp.status == 200:
+                    err_resp.set_status(404)
+                    return err_resp
+        if origin is not None and cors_rules:
+            rule = find_matching_cors_rule(cors_rules, request.method, origin, [])
+            if rule is not None and isinstance(resp, web.Response):
+                hdrs = dict(resp.headers)
+                apply_cors_headers(hdrs, rule, origin)
+                for k, v in hdrs.items():
+                    resp.headers[k] = v
+        return resp
+
+    async def _get_object(self, request, bucket_id, key: str) -> web.StreamResponse:
+        """Serve one object via the S3 read internals (no auth — websites
+        are public reads, ref web_server.rs serve_file)."""
+        from ..api.common import ApiError
+        from ..api.s3 import get as get_ops
+
+        class _Ctx:
+            garage = self.garage
+            key_name = key
+
+            def __init__(self):
+                self.request = request
+                self.bucket_id = bucket_id
+
+        ctx = _Ctx()
+        try:
+            if request.method == "HEAD":
+                return await get_ops.handle_head_object(ctx)
+            return await get_ops.handle_get_object(ctx)
+        except ApiError as e:
+            if e.status == 404:
+                return web.Response(status=404, text="not found")
+            return web.Response(status=e.status, text=str(e))

@@ -224,6 +224,40 @@ async def test_delete_and_list(tmp_path):
     await stop_all(garages, server)
 
 
+async def test_list_v2_token_key_vs_prefix(tmp_path):
+    """A key that merely ends with the delimiter (folder placeholder) must
+    not be treated as a completed common prefix when resuming."""
+    garages, server, client, key = await make_api_cluster(tmp_path)
+    await client.req("PUT", "/tok")
+    for k in ["photos/", "photos/a", "photos/b"]:
+        st, _, _ = await client.req("PUT", f"/tok/{k}", body=b"x")
+        assert st == 200
+    # page 1: prefix=photos/, delimiter none... use no delimiter so the
+    # placeholder key itself is returned first
+    status, _, body = await client.req(
+        "GET", "/tok",
+        query=[("list-type", "2"), ("prefix", "photos/"), ("max-keys", "1")],
+    )
+    root = ET.fromstring(body)
+    ns = root.tag[: root.tag.index("}") + 1]
+    assert root.findtext(f"{ns}IsTruncated") == "true"
+    keys1 = [c.findtext(f"{ns}Key") for c in root.findall(f"{ns}Contents")]
+    assert keys1 == ["photos/"]
+    token = root.findtext(f"{ns}NextContinuationToken")
+    # page 2 with a delimiter — the token marks a KEY, so photos/a and
+    # photos/b must still be enumerated (as members of cp photos/? no —
+    # prefix is photos/, delimiter /, so they are plain keys)
+    status, _, body = await client.req(
+        "GET", "/tok",
+        query=[("list-type", "2"), ("prefix", "photos/"), ("delimiter", "/"),
+               ("continuation-token", token)],
+    )
+    root = ET.fromstring(body)
+    keys2 = [c.findtext(f"{ns}Key") for c in root.findall(f"{ns}Contents")]
+    assert keys2 == ["photos/a", "photos/b"], keys2
+    await stop_all(garages, server)
+
+
 async def test_multipart(tmp_path):
     import os as _os
 
