@@ -1,11 +1,17 @@
 """Benchmark: scrub + RS(8,4) throughput, TPU codec vs CPU baseline.
 
-Per BASELINE.md the project metric is scrub+RS(8,4)-repair GiB/s over 1 MiB
+Per BASELINE.md the project metric is scrub+RS(8,4) GiB/s over 1 MiB
 blocks (the reference's scrub is a sequential per-block CPU verify,
-ref src/block/repair.rs:438-490).  This bench runs the batched scrub step —
-BLAKE2s-256 integrity verify + Reed-Solomon(8,4) parity encode — over the
-same data on both backends and reports TPU GiB/s with vs_baseline = ratio
-over the CPU codec on this host.
+ref src/block/repair.rs:438-490).  The TPU path runs the FUSED scrub step
+— BLAKE2s-256 integrity verify + Reed-Solomon(8,4) parity encode in one
+device dispatch per batch — and PIPELINES batches (async dispatch, one
+sync at the end): the accelerator sits behind a high-latency tunnel, so
+steady-state throughput requires keeping several batches in flight, which
+is exactly how the scrub worker feeds the codec.
+
+The CPU baseline is the same work through CpuCodec (hashlib + native C++
+GF kernel) on this host — what the reference's architecture does with
+the same machine minus the TPU.
 
 Prints ONE JSON line:
   {"metric": "scrub_rs84_throughput", "value": <tpu GiB/s>, "unit": "GiB/s",
@@ -14,66 +20,101 @@ Prints ONE JSON line:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import time
+import traceback
 
 import numpy as np
 
+BLOCK = 1 << 20          # 1 MiB, the reference's default block size
+K, M = 8, 4
+BATCH = 256              # blocks per device batch (256 MiB)
+N_DISTINCT = 2           # distinct host batches cycled (host RAM bound)
+N_BATCHES = 8            # total batches per timed run (2 GiB)
 
-def _timeit(fn, *, warmup: int = 1, iters: int = 3) -> float:
-    for _ in range(warmup):
-        fn()
+
+def make_batches(rng):
+    batches = []
+    for _ in range(N_DISTINCT):
+        arr = rng.integers(0, 256, (BATCH, BLOCK), dtype=np.uint8)
+        lengths = np.full((BATCH,), BLOCK, dtype=np.int32)
+        expected = np.stack([
+            np.frombuffer(
+                hashlib.blake2s(arr[i].tobytes(), digest_size=32).digest(),
+                dtype="<u4",
+            )
+            for i in range(BATCH)
+        ])
+        batches.append((arr, lengths, expected))
+    return batches
+
+
+def bench_tpu(batches) -> float:
+    import jax
+
+    from garage_tpu.ops import make_codec
+
+    codec = make_codec("tpu", rs_data=K, rs_parity=M, batch_blocks=BATCH)
+
+    def sync(res):
+        # force completion of the whole dispatch chain (block_until_ready
+        # returns at enqueue time behind the tunnel; a D2H get does not)
+        return jax.device_get(res[2])
+
+    # warmup: compile + one dispatch
+    sync(codec.scrub_encode_submit(*batches[0]))
+
     t0 = time.perf_counter()
-    for _ in range(iters):
-        fn()
-    return (time.perf_counter() - t0) / iters
+    res = None
+    for i in range(N_BATCHES):
+        arr, lengths, expected = batches[i % N_DISTINCT]
+        res = codec.scrub_encode_submit(arr, lengths, expected)
+    nbad = sync(res)
+    dt = time.perf_counter() - t0
+    assert int(nbad) == 0, "unexpected corruption reported"
+    return N_BATCHES * BATCH * BLOCK / dt / 2**30
+
+
+def bench_cpu(batches) -> float:
+    from garage_tpu.ops import make_codec
+    from garage_tpu.utils.data import Hash
+
+    codec = make_codec("cpu", rs_data=K, rs_parity=M, batch_blocks=BATCH)
+    arr, _lengths, expected = batches[0]
+    blocks = [arr[i].tobytes() for i in range(BATCH)]
+    hashes = [
+        Hash(np.ascontiguousarray(expected[i]).tobytes()) for i in range(BATCH)
+    ]
+    shards = arr.reshape(BATCH // K, K, BLOCK)
+
+    # warmup (thread pool spin-up, native lib load)
+    codec.batch_verify(blocks[:8], hashes[:8])
+    codec.rs_encode(shards[:1])
+
+    t0 = time.perf_counter()
+    ok = codec.batch_verify(blocks, hashes)
+    codec.rs_encode(shards)
+    dt = time.perf_counter() - t0
+    assert ok.all()
+    return BATCH * BLOCK / dt / 2**30
 
 
 def main() -> None:
-    from garage_tpu.ops import make_codec
-
-    block_size = 1 << 20  # 1 MiB, the reference's default block size
-    n_blocks = 64         # 64 MiB per batch
-    k, m = 8, 4
-
     rng = np.random.default_rng(0)
-    arr = rng.integers(0, 256, (n_blocks, block_size), dtype=np.uint8)
-    blocks = [arr[i].tobytes() for i in range(n_blocks)]
-    shards = arr.reshape(n_blocks, k, block_size // k)
-
-    cpu = make_codec("cpu", rs_data=k, rs_parity=m)
-    hashes = cpu.batch_hash(blocks)
-
-    def run(codec):
-        ok = codec.batch_verify(blocks, hashes)
-        parity = codec.rs_encode(shards)
-        assert ok.all()
-        return parity
-
-    total_bytes = n_blocks * block_size
-    cpu_s = _timeit(lambda: run(cpu))
-    cpu_gibps = total_bytes / cpu_s / (1 << 30)
-
-    import traceback
-
+    batches = make_batches(rng)
+    cpu = bench_cpu(batches)
     try:
-        tpu = make_codec("tpu", rs_data=k, rs_parity=m)
-        tpu_s = _timeit(lambda: run(tpu))
-        tpu_gibps = total_bytes / tpu_s / (1 << 30)
+        tpu = bench_tpu(batches)
     except Exception:
         traceback.print_exc()
-        tpu_gibps = 0.0  # a failed TPU path reports 0, never the CPU number
-
-    print(
-        json.dumps(
-            {
-                "metric": "scrub_rs84_throughput",
-                "value": round(tpu_gibps, 4),
-                "unit": "GiB/s",
-                "vs_baseline": round(tpu_gibps / cpu_gibps, 4) if cpu_gibps else 0.0,
-            }
-        )
-    )
+        tpu = 0.0  # a failed TPU path reports 0, never the CPU number
+    print(json.dumps({
+        "metric": "scrub_rs84_throughput",
+        "value": round(tpu, 4),
+        "unit": "GiB/s",
+        "vs_baseline": round(tpu / cpu, 4) if cpu else 0.0,
+    }))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,450 @@
+"""K2V HTTP API.
+
+Equivalent of reference src/api/k2v/ (SURVEY.md §2.7, ≈2100 LoC):
+  - item ops (item.rs): GET/PUT/DELETE /{bucket}/{partition}/{sort}; reads
+    return the causality token in X-Garage-Causality-Token and either a
+    single raw value (octet-stream; 409 on conflict) or a JSON array of
+    base64 values / null tombstones; writes take the token to supersede.
+  - PollItem (long-poll) via ?causality_token=…&timeout=… on GET.
+  - ReadIndex (index.rs): GET /{bucket}?start&end&limit over the partition
+    counter table.
+  - batch ops (batch.rs): POST /{bucket} = InsertBatch, ?search =
+    ReadBatch, ?delete = DeleteBatch.
+SigV4-authenticated like the S3 API, same key/bucket permission model.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..model.helper import NoSuchBucket, NoSuchKey
+from ..model.k2v.causality import CausalContext
+from ..utils.data import gen_uuid
+from .common import (
+    AccessDeniedError,
+    ApiError,
+    BadRequestError,
+    NoSuchBucketError,
+    NoSuchKeyError,
+    error_xml,
+    int_param,
+)
+from .signature import check_signature
+
+logger = logging.getLogger("garage_tpu.api.k2v")
+
+CAUSALITY_HEADER = "X-Garage-Causality-Token"
+
+
+class K2VApiServer:
+    def __init__(self, garage):
+        self.garage = garage
+        self.helper = garage.helper()
+        self.region = garage.config.s3_region
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self, bind_addr: str) -> None:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_route("*", "/{tail:.*}", self.handle_request)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        host, port = bind_addr.rsplit(":", 1)
+        self._site = web.TCPSite(self._runner, host, int(port))
+        await self._site.start()
+        logger.info("K2V API listening on %s", bind_addr)
+
+    @property
+    def port(self) -> int:
+        return self._site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def handle_request(self, request: web.Request) -> web.StreamResponse:
+        try:
+            return await self._handle(request)
+        except (ApiError, NoSuchBucket, NoSuchKey) as e:
+            status = getattr(e, "status", 500)
+            return web.Response(
+                status=status,
+                body=error_xml(e, request.path, bytes(gen_uuid()).hex()[:16]),
+                content_type="application/xml",
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.exception("K2V API error")
+            return web.Response(
+                status=500, body=error_xml(e, request.path, ""),
+                content_type="application/xml",
+            )
+
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        headers = {k.lower(): v for k, v in request.headers.items()}
+
+        async def get_key(key_id: str):
+            k = await self.garage.key_table.get(key_id, "")
+            if k is None or k.is_deleted():
+                return None
+            return k
+
+        query = [(k, v) for k, v in request.query.items()]
+        verified = await check_signature(
+            get_key, self.region, request.method, request.path, query, headers
+        )
+        api_key = verified.key
+
+        import urllib.parse
+
+        parts = [
+            urllib.parse.unquote(p)
+            for p in request.rel_url.raw_path.lstrip("/").split("/")
+        ]
+        if not parts or parts[0] == "":
+            raise BadRequestError("missing bucket in path")
+        bucket_name = parts[0]
+        pk = parts[1] if len(parts) > 1 and parts[1] != "" else None
+        sk = parts[2] if len(parts) > 2 else None
+
+        bucket_id = await self.helper.resolve_bucket(bucket_name, api_key)
+        m = request.method
+        needs = "read" if m == "GET" else "write"
+        allowed = (
+            api_key.allow_read(bucket_id) if needs == "read"
+            else api_key.allow_write(bucket_id)
+        )
+        if not allowed:
+            raise AccessDeniedError(f"no {needs} permission on {bucket_name}")
+
+        q = request.query
+        if pk is None:
+            if m == "GET":
+                return await self.read_index(bucket_id, q)
+            if m == "POST":
+                if "search" in q:
+                    return await self.read_batch(bucket_id, request)
+                if "delete" in q:
+                    return await self.delete_batch(bucket_id, request)
+                return await self.insert_batch(bucket_id, request)
+            raise BadRequestError(f"no such K2V endpoint: {m} /bucket")
+        if sk is None and "poll_range" in q:
+            return await self.poll_range(bucket_id, pk, request)
+        if sk is None:
+            raise BadRequestError("missing sort key")
+        if m == "GET":
+            if "causality_token" in q and "timeout" in q:
+                return await self.poll_item(bucket_id, pk, sk, q, headers)
+            return await self.read_item(bucket_id, pk, sk, headers)
+        if m == "PUT":
+            return await self.insert_item(bucket_id, pk, sk, request, headers)
+        if m == "DELETE":
+            return await self.delete_item(bucket_id, pk, sk, headers)
+        raise BadRequestError(f"no such K2V endpoint: {m} on item")
+
+    # --- item ops (ref api/k2v/item.rs) ---
+
+    async def _get_item(self, bucket_id, pk, sk):
+        return await self.garage.k2v_item_table.get((bytes(bucket_id), pk), sk)
+
+    def _item_response(self, item, headers) -> web.Response:
+        token = item.causal_context().serialize()
+        vals = item.values()
+        accept = headers.get("accept", "*/*")
+        wants_json = "application/json" in accept
+        wants_raw = "application/octet-stream" in accept
+        live = [v for v in vals if v is not None]
+        if not live:
+            raise NoSuchKeyError("item is deleted")
+        if wants_raw or (not wants_json and len(live) == 1 and len(vals) == 1):
+            if len(vals) > 1:
+                raise ApiError(
+                    "multiple concurrent values; use Accept: application/json",
+                    status=409, code="Conflict",
+                )
+            return web.Response(
+                status=200, body=live[0],
+                headers={CAUSALITY_HEADER: token},
+                content_type="application/octet-stream",
+            )
+        body = json.dumps([
+            base64.b64encode(v).decode() if v is not None else None
+            for v in vals
+        ])
+        return web.Response(
+            status=200, body=body.encode(),
+            headers={CAUSALITY_HEADER: token},
+            content_type="application/json",
+        )
+
+    async def read_item(self, bucket_id, pk, sk, headers) -> web.Response:
+        item = await self._get_item(bucket_id, pk, sk)
+        if item is None:
+            raise NoSuchKeyError(f"no such K2V item: {pk}/{sk}")
+        return self._item_response(item, headers)
+
+    async def insert_item(self, bucket_id, pk, sk, request, headers) -> web.Response:
+        value = await request.read()
+        ct = headers.get(CAUSALITY_HEADER.lower())
+        context = CausalContext.parse(ct) if ct else None
+        await self.garage.k2v_rpc.insert(bucket_id, pk, sk, context, value)
+        return web.Response(status=204)
+
+    async def delete_item(self, bucket_id, pk, sk, headers) -> web.Response:
+        ct = headers.get(CAUSALITY_HEADER.lower())
+        context = CausalContext.parse(ct) if ct else None
+        await self.garage.k2v_rpc.insert(bucket_id, pk, sk, context, None)
+        return web.Response(status=204)
+
+    async def poll_item(self, bucket_id, pk, sk, q, headers) -> web.Response:
+        context = CausalContext.parse(q["causality_token"])
+        timeout = min(float(q.get("timeout", "300")), 600.0)
+        item = await self.garage.k2v_rpc.poll_item(
+            bucket_id, pk, sk, context, timeout
+        )
+        if item is None:
+            return web.Response(status=304)  # not modified within timeout
+        return self._item_response(item, headers)
+
+    # --- index (ref api/k2v/index.rs) ---
+
+    async def read_index(self, bucket_id, q) -> web.Response:
+        start = q.get("start")
+        end = q.get("end")
+        prefix = q.get("prefix")
+        limit = min(int_param(q.get("limit"), "limit", 1000), 1000)
+        ent = await self.garage.k2v_counter_table.get_range(
+            bytes(bucket_id), start, filter=None, limit=limit + 1,
+        )
+        partitions = []
+        for ce in ent:
+            pk = ce.sk
+            if prefix and not pk.startswith(prefix):
+                continue
+            if end is not None and pk >= end:
+                break
+            t = ce.totals()
+            if t.get("items", 0) <= 0:
+                continue
+            partitions.append({
+                "pk": pk,
+                "entries": t.get("items", 0),
+                "conflicts": t.get("conflicts", 0),
+                "values": t.get("values", 0),
+                "bytes": t.get("bytes", 0),
+            })
+        truncated = len(partitions) > limit
+        partitions = partitions[:limit]
+        return web.json_response({
+            "prefix": prefix,
+            "start": start,
+            "end": end,
+            "limit": limit,
+            "partitionKeys": partitions,
+            "more": truncated,
+            "nextStart": partitions[-1]["pk"] if truncated else None,
+        })
+
+    # --- batch ops (ref api/k2v/batch.rs) ---
+
+    async def insert_batch(self, bucket_id, request) -> web.Response:
+        try:
+            body = json.loads(await request.read())
+            items = [
+                (
+                    it["pk"], it["sk"],
+                    CausalContext.parse(it["ct"]) if it.get("ct") else None,
+                    base64.b64decode(it["v"]) if it.get("v") is not None else None,
+                )
+                for it in body
+            ]
+        except (ValueError, KeyError, TypeError) as e:
+            raise BadRequestError(f"malformed InsertBatch body: {e}")
+        await self.garage.k2v_rpc.insert_many(bucket_id, items)
+        return web.Response(status=204)
+
+    async def read_batch(self, bucket_id, request) -> web.Response:
+        try:
+            queries = json.loads(await request.read())
+            assert isinstance(queries, list)
+        except (ValueError, AssertionError) as e:
+            raise BadRequestError(f"malformed ReadBatch body: {e}")
+        out = []
+        for sq in queries:
+            out.append(await self._search(bucket_id, sq))
+        return web.json_response(out)
+
+    async def _search(self, bucket_id, sq) -> dict:
+        pk = sq.get("partitionKey")
+        if pk is None:
+            raise BadRequestError("search missing partitionKey")
+        limit = min(int(sq.get("limit") or 1000), 1000)
+        start = sq.get("start")
+        end = sq.get("end")
+        prefix = sq.get("prefix")
+        single = sq.get("singleItem", False)
+        conflicts_only = sq.get("conflictsOnly", False)
+        tombstones = sq.get("tombstones", False)
+
+        if single:
+            item = await self._get_item(bucket_id, pk, start or "")
+            items = [item] if item is not None else []
+        else:
+            filt = "conflicts_only" if conflicts_only else ("any" if tombstones else None)
+            items = await self.garage.k2v_item_table.get_range(
+                (bytes(bucket_id), pk), start, filter=filt, limit=limit + 1,
+            )
+            if prefix:
+                items = [i for i in items if i.sort_key_str.startswith(prefix)]
+            if end is not None:
+                items = [i for i in items if i.sort_key_str < end]
+        truncated = len(items) > limit
+        items = items[:limit]
+        return {
+            "partitionKey": pk,
+            "prefix": prefix,
+            "start": start,
+            "end": end,
+            "limit": limit,
+            "singleItem": single,
+            "items": [
+                {
+                    "sk": i.sort_key_str,
+                    "ct": i.causal_context().serialize(),
+                    "v": [
+                        base64.b64encode(v).decode() if v is not None else None
+                        for v in i.values()
+                    ],
+                }
+                for i in items
+            ],
+            "more": truncated,
+            "nextStart": items[-1].sort_key_str if truncated else None,
+        }
+
+    async def delete_batch(self, bucket_id, request) -> web.Response:
+        try:
+            queries = json.loads(await request.read())
+            assert isinstance(queries, list)
+        except (ValueError, AssertionError) as e:
+            raise BadRequestError(f"malformed DeleteBatch body: {e}")
+        out = []
+        for dq in queries:
+            pk = dq.get("partitionKey")
+            if pk is None:
+                raise BadRequestError("delete missing partitionKey")
+            if dq.get("singleItem"):
+                sk = dq.get("start") or ""
+                item = await self._get_item(bucket_id, pk, sk)
+                n = 0
+                if item is not None and item.live_values():
+                    await self.garage.k2v_rpc.insert(
+                        bucket_id, pk, sk, item.causal_context(), None
+                    )
+                    n = 1
+                out.append({"partitionKey": pk, "singleItem": True, "deletedItems": n})
+            else:
+                items = await self.garage.k2v_item_table.get_range(
+                    (bytes(bucket_id), pk), dq.get("start"), filter=None,
+                    limit=1000,
+                )
+                end = dq.get("end")
+                prefix = dq.get("prefix")
+                n = 0
+                for i in items:
+                    if prefix and not i.sort_key_str.startswith(prefix):
+                        continue
+                    if end is not None and i.sort_key_str >= end:
+                        continue
+                    await self.garage.k2v_rpc.insert(
+                        bucket_id, pk, i.sort_key_str, i.causal_context(), None
+                    )
+                    n += 1
+                out.append({"partitionKey": pk, "singleItem": False, "deletedItems": n})
+        return web.json_response(out)
+
+    # --- poll range (ref api/k2v/range.rs + k2v/seen.rs) ---
+
+    async def poll_range(self, bucket_id, pk, request) -> web.Response:
+        try:
+            body = json.loads(await request.read() or b"{}")
+        except ValueError as e:
+            raise BadRequestError(f"malformed PollRange body: {e}")
+        timeout = min(float(body.get("timeout", 300)), 600.0)
+        prefix = body.get("prefix")
+        start = body.get("start")
+        end = body.get("end")
+        seen = body.get("seenMarker")
+        # seen marker = {sort_key: causality token} of what the client saw
+        seen_map = {}
+        if seen:
+            try:
+                seen_map = {
+                    k: CausalContext.parse(v)
+                    for k, v in json.loads(
+                        base64.urlsafe_b64decode(seen.encode()).decode()
+                    ).items()
+                }
+            except Exception:
+                raise BadRequestError("invalid seenMarker")
+
+        def matches(i):
+            if prefix and not i.sort_key_str.startswith(prefix):
+                return False
+            if start is not None and i.sort_key_str < start:
+                return False
+            if end is not None and i.sort_key_str >= end:
+                return False
+            return True
+
+        def is_new(i):
+            old = seen_map.get(i.sort_key_str)
+            return old is None or i.causal_context().is_newer_than(old)
+
+        subs = self.garage.k2v_subscriptions
+        q = subs.subscribe_range(bucket_id, pk)
+        try:
+            items = await self.garage.k2v_item_table.get_range(
+                (bytes(bucket_id), pk), start, filter="any", limit=1000,
+            )
+            fresh = [i for i in items if matches(i) and is_new(i)]
+            if not fresh:
+                import time as _time
+
+                deadline = _time.monotonic() + timeout
+                while not fresh:
+                    remain = deadline - _time.monotonic()
+                    if remain <= 0:
+                        return web.Response(status=304)
+                    try:
+                        import asyncio as _asyncio
+
+                        cand = await _asyncio.wait_for(q.get(), timeout=remain)
+                    except Exception:
+                        return web.Response(status=304)
+                    if matches(cand) and is_new(cand):
+                        fresh = [cand]
+            for i in fresh:
+                seen_map[i.sort_key_str] = i.causal_context()
+            marker = base64.urlsafe_b64encode(json.dumps({
+                k: v.serialize() for k, v in seen_map.items()
+            }).encode()).decode()
+            return web.json_response({
+                "items": [
+                    {
+                        "sk": i.sort_key_str,
+                        "ct": i.causal_context().serialize(),
+                        "v": [
+                            base64.b64encode(v).decode() if v is not None else None
+                            for v in i.values()
+                        ],
+                    }
+                    for i in fresh
+                ],
+                "seenMarker": marker,
+            })
+        finally:
+            subs.unsubscribe_range(bucket_id, pk, q)

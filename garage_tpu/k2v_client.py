@@ -1,0 +1,217 @@
+"""K2V client library.
+
+Equivalent of reference src/k2v-client/ (SURVEY.md §2.10, 1398 LoC): a
+standalone async client for the K2V HTTP API with SigV4 signing — item
+CRUD with causality tokens, long-poll, index reads and batch operations.
+
+Usage::
+
+    c = K2VClient("http://127.0.0.1:3904", "mybucket", key_id, secret)
+    ct = await c.insert_item("pk", "sk", b"value")
+    value, ct = await c.read_item("pk", "sk")
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.parse
+from typing import Any, Dict, List, Optional, Tuple
+
+import aiohttp
+
+from .api.signature import sign_request
+
+CAUSALITY_HEADER = "X-Garage-Causality-Token"
+
+
+class K2VError(Exception):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"K2V error {status}: {body[:200]}")
+        self.status = status
+
+
+class CausalityToken(str):
+    """Opaque causality token (base64 vector clock)."""
+
+
+class K2VItemValue:
+    """One read result: list of concurrent values (None = tombstone
+    sibling) + the causality token covering them."""
+
+    def __init__(self, values: List[Optional[bytes]], token: CausalityToken):
+        self.values = values
+        self.token = token
+
+    @property
+    def value(self) -> Optional[bytes]:
+        live = [v for v in self.values if v is not None]
+        return live[0] if len(live) == 1 and len(self.values) == 1 else None
+
+    def __repr__(self):  # pragma: no cover
+        return f"K2VItemValue({len(self.values)} values)"
+
+
+class K2VClient:
+    def __init__(self, endpoint: str, bucket: str, key_id: str, secret: str,
+                 region: str = "garage"):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.key_id = key_id
+        self.secret = secret
+        self.region = region
+
+    async def _req(self, method: str, path: str, query=None, body: bytes = b"",
+                   headers=None, timeout: float = 60.0):
+        query = query or []
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        host = self.endpoint[self.endpoint.index("://") + 3:]
+        headers["host"] = host
+        sig = sign_request(
+            self.key_id, self.secret, self.region, method,
+            urllib.parse.unquote(path), query, headers, body,
+        )
+        headers.update(sig)
+        qs = urllib.parse.urlencode(query)
+        url = f"{self.endpoint}{path}" + (f"?{qs}" if qs else "")
+        async with aiohttp.ClientSession() as s:
+            async with s.request(
+                method, url, data=body, headers=headers,
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as r:
+                return r.status, r.headers.copy(), await r.read()
+
+    def _item_path(self, pk: str, sk: str) -> str:
+        return (
+            f"/{urllib.parse.quote(self.bucket, safe='')}"
+            f"/{urllib.parse.quote(pk, safe='')}"
+            f"/{urllib.parse.quote(sk, safe='')}"
+        )
+
+    # --- item ops ---
+
+    async def read_item(self, pk: str, sk: str) -> Optional[K2VItemValue]:
+        st, hdrs, body = await self._req(
+            "GET", self._item_path(pk, sk),
+            headers={"accept": "application/json"},
+        )
+        if st == 404:
+            return None
+        if st != 200:
+            raise K2VError(st, body.decode(errors="replace"))
+        vals = [
+            base64.b64decode(v) if v is not None else None
+            for v in json.loads(body)
+        ]
+        return K2VItemValue(vals, CausalityToken(hdrs.get(CAUSALITY_HEADER, "")))
+
+    async def insert_item(self, pk: str, sk: str, value: bytes,
+                          token: Optional[str] = None) -> None:
+        headers = {}
+        if token:
+            headers[CAUSALITY_HEADER] = str(token)
+        st, _h, body = await self._req(
+            "PUT", self._item_path(pk, sk), body=value, headers=headers
+        )
+        if st not in (200, 204):
+            raise K2VError(st, body.decode(errors="replace"))
+
+    async def delete_item(self, pk: str, sk: str, token: Optional[str] = None) -> None:
+        headers = {}
+        if token:
+            headers[CAUSALITY_HEADER] = str(token)
+        st, _h, body = await self._req(
+            "DELETE", self._item_path(pk, sk), headers=headers
+        )
+        if st not in (200, 204):
+            raise K2VError(st, body.decode(errors="replace"))
+
+    async def poll_item(self, pk: str, sk: str, token: str,
+                        timeout: float = 300.0) -> Optional[K2VItemValue]:
+        """Long-poll until the item changes past `token`; None on timeout."""
+        st, hdrs, body = await self._req(
+            "GET", self._item_path(pk, sk),
+            query=[("causality_token", str(token)), ("timeout", str(timeout))],
+            headers={"accept": "application/json"},
+            timeout=timeout + 30.0,
+        )
+        if st == 304:
+            return None
+        if st != 200:
+            raise K2VError(st, body.decode(errors="replace"))
+        vals = [
+            base64.b64decode(v) if v is not None else None
+            for v in json.loads(body)
+        ]
+        return K2VItemValue(vals, CausalityToken(hdrs.get(CAUSALITY_HEADER, "")))
+
+    # --- index + batches ---
+
+    async def read_index(self, start: Optional[str] = None,
+                         end: Optional[str] = None,
+                         prefix: Optional[str] = None,
+                         limit: int = 1000) -> Dict[str, Any]:
+        q = [("limit", str(limit))]
+        for name, v in (("start", start), ("end", end), ("prefix", prefix)):
+            if v is not None:
+                q.append((name, v))
+        st, _h, body = await self._req(
+            "GET", f"/{urllib.parse.quote(self.bucket, safe='')}", query=q
+        )
+        if st != 200:
+            raise K2VError(st, body.decode(errors="replace"))
+        return json.loads(body)
+
+    async def insert_batch(self, items: List[Tuple[str, str, Optional[bytes], Optional[str]]]) -> None:
+        """items = [(pk, sk, value|None, causality_token|None)]."""
+        payload = json.dumps([
+            {
+                "pk": pk, "sk": sk,
+                "v": base64.b64encode(v).decode() if v is not None else None,
+                "ct": str(ct) if ct else None,
+            }
+            for pk, sk, v, ct in items
+        ]).encode()
+        st, _h, body = await self._req(
+            "POST", f"/{urllib.parse.quote(self.bucket, safe='')}", body=payload
+        )
+        if st not in (200, 204):
+            raise K2VError(st, body.decode(errors="replace"))
+
+    async def read_batch(self, queries: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        st, _h, body = await self._req(
+            "POST", f"/{urllib.parse.quote(self.bucket, safe='')}",
+            query=[("search", "")], body=json.dumps(queries).encode(),
+        )
+        if st != 200:
+            raise K2VError(st, body.decode(errors="replace"))
+        return json.loads(body)
+
+    async def delete_batch(self, queries: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        st, _h, body = await self._req(
+            "POST", f"/{urllib.parse.quote(self.bucket, safe='')}",
+            query=[("delete", "")], body=json.dumps(queries).encode(),
+        )
+        if st != 200:
+            raise K2VError(st, body.decode(errors="replace"))
+        return json.loads(body)
+
+    async def poll_range(self, pk: str, seen_marker: Optional[str] = None,
+                         prefix: Optional[str] = None,
+                         timeout: float = 300.0) -> Optional[Dict[str, Any]]:
+        payload: Dict[str, Any] = {"timeout": timeout}
+        if seen_marker:
+            payload["seenMarker"] = seen_marker
+        if prefix:
+            payload["prefix"] = prefix
+        st, _h, body = await self._req(
+            "POST",
+            f"/{urllib.parse.quote(self.bucket, safe='')}/{urllib.parse.quote(pk, safe='')}",
+            query=[("poll_range", "")], body=json.dumps(payload).encode(),
+            timeout=timeout + 30.0,
+        )
+        if st == 304:
+            return None
+        if st != 200:
+            raise K2VError(st, body.decode(errors="replace"))
+        return json.loads(body)

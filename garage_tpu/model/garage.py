@@ -136,6 +136,28 @@ class Garage:
             self.system, object_schema, self.meta_rep, self.db
         )
 
+        # --- K2V (ref garage.rs k2v section + model/k2v/) ---
+        from .k2v.item_table import K2VItemTableSchema
+        from .k2v.rpc import K2VRpcHandler, SubscriptionManager
+
+        self.k2v_counter_table = Table(
+            self.system,
+            counter_table_schema("k2v_index_counter"),
+            self.meta_rep,
+            self.db,
+        )
+        self.k2v_counter = IndexCounter(
+            self.system, self.k2v_counter_table, self.db
+        )
+        self.k2v_subscriptions = SubscriptionManager()
+        k2v_schema = K2VItemTableSchema(self.k2v_counter, self.k2v_subscriptions)
+        self.k2v_item_table = Table(
+            self.system, k2v_schema, self.meta_rep, self.db
+        )
+        self.k2v_rpc = K2VRpcHandler(
+            self.system, self.k2v_item_table, self.db, self.k2v_subscriptions
+        )
+
         self.tables: List[Table] = [
             self.bucket_table,
             self.bucket_alias_table,
@@ -146,6 +168,8 @@ class Garage:
             self.version_table,
             self.mpu_table,
             self.object_table,
+            self.k2v_counter_table,
+            self.k2v_item_table,
         ]
 
         self.bg = BackgroundRunner()

@@ -3,12 +3,19 @@
 The reference verifies block integrity with a sequential per-block blake2
 hash on CPU (ref src/block/block.rs:66-78, src/util/data.rs:117).  BLAKE2 is
 inherently sequential *within* a block (each 64-byte chunk's compression
-feeds the next), so the TPU axis of parallelism is *across* blocks: the
-compression function runs on uint32 vectors of B lanes (one lane per block),
-and a `lax.scan` walks the 64-byte chunks.  All arithmetic is uint32
-add/xor/rotate — native VPU ops; this is why the framework's default block
-hash is BLAKE2s (32-bit) rather than the reference's blake2b (64-bit, which
-TPUs emulate slowly).
+feeds the next), so the TPU axis of parallelism is *across* blocks.
+
+Layout is LANE-MAJOR: every one of the 16 state words is a (B,) uint32
+vector with the batch on the minor (128-lane) dimension, and the 10 rounds
+× 8 G quarter-rounds are fully unrolled with the SIGMA message schedule
+resolved at trace time — zero gathers, zero rolls, pure uint32
+add/xor/shift VPU ops.  (The first version kept state as (B, 4) row
+vectors: minor dim 4 wastes 124 of 128 VPU lanes and the per-round SIGMA
+gathers dominate; lane-major is ~an order of magnitude faster.)
+
+All arithmetic is uint32 — native VPU ops; this is why the framework's
+default block hash is BLAKE2s (32-bit) rather than the reference's blake2b
+(64-bit, which TPUs emulate slowly).
 
 Exactly RFC 7693 (sequential mode, digest 32 B, no key); verified
 bit-identical to hashlib.blake2s in tests/test_codec_equivalence.py.
@@ -43,6 +50,12 @@ SIGMA = np.array([
     [10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0],
 ], dtype=np.int32)
 
+# the 8 G applications per round: (state indices a,b,c,d)
+_G_IDX = [
+    (0, 4, 8, 12), (1, 5, 9, 13), (2, 6, 10, 14), (3, 7, 11, 15),
+    (0, 5, 10, 15), (1, 6, 11, 12), (2, 7, 8, 13), (3, 4, 9, 14),
+]
+
 # h[0] ^= 0x01010000 ^ digest_len  (param block: fanout=1, depth=1, len=32)
 H0 = IV.copy()
 H0[0] ^= 0x01010020
@@ -52,63 +65,81 @@ def _rotr(x: jax.Array, n: int) -> jax.Array:
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
-def _g_vec(a, b, c, d, x, y):
-    """One G quarter-round applied to 4 lanes at once: a/b/c/d are (..., 4)
-    uint32 rows of the 4×4 state matrix — the classic SIMD formulation of
-    BLAKE2 (column step, then diagonal step after row rotation).  Wider ops
-    mean ~3× fewer XLA primitives than 16 scalar-word G calls, which keeps
-    the compiled graph small and feeds the VPU (..., 4)-wide vectors."""
-    a = a + b + x
-    d = _rotr(d ^ a, 16)
-    c = c + d
-    b = _rotr(b ^ c, 12)
-    a = a + b + y
-    d = _rotr(d ^ a, 8)
-    c = c + d
-    b = _rotr(b ^ c, 7)
-    return a, b, c, d
-
-
-# Per round: message word indices feeding the column G (x0,y0) and the
-# diagonal G (x1,y1), each (10, 4) — derived from SIGMA once at import.
-_SX0 = SIGMA[:, 0:8:2]
-_SY0 = SIGMA[:, 1:8:2]
-_SX1 = SIGMA[:, 8:16:2]
-_SY1 = SIGMA[:, 9:16:2]
-
-
 def compress(h: jax.Array, m: jax.Array, t: jax.Array, f: jax.Array) -> jax.Array:
-    """One BLAKE2s compression, vectorized over leading batch dims.
+    """One BLAKE2s compression, lane-major.
 
-    h (B, 8) uint32 state; m (B, 16) uint32 message words (LE);
+    h (8, B) uint32 state; m (16, B) uint32 message words (LE);
     t (B,) uint32 low byte counter (messages < 4 GiB so t_hi = 0);
-    f (B,) bool final-chunk flag.
+    f (B,) bool final-chunk flag.  Returns the new (8, B) state.
     """
-    r0 = h[..., 0:4]
-    r1 = h[..., 4:8]
-    r2 = jnp.broadcast_to(jnp.asarray(IV[0:4]), r0.shape)
-    iv4 = jnp.asarray(IV[4:8])
-    r3 = jnp.broadcast_to(iv4, r0.shape)
-    tvec = jnp.stack(
-        [t, jnp.zeros_like(t),
-         jnp.where(f, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)),
-         jnp.zeros_like(t)],
-        axis=-1,
-    )
-    r3 = r3 ^ tvec
+    hw = [h[i] for i in range(8)]
+    mw = [m[i] for i in range(16)]
+    iv = [jnp.uint32(x) for x in IV]
+    v = hw + [
+        jnp.broadcast_to(iv[0], t.shape),
+        jnp.broadcast_to(iv[1], t.shape),
+        jnp.broadcast_to(iv[2], t.shape),
+        jnp.broadcast_to(iv[3], t.shape),
+        iv[4] ^ t,
+        jnp.broadcast_to(iv[5], t.shape),
+        iv[6] ^ jnp.where(f, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)),
+        jnp.broadcast_to(iv[7], t.shape),
+    ]
     for r in range(10):
-        r0, r1, r2, r3 = _g_vec(r0, r1, r2, r3, m[..., _SX0[r]], m[..., _SY0[r]])
-        # diagonalize: rotate row i left by i, run columns, rotate back
-        r1d = jnp.roll(r1, -1, axis=-1)
-        r2d = jnp.roll(r2, -2, axis=-1)
-        r3d = jnp.roll(r3, -3, axis=-1)
-        r0, r1d, r2d, r3d = _g_vec(r0, r1d, r2d, r3d, m[..., _SX1[r]], m[..., _SY1[r]])
-        r1 = jnp.roll(r1d, 1, axis=-1)
-        r2 = jnp.roll(r2d, 2, axis=-1)
-        r3 = jnp.roll(r3d, 3, axis=-1)
-    return jnp.concatenate(
-        [h[..., 0:4] ^ r0 ^ r2, h[..., 4:8] ^ r1 ^ r3], axis=-1
-    )
+        s = SIGMA[r]
+        for g, (ia, ib, ic, id_) in enumerate(_G_IDX):
+            x, y = mw[s[2 * g]], mw[s[2 * g + 1]]
+            a, b, c, d = v[ia], v[ib], v[ic], v[id_]
+            a = a + b + x
+            d = _rotr(d ^ a, 16)
+            c = c + d
+            b = _rotr(b ^ c, 12)
+            a = a + b + y
+            d = _rotr(d ^ a, 8)
+            c = c + d
+            b = _rotr(b ^ c, 7)
+            v[ia], v[ib], v[ic], v[id_] = a, b, c, d
+    return jnp.stack([hw[i] ^ v[i] ^ v[i + 8] for i in range(8)])
+
+
+def compress_rolled(h: jax.Array, m: jax.Array, t: jax.Array, f: jax.Array) -> jax.Array:
+    """Same compression with the 10 rounds as a lax.scan — ~10× smaller
+    compiled body.  Used on CPU (tests, 1-core CI boxes) where XLA compile
+    time of the fully unrolled body is prohibitive; bit-identical to
+    `compress` (asserted in tests/test_codec_equivalence.py)."""
+    iv = jnp.asarray(IV)
+    bsz = t.shape[0]
+    v = jnp.concatenate([
+        h,
+        jnp.broadcast_to(iv[0:4, None], (4, bsz)),
+        jnp.stack([
+            iv[4] ^ t,
+            jnp.broadcast_to(iv[5], t.shape),
+            iv[6] ^ jnp.where(f, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)),
+            jnp.broadcast_to(iv[7], t.shape),
+        ]),
+    ])
+    sigma = jnp.asarray(SIGMA)
+
+    def round_body(v, s):
+        mp = jnp.take(m, s, axis=0)  # (16, B) message words in round order
+        vw = [v[i] for i in range(16)]
+        for g, (ia, ib, ic, id_) in enumerate(_G_IDX):
+            x, y = mp[2 * g], mp[2 * g + 1]
+            a, b, c, d = vw[ia], vw[ib], vw[ic], vw[id_]
+            a = a + b + x
+            d = _rotr(d ^ a, 16)
+            c = c + d
+            b = _rotr(b ^ c, 12)
+            a = a + b + y
+            d = _rotr(d ^ a, 8)
+            c = c + d
+            b = _rotr(b ^ c, 7)
+            vw[ia], vw[ib], vw[ic], vw[id_] = a, b, c, d
+        return jnp.stack(vw), None
+
+    v, _ = jax.lax.scan(round_body, v, sigma)
+    return h ^ v[0:8] ^ v[8:16]
 
 
 def bytes_to_words(data_u8: jax.Array) -> jax.Array:
@@ -118,35 +149,52 @@ def bytes_to_words(data_u8: jax.Array) -> jax.Array:
     return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
 
 
-def blake2s_batch(data_u8: jax.Array, lengths: jax.Array) -> jax.Array:
+def _default_unroll() -> bool:
+    """Full round unroll on TPU (no gathers, fastest); rolled rounds on
+    CPU, where the ~1100-primitive unrolled scan body makes XLA's 1-core
+    compile pathologically slow."""
+    return jax.default_backend() != "cpu"
+
+
+def blake2s_batch(
+    data_u8: jax.Array, lengths: jax.Array, unroll: bool = None
+) -> jax.Array:
     """Hash B zero-padded messages.
 
     data_u8 (B, C*64) uint8 — messages padded with zeros to a common
     multiple-of-64 length (C ≥ 1 chunks); lengths (B,) int32 true byte
     counts.  Returns (B, 8) uint32 digests (little-endian word order).
     """
+    if unroll is None:
+        unroll = _default_unroll()
+    compress_fn = compress if unroll else compress_rolled
     bsz, total = data_u8.shape
     assert total % 64 == 0 and total > 0
     nchunks = total // 64
-    msg = bytes_to_words(data_u8).reshape(bsz, nchunks, 16)
+    # (B, C, 16) → (C, 16, B): batch lane-major for the scan body
+    msg = jnp.transpose(
+        bytes_to_words(data_u8).reshape(bsz, nchunks, 16), (1, 2, 0)
+    )
     lengths = lengths.astype(jnp.uint32)
     # index of each lane's final chunk: ceil(L/64)-1, clamped ≥ 0
     last = jnp.maximum(
         (lengths + jnp.uint32(63)) // jnp.uint32(64), jnp.uint32(1)
     ) - jnp.uint32(1)
-    h0 = jnp.broadcast_to(jnp.asarray(H0), (bsz, 8))
+    h0 = jnp.broadcast_to(jnp.asarray(H0)[:, None], (8, bsz))
 
-    def step(h, c):
+    def step(h, xs):
+        c, m = xs
         c32 = c.astype(jnp.uint32)
-        m = jax.lax.dynamic_index_in_dim(msg, c, axis=1, keepdims=False)
         t = jnp.minimum((c32 + 1) * jnp.uint32(64), lengths)
         f = c32 == last
-        h_new = compress(h, m, t, f)
+        h_new = compress_fn(h, m, t, f)
         active = c32 <= last
-        return jnp.where(active[:, None], h_new, h), None
+        return jnp.where(active[None, :], h_new, h), None
 
-    h, _ = jax.lax.scan(step, h0, jnp.arange(nchunks, dtype=jnp.int32))
-    return h
+    h, _ = jax.lax.scan(
+        step, h0, (jnp.arange(nchunks, dtype=jnp.int32), msg)
+    )
+    return h.T
 
 
 @functools.partial(jax.jit, static_argnames=())

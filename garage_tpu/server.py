@@ -35,6 +35,7 @@ class Server:
         self.s3: Optional[S3ApiServer] = None
         self.admin: Optional[AdminApiServer] = None
         self.web: Optional[WebServer] = None
+        self.k2v = None
 
     async def start(self) -> None:
         g = self.garage
@@ -49,6 +50,11 @@ class Server:
         if self.config.web_bind_addr:
             self.web = WebServer(g)
             await self.web.start(self.config.web_bind_addr)
+        if self.config.k2v_api_bind_addr:
+            from .api.k2v_server import K2VApiServer
+
+            self.k2v = K2VApiServer(g)
+            await self.k2v.start(self.config.k2v_api_bind_addr)
         logger.info(
             "node %s up (rpc %s)",
             bytes(g.system.id).hex()[:16],
@@ -57,7 +63,7 @@ class Server:
 
     async def stop(self) -> None:
         # reverse order of start (ref server.rs:135-171)
-        for srv in (self.web, self.admin, self.s3):
+        for srv in (self.k2v, self.web, self.admin, self.s3):
             if srv is not None:
                 await srv.stop()
         await self.garage.shutdown()

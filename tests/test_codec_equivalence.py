@@ -39,6 +39,26 @@ class TestBlake2s:
         for g, w, n in zip(got, want, self.SIZES):
             assert bytes(g) == w, f"mismatch at size {n}"
 
+    def test_rolled_vs_unrolled_compress(self):
+        """The TPU path unrolls all 10 rounds; CPU uses a rolled scan.
+        Both must be bit-identical.  Runs EAGERLY (un-jitted): XLA-CPU
+        compile of the unrolled body hangs under the forced-8-device test
+        platform; op-by-op eager avoids the compile entirely.  (On real
+        TPU the unrolled graph is exercised by bench.py, which asserts
+        every digest against hashlib-derived expectations.)"""
+        import jax.numpy as jnp
+
+        from garage_tpu.ops.tpu_blake2s import compress, compress_rolled
+
+        rng = np.random.default_rng(7)
+        h = jnp.asarray(rng.integers(0, 2**32, (8, 4), dtype=np.uint32))
+        m = jnp.asarray(rng.integers(0, 2**32, (16, 4), dtype=np.uint32))
+        t = jnp.asarray(np.array([64, 65, 128, 1], dtype=np.uint32))
+        f = jnp.asarray(np.array([False, True, False, True]))
+        a = np.asarray(compress(h, m, t, f))
+        b = np.asarray(compress_rolled(h, m, t, f))
+        assert np.array_equal(a, b)
+
     def test_cpu_tpu_hash_identical(self, cpu, tpu):
         blocks = _blocks([777, 1024, 8192], seed=1)
         assert [bytes(h) for h in cpu.batch_hash(blocks)] == [
