@@ -8,6 +8,7 @@ admin token (api_server.rs:32-60,271-335).
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 from typing import Optional
@@ -58,7 +59,11 @@ class AdminApiServer:
         if token is None:
             raise web.HTTPForbidden(text="admin token not configured")
         auth = request.headers.get("Authorization", "")
-        if auth != f"Bearer {token}":
+        # compare bytes: compare_digest raises TypeError on non-ASCII str
+        if not hmac.compare_digest(
+            auth.encode("utf-8", "surrogateescape"),
+            f"Bearer {token}".encode("utf-8", "surrogateescape"),
+        ):
             raise web.HTTPForbidden(text="invalid bearer token")
 
     def _admin(self, request) -> None:

@@ -34,7 +34,7 @@ from .common import (
     error_xml,
     int_param,
 )
-from .signature import check_signature
+from .signature import check_signature, raw_query_pairs
 
 logger = logging.getLogger("garage_tpu.api.k2v")
 
@@ -94,7 +94,9 @@ class K2VApiServer:
 
         query = [(k, v) for k, v in request.query.items()]
         verified = await check_signature(
-            get_key, self.region, request.method, request.path, query, headers
+            get_key, self.region, request.method, request.path, query, headers,
+            raw_path=request.rel_url.raw_path,
+            raw_query=raw_query_pairs(request.rel_url.raw_query_string),
         )
         api_key = verified.key
 
@@ -112,7 +114,21 @@ class K2VApiServer:
 
         bucket_id = await self.helper.resolve_bucket(bucket_name, api_key)
         m = request.method
-        needs = "read" if m == "GET" else "write"
+        # Classify the endpoint BEFORE the permission check (ref
+        # src/api/k2v/router.rs authorization_type): ReadBatch (POST
+        # ?search) and PollRange are reads even though they are POSTs;
+        # everything else follows the method (GET=read, PUT/POST/DELETE
+        # mutations=write).
+        qk = request.query
+        if m == "GET":
+            needs = "read"
+        elif m == "POST" and (
+            (pk is None and "search" in qk)
+            or (pk is not None and sk is None and "poll_range" in qk)
+        ):
+            needs = "read"
+        else:
+            needs = "write"
         allowed = (
             api_key.allow_read(bucket_id) if needs == "read"
             else api_key.allow_write(bucket_id)
@@ -132,6 +148,10 @@ class K2VApiServer:
                 return await self.insert_batch(bucket_id, request)
             raise BadRequestError(f"no such K2V endpoint: {m} /bucket")
         if sk is None and "poll_range" in q:
+            # POST only (ref router.rs); the permission classification
+            # above treats only the POST form as a read
+            if m != "POST":
+                raise BadRequestError("PollRange is POST")
             return await self.poll_range(bucket_id, pk, request)
         if sk is None:
             raise BadRequestError("missing sort key")

@@ -39,6 +39,7 @@ from ..signature import (
     GarageError,
     InvalidRequest,
     check_signature,
+    raw_query_pairs,
 )
 from .router import NONE, OWNER, READ, WRITE, parse_endpoint
 
@@ -125,7 +126,9 @@ class S3ApiServer:
             return k
 
         verified = await check_signature(
-            get_key, self.region, request.method, request.path, query, headers
+            get_key, self.region, request.method, request.path, query, headers,
+            raw_path=request.rel_url.raw_path,
+            raw_query=raw_query_pairs(request.rel_url.raw_query_string),
         )
         api_key = verified.key
 

@@ -68,8 +68,24 @@ async def handle_copy_object(ctx) -> web.Response:
     new_uuid = gen_uuid()
     ts = now_msec()
 
+    # x-amz-metadata-directive=REPLACE takes the new object's headers from
+    # the request instead of the source (ref copy.rs:52); default is COPY
+    directive = ctx.request.headers.get(
+        "x-amz-metadata-directive", "COPY"
+    ).upper()
+    if directive == "REPLACE":
+        from .put import headers_from_request
+
+        stored_headers = headers_from_request(ctx)
+    elif directive == "COPY":
+        stored_headers = meta["headers"]
+    else:
+        raise BadRequestError(
+            f"bad x-amz-metadata-directive {directive!r} (COPY or REPLACE)"
+        )
+
     if data[0] == "inline":
-        new_meta = ObjectVersionMeta.new(meta["headers"], meta["size"], meta["etag"])
+        new_meta = ObjectVersionMeta.new(stored_headers, meta["size"], meta["etag"])
         ov = ObjectVersion(
             new_uuid, ts, ["complete", ObjectVersionData.inline(new_meta, bytes(data[2]))]
         )
@@ -85,7 +101,7 @@ async def handle_copy_object(ctx) -> web.Response:
             new_version.blocks[pk] = (h, sz)
         new_version.parts_etags = dict(src_ver_row.parts_etags)
         await garage.version_table.insert(new_version)
-        new_meta = ObjectVersionMeta.new(meta["headers"], meta["size"], meta["etag"])
+        new_meta = ObjectVersionMeta.new(stored_headers, meta["size"], meta["etag"])
         ov = ObjectVersion(
             new_uuid, ts,
             ["complete", ObjectVersionData.first_block(new_meta, bytes(data[2]))],

@@ -128,6 +128,21 @@ async def save_stream(
         return etag, len(first)
 
     # large payload: streaming multi-block write (put.rs:120-199)
+    # Pre-check quotas against the declared Content-Length so an over-quota
+    # upload is rejected before consuming bandwidth and disk churn (the
+    # reference pre-checks with the announced size, put.rs:76-82); the
+    # post-stream check below still covers chunked bodies with no length.
+    declared = ctx.request.headers.get(
+        "x-amz-decoded-content-length",  # payload size under aws-chunked
+        ctx.request.headers.get("Content-Length"),
+    )
+    if declared is not None:
+        try:
+            declared_n = int(declared)
+        except ValueError:
+            declared_n = None
+        if declared_n is not None:
+            await check_quotas(ctx, declared_n, key)
     version_uuid = gen_uuid()
     ts = now_msec()
     ov = ObjectVersion.uploading(version_uuid, ts, False, headers)

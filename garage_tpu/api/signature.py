@@ -73,6 +73,19 @@ def canonical_query_string(query: List[Tuple[str, str]], skip_sig: bool = False)
     return "&".join(f"{k}={v}" for k, v in items)
 
 
+def canonical_query_string_raw(
+    raw_query: List[Tuple[str, str]], skip_sig: bool = False
+) -> str:
+    """Canonical query from the RAW (still percent-encoded, as sent) pairs:
+    sort and join without re-encoding, so the signature covers exactly the
+    client's wire encoding (the reference signs the raw query, payload.rs).
+    X-Amz-Signature is unreserved-only so the raw name matches literally."""
+    items = sorted(
+        p for p in raw_query if not (skip_sig and p[0] == "X-Amz-Signature")
+    )
+    return "&".join(f"{k}={v}" for k, v in items)
+
+
 def canonical_request(
     method: str,
     path: str,
@@ -81,9 +94,21 @@ def canonical_request(
     signed_headers: List[str],
     payload_hash: str,
     skip_sig_param: bool = False,
+    raw_path: Optional[str] = None,
+    raw_query: Optional[List[Tuple[str, str]]] = None,
 ) -> str:
-    canon_uri = uri_encode(path, encode_slash=False)
-    canon_query = canonical_query_string(query, skip_sig=skip_sig_param)
+    # Sign over the URI exactly as the client sent it (raw_path) when the
+    # server can supply it; clients whose wire encoding differs from our
+    # re-encoding of the decoded path (literal %2F in keys, '+' in values)
+    # would otherwise get spurious SignatureDoesNotMatch.  The re-encoding
+    # branch remains for client-side signing, where `path` is logical.
+    canon_uri = raw_path if raw_path is not None else uri_encode(
+        path, encode_slash=False
+    )
+    if raw_query is not None:
+        canon_query = canonical_query_string_raw(raw_query, skip_sig=skip_sig_param)
+    else:
+        canon_query = canonical_query_string(query, skip_sig=skip_sig_param)
     canon_headers = "".join(
         f"{h}:{' '.join(headers.get(h, '').split())}\n" for h in signed_headers
     )
@@ -149,6 +174,21 @@ def _parse_auth_header(auth: str) -> Dict[str, str]:
     return out
 
 
+def raw_query_pairs(raw_query_string: str) -> List[Tuple[str, str]]:
+    """Split a raw (still-encoded) query string into (name, value) pairs
+    without decoding, preserving the client's exact wire encoding."""
+    out: List[Tuple[str, str]] = []
+    for part in raw_query_string.split("&"):
+        if not part:
+            continue
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out.append((k, v))
+        else:
+            out.append((part, ""))
+    return out
+
+
 async def check_signature(
     get_key,
     region: str,
@@ -156,17 +196,21 @@ async def check_signature(
     path: str,
     query: List[Tuple[str, str]],
     headers: Dict[str, str],
+    raw_path: Optional[str] = None,
+    raw_query: Optional[List[Tuple[str, str]]] = None,
 ) -> VerifiedRequest:
     """Verify header or presigned-query SigV4 (ref payload.rs:20-100+).
-    `headers` keys must be lowercase."""
+    `headers` keys must be lowercase.  `raw_path`/`raw_query` are the
+    still-encoded wire forms; when given, the canonical request is built
+    from them (decoded `path`/`query` stay for parameter lookups)."""
     qdict = dict(query)
     if "Authorization" in headers or "authorization" in headers:
         return await _check_header_signature(
-            get_key, region, method, path, query, headers
+            get_key, region, method, path, query, headers, raw_path, raw_query
         )
     if qdict.get("X-Amz-Algorithm") == ALGORITHM:
         return await _check_presigned_signature(
-            get_key, region, method, path, query, headers
+            get_key, region, method, path, query, headers, raw_path, raw_query
         )
     raise AuthError("no signature: anonymous access denied")
 
@@ -183,7 +227,8 @@ async def _lookup(get_key, cred: Credential, region: str):
 
 
 async def _check_header_signature(
-    get_key, region, method, path, query, headers
+    get_key, region, method, path, query, headers,
+    raw_path=None, raw_query=None,
 ) -> VerifiedRequest:
     auth = _parse_auth_header(headers.get("authorization", headers.get("Authorization", "")))
     cred = Credential(auth["Credential"])
@@ -201,7 +246,8 @@ async def _check_header_signature(
 
     key = await _lookup(get_key, cred, region)
     canon = canonical_request(
-        method, path, query, headers, signed_headers, content_sha256
+        method, path, query, headers, signed_headers, content_sha256,
+        raw_path=raw_path, raw_query=raw_query,
     )
     sts = string_to_sign(timestamp, cred.scope, canon)
     sk = signing_key(key.params().secret_key, cred.date, cred.region, cred.service)
@@ -219,7 +265,8 @@ async def _check_header_signature(
 
 
 async def _check_presigned_signature(
-    get_key, region, method, path, query, headers
+    get_key, region, method, path, query, headers,
+    raw_path=None, raw_query=None,
 ) -> VerifiedRequest:
     q = dict(query)
     cred = Credential(q.get("X-Amz-Credential", ""))
@@ -248,7 +295,7 @@ async def _check_presigned_signature(
     key = await _lookup(get_key, cred, region)
     canon = canonical_request(
         method, path, query, headers, signed_headers, UNSIGNED_PAYLOAD,
-        skip_sig_param=True,
+        skip_sig_param=True, raw_path=raw_path, raw_query=raw_query,
     )
     sts = string_to_sign(timestamp, cred.scope, canon)
     sk = signing_key(key.params().secret_key, cred.date, cred.region, cred.service)
@@ -355,10 +402,15 @@ def sign_request(
     headers: Dict[str, str],
     payload: bytes = b"",
     timestamp: Optional[str] = None,
+    path_is_raw: bool = False,
 ) -> Dict[str, str]:
     """Produce the headers for a header-authenticated request (the
     reference keeps an equivalent in tests/common/custom_requester.rs).
-    Returns headers to add; input `headers` must include host."""
+    Returns headers to add; input `headers` must include host.
+    With `path_is_raw`, `path` is the exact wire form (already
+    percent-encoded) and is signed verbatim — required for keys whose
+    decoded form re-encodes differently (literal %2F), since the server
+    verifies against the raw wire path."""
     now = timestamp or datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y%m%dT%H%M%SZ"
     )
@@ -369,7 +421,10 @@ def sign_request(
     hdrs["x-amz-content-sha256"] = payload_hash
     signed = sorted(set(list(hdrs.keys()) + ["host"]))
     cred = Credential(f"{key_id}/{date}/{region}/{SERVICE}/aws4_request")
-    canon = canonical_request(method, path, query, hdrs, signed, payload_hash)
+    canon = canonical_request(
+        method, path, query, hdrs, signed, payload_hash,
+        raw_path=path if path_is_raw else None,
+    )
     sts = string_to_sign(now, cred.scope, canon)
     sk = signing_key(secret, date, region)
     sig = hmac.new(sk, sts.encode(), hashlib.sha256).hexdigest()

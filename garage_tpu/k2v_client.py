@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import aiohttp
 
-from .api.signature import sign_request
+from .api.signature import sign_request, uri_encode
 
 CAUSALITY_HEADER = "X-Garage-Causality-Token"
 
@@ -67,13 +67,23 @@ class K2VClient:
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         host = self.endpoint[self.endpoint.index("://") + 3:]
         headers["host"] = host
+        # `path` is already the wire form (built with quote()); sign it
+        # verbatim — the server verifies against the raw wire path, and
+        # unquote→re-encode round-trips differently for keys with literal %2F
         sig = sign_request(
             self.key_id, self.secret, self.region, method,
-            urllib.parse.unquote(path), query, headers, body,
+            path, query, headers, body, path_is_raw=True,
         )
         headers.update(sig)
-        qs = urllib.parse.urlencode(query)
-        url = f"{self.endpoint}{path}" + (f"?{qs}" if qs else "")
+        # wire form must equal the signed canonical form (uri_encode, not
+        # urlencode's '+'-for-space), now that the server signs raw pairs;
+        # encoded=True stops yarl re-normalizing what we signed
+        import yarl
+
+        qs = "&".join(f"{uri_encode(k)}={uri_encode(v)}" for k, v in query)
+        url = yarl.URL(
+            f"{self.endpoint}{path}" + (f"?{qs}" if qs else ""), encoded=True
+        )
         async with aiohttp.ClientSession() as s:
             async with s.request(
                 method, url, data=body, headers=headers,

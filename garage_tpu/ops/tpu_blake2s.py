@@ -149,10 +149,25 @@ def bytes_to_words(data_u8: jax.Array) -> jax.Array:
     return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
 
 
+# Process-wide override for the unroll choice (None = auto by backend).
+# dryrun_multichip sets this to False: its virtual CPU mesh must compile
+# the small rolled body even when the axon TPU platform won the
+# default-backend slot (the r01 dryrun timed out compiling the ~1100-
+# primitive unrolled body through the slow tunnel).
+_UNROLL_OVERRIDE: bool | None = None
+
+
+def set_unroll_override(value: bool | None) -> None:
+    global _UNROLL_OVERRIDE
+    _UNROLL_OVERRIDE = value
+
+
 def _default_unroll() -> bool:
     """Full round unroll on TPU (no gathers, fastest); rolled rounds on
     CPU, where the ~1100-primitive unrolled scan body makes XLA's 1-core
     compile pathologically slow."""
+    if _UNROLL_OVERRIDE is not None:
+        return _UNROLL_OVERRIDE
     return jax.default_backend() != "cpu"
 
 

@@ -17,6 +17,7 @@ from garage_tpu.api.signature import (
     Credential,
     sign_request,
     signing_key,
+    uri_encode,
 )
 from garage_tpu.model import BucketKeyPerm, Garage
 from garage_tpu.utils.config import config_from_dict
@@ -37,13 +38,20 @@ class S3Client:
         query = query or []
         headers = dict(headers or {})
         headers["host"] = self.base[len("http://"):]
+        # `path` is the wire form; sign it verbatim (server verifies raw)
         sig_headers = sign_request(
             self.key_id, self.secret, self.region, method,
-            urllib.parse.unquote(path), query, headers, body,
+            path, query, headers, body, path_is_raw=True,
         )
         headers.update(sig_headers)
-        qs = urllib.parse.urlencode(query)
-        url = f"{self.base}{path}" + (f"?{qs}" if qs else "")
+        # wire query must equal the signed canonical encoding (no '+');
+        # encoded=True stops yarl re-normalizing it (e.g. %2F back to /)
+        import yarl
+
+        qs = "&".join(f"{uri_encode(k)}={uri_encode(v)}" for k, v in query)
+        url = yarl.URL(
+            f"{self.base}{path}" + (f"?{qs}" if qs else ""), encoded=True
+        )
         async with aiohttp.ClientSession() as s:
             async with s.request(method, url, data=body, headers=headers) as r:
                 # r.headers is a CIMultiDict — keep case-insensitive lookup
