@@ -118,6 +118,13 @@ class S3ApiServer:
             request.method, bucket_name, key_name, query, headers
         )
 
+        # PostObject authenticates via the signed policy document inside
+        # the form, not an Authorization header (ref post_object.rs:1-507)
+        if endpoint.name == "PostObject":
+            from .post_object import handle_post_object
+
+            return await handle_post_object(self, request, bucket_name)
+
         # authentication (ref api_server.rs:105-130 + signature/)
         async def get_key(key_id: str):
             k = await self.garage.key_table.get(key_id, "")

@@ -176,8 +176,19 @@ async def handle_get_lifecycle(ctx) -> web.Response:
             ET.SubElement(el, "ID").text = r["id"]
         ET.SubElement(el, "Status").text = "Enabled" if r.get("enabled", True) else "Disabled"
         f = ET.SubElement(el, "Filter")
-        if r.get("prefix"):
-            ET.SubElement(f, "Prefix").text = r["prefix"]
+        preds = [
+            (tag, r[k])
+            for tag, k in (
+                ("Prefix", "prefix"),
+                ("ObjectSizeGreaterThan", "size_gt"),
+                ("ObjectSizeLessThan", "size_lt"),
+            )
+            if r.get(k) not in (None, "")
+        ]
+        # AWS XML: 2+ predicates must be wrapped in <And>
+        parent = ET.SubElement(f, "And") if len(preds) > 1 else f
+        for tag, v in preds:
+            ET.SubElement(parent, tag).text = str(v)
         if r.get("expiration_days") is not None or r.get("expiration_date"):
             ex = ET.SubElement(el, "Expiration")
             if r.get("expiration_days") is not None:
@@ -200,8 +211,11 @@ async def handle_put_lifecycle(ctx) -> web.Response:
     rules = []
     for el in root.findall(f"{ns}Rule"):
         status = el.findtext(f"{ns}Status") or "Enabled"
+        # AWS wraps multiple Filter predicates in <And>; single predicates
+        # sit directly under <Filter>; Prefix may also be legacy top-level
         prefix = (
-            el.findtext(f"{ns}Filter/{ns}Prefix")
+            el.findtext(f"{ns}Filter/{ns}And/{ns}Prefix")
+            or el.findtext(f"{ns}Filter/{ns}Prefix")
             or el.findtext(f"{ns}Prefix")  # legacy top-level form
             or ""
         )
@@ -210,15 +224,35 @@ async def handle_put_lifecycle(ctx) -> web.Response:
         abort_days = el.findtext(
             f"{ns}AbortIncompleteMultipartUpload/{ns}DaysAfterInitiation"
         )
-        if days is not None and int(days) <= 0:
+        size_gt = (
+            el.findtext(f"{ns}Filter/{ns}And/{ns}ObjectSizeGreaterThan")
+            or el.findtext(f"{ns}Filter/{ns}ObjectSizeGreaterThan")
+        )
+        size_lt = (
+            el.findtext(f"{ns}Filter/{ns}And/{ns}ObjectSizeLessThan")
+            or el.findtext(f"{ns}Filter/{ns}ObjectSizeLessThan")
+        )
+
+        def _int(v, what):
+            if v is None:
+                return None
+            try:
+                return int(v)
+            except ValueError:
+                raise BadRequestError(f"{what} must be an integer, got {v!r}")
+
+        days = _int(days, "Expiration Days")
+        if days is not None and days <= 0:
             raise BadRequestError("Expiration Days must be positive")
         rules.append({
             "id": el.findtext(f"{ns}ID"),
             "enabled": status == "Enabled",
             "prefix": prefix,
-            "expiration_days": int(days) if days is not None else None,
+            "size_gt": _int(size_gt, "ObjectSizeGreaterThan"),
+            "size_lt": _int(size_lt, "ObjectSizeLessThan"),
+            "expiration_days": days,
             "expiration_date": date,
-            "abort_incomplete_days": int(abort_days) if abort_days is not None else None,
+            "abort_incomplete_days": _int(abort_days, "DaysAfterInitiation"),
         })
     await _update_bucket(ctx, lambda p: p.lifecycle_config.update(rules))
     return web.Response(status=200)

@@ -196,6 +196,13 @@ class ScrubWorker(Worker):
         self._cmd.put_nowait(cmd)
         self._wake.set()
 
+    def set_tranquility(self, t: int) -> None:
+        t = int(t)
+        if t < 0:
+            raise ValueError("scrub-tranquility must be >= 0")
+        self.state.tranquility = t
+        self._checkpoint(force=True)
+
     def _apply_command(self, cmd: str) -> None:
         st = self.state
         if cmd == "start":

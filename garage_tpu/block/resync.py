@@ -27,7 +27,8 @@ from ..utils.background import Worker, WorkerState
 from ..utils.crdt import now_msec
 from ..utils.data import Hash
 from ..utils.error import GarageError
-from ..utils.migrate import pack, unpack
+from ..utils.migrate import Migrated, pack, unpack
+from ..utils.persister import Persister
 from ..utils.tranquilizer import Tranquilizer
 
 logger = logging.getLogger("garage_tpu.block.resync")
@@ -64,15 +65,61 @@ class ErrorCounter:
         return self.last_try + self.delay_ms()
 
 
+class ResyncPersistedConfig(Migrated):
+    """Persisted resync tunables (ref resync.rs:143-173): survive restarts,
+    settable at runtime via `worker set resync-worker-count / -tranquility`."""
+
+    VERSION_MARKER = b"GT01rscfg"
+
+    def __init__(self, n_workers: int = 1,
+                 tranquility: int = DEFAULT_RESYNC_TRANQUILITY):
+        self.n_workers = n_workers
+        self.tranquility = tranquility
+
+    def fields(self):
+        return [self.n_workers, self.tranquility]
+
+    @classmethod
+    def from_fields(cls, b):
+        return cls(*b)
+
+
 class BlockResyncManager:
-    def __init__(self, manager, db: Db):
+    def __init__(self, manager, db: Db,
+                 persister: Optional[Persister] = None):
         self.manager = manager
         self.queue = CountedTree(db.open_tree("block_local_resync_queue"))
         self.errors = CountedTree(db.open_tree("block_local_resync_errors"))
         self.busy_set: Set[bytes] = set()
         self.notify = asyncio.Event()
-        self.n_workers = 1
-        self.tranquility = DEFAULT_RESYNC_TRANQUILITY
+        self.persister = persister
+        cfg = (persister.load() if persister is not None else None) \
+            or ResyncPersistedConfig()
+        self.n_workers = cfg.n_workers
+        self.tranquility = cfg.tranquility
+
+    def _persist_config(self) -> None:
+        if self.persister is not None:
+            self.persister.save(
+                ResyncPersistedConfig(self.n_workers, self.tranquility)
+            )
+
+    def set_n_workers(self, n: int) -> None:
+        n = int(n)
+        if not 1 <= n <= MAX_RESYNC_WORKERS:
+            raise ValueError(
+                f"resync-worker-count must be in [1, {MAX_RESYNC_WORKERS}]"
+            )
+        self.n_workers = n
+        self._persist_config()
+        self.notify.set()
+
+    def set_tranquility(self, t: int) -> None:
+        t = int(t)
+        if t < 0:
+            raise ValueError("resync-tranquility must be >= 0")
+        self.tranquility = t
+        self._persist_config()
 
     # --- queue management (ref resync.rs:88-260) ---
 

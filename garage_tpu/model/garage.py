@@ -16,8 +16,13 @@ import os
 from typing import List, Optional
 
 from ..block.manager import BlockManager
-from ..block.repair import RebalanceWorker, RepairWorker, ScrubWorker
-from ..block.resync import BlockResyncManager, ResyncWorker
+from ..block.repair import RebalanceWorker, RepairWorker, ScrubWorker, ScrubWorkerState
+from ..block.resync import (
+    MAX_RESYNC_WORKERS,
+    BlockResyncManager,
+    ResyncPersistedConfig,
+    ResyncWorker,
+)
 from ..db import Db, open_db
 from ..rpc.replication_mode import parse_replication_mode
 from ..rpc.system import System
@@ -34,6 +39,7 @@ from ..table.gc import GcWorker
 from ..table.sync import SyncWorker
 from ..utils.background import BackgroundRunner, BgVars
 from ..utils.config import Config
+from ..utils.persister import Persister
 from .bucket_alias_table import BucketAliasTableSchema
 from .bucket_table import BucketTableSchema
 from .index_counter import IndexCounter, counter_table_schema
@@ -83,7 +89,12 @@ class Garage:
         self.block_manager = BlockManager(
             config, self.db, self.system, self.data_rep
         )
-        self.block_resync = BlockResyncManager(self.block_manager, self.db)
+        self.block_resync = BlockResyncManager(
+            self.block_manager, self.db,
+            persister=Persister(
+                config.metadata_dir, "resync_cfg", ResyncPersistedConfig
+            ),
+        )
         self.block_manager.resync = self.block_resync
 
         # --- tables, wired bottom-up so hooks can reach lower tables ---
@@ -186,15 +197,49 @@ class Garage:
             self.bg.spawn(SyncWorker(t.syncer))
             self.bg.spawn(GcWorker(t.gc))
             self.bg.spawn(InsertQueueWorker(t))
-        n_resync = int(os.environ.get("GARAGE_TPU_RESYNC_WORKERS", "1"))
-        for i in range(n_resync):
+        # Spawn the max worker count; the active number is the runtime-
+        # tunable persisted `n_workers` — idle extras cost one sleeping
+        # task each (ref resync.rs:481-567 + block/manager.rs:209-227).
+        for i in range(MAX_RESYNC_WORKERS):
             self.bg.spawn(ResyncWorker(self.block_resync, index=i))
-        self.scrub_worker = ScrubWorker(self.block_manager)
+        self.scrub_worker = ScrubWorker(
+            self.block_manager,
+            persister=Persister(
+                self.config.metadata_dir, "scrub_info", ScrubWorkerState
+            ),
+        )
         self.bg.spawn(self.scrub_worker)
         self.bg_vars.register_rw(
             "resync-tranquility",
             lambda: self.block_resync.tranquility,
-            lambda v: setattr(self.block_resync, "tranquility", int(v)),
+            self.block_resync.set_tranquility,
+        )
+        self.bg_vars.register_rw(
+            "resync-worker-count",
+            lambda: self.block_resync.n_workers,
+            self.block_resync.set_n_workers,
+        )
+        self.bg_vars.register_rw(
+            "scrub-tranquility",
+            lambda: self.scrub_worker.state.tranquility,
+            self.scrub_worker.set_tranquility,
+        )
+        from .s3.lifecycle_worker import LifecycleWorker, LifecycleWorkerPersisted
+
+        self.lifecycle_worker = LifecycleWorker(
+            self,
+            Persister(
+                self.config.metadata_dir, "lifecycle_worker_state",
+                LifecycleWorkerPersisted,
+            ),
+        )
+        self.bg.spawn(self.lifecycle_worker)
+        self.bg_vars.register_ro(
+            "lifecycle-last-completed",
+            lambda: (
+                self.lifecycle_worker.last_completed.isoformat()
+                if self.lifecycle_worker.last_completed else "never"
+            ),
         )
 
     def helper(self):

@@ -15,7 +15,13 @@ from garage_tpu.block import (
     ScrubWorker,
 )
 from garage_tpu.block.layout import DRIVE_NPART, drive_partition
-from garage_tpu.block.repair import BlockStoreIterator, RebalanceWorker
+from garage_tpu.block.repair import (
+    BlockStoreIterator,
+    RebalanceWorker,
+    ScrubWorkerState,
+)
+from garage_tpu.block.resync import ResyncPersistedConfig
+from garage_tpu.utils.persister import Persister
 from garage_tpu.db import open_db
 from garage_tpu.rpc.replication_mode import parse_replication_mode
 from garage_tpu.table import TableShardedReplication
@@ -213,6 +219,53 @@ async def test_scrub_batch_detects_corruption(tmp_path):
     assert m.resync.queue_len() == 3
     present = sum(1 for h in hashes if m.is_block_present(h))
     assert present == 17
+    await shutdown(systems)
+
+
+async def test_scrub_checkpoint_and_resume(tmp_path):
+    """Kill mid-scrub (drop the worker), restart from the same persister:
+    the new worker resumes running from the checkpointed position
+    (ref repair.rs:185-229 persisted scrub state)."""
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    for _ in range(40):
+        d = os.urandom(5_000)
+        await m.write_block(blake2s_sum(d), DataBlock.plain(d))
+    pers = Persister(str(tmp_path / "meta"), "scrub_info", ScrubWorkerState)
+    w = ScrubWorker(m, persister=pers)
+    w.send_command("start")
+    await w.work()   # applies start (checkpoints), scrubs the first prefix
+    w._checkpoint(force=True)
+    pos = w.iterator.position
+    assert w.state.running and pos > 0
+
+    # "kill -9": drop w without any shutdown; restart from disk
+    w2 = ScrubWorker(m, persister=pers)
+    assert w2.state.running
+    assert w2.iterator is not None and w2.iterator.position == pos
+    while (await w2.work()).name in ("BUSY", "THROTTLED"):
+        pass
+    assert not w2.state.running and w2.state.time_last_complete > 0
+    # completion checkpointed: a third restart schedules the next run
+    w3 = ScrubWorker(m, persister=pers)
+    assert not w3.state.running and w3.iterator is None
+    assert w3.state.time_next_run > 0
+    await shutdown(systems)
+
+
+async def test_resync_config_persists(tmp_path):
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    pers = Persister(str(tmp_path / "meta"), "resync_cfg", ResyncPersistedConfig)
+    r = BlockResyncManager(m, open_db("memory"), persister=pers)
+    r.set_n_workers(4)
+    r.set_tranquility(7)
+    with pytest.raises(ValueError):
+        r.set_n_workers(0)
+    with pytest.raises(ValueError):
+        r.set_n_workers(99)
+    r2 = BlockResyncManager(m, open_db("memory"), persister=pers)
+    assert r2.n_workers == 4 and r2.tranquility == 7
     await shutdown(systems)
 
 

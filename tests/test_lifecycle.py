@@ -1,0 +1,147 @@
+"""Lifecycle worker tests: expiration, abort-incomplete-MPU, bucket
+skipping and persisted completion date (ref model/s3/lifecycle_worker.rs
+semantics, SURVEY.md §2.6)."""
+
+import datetime
+
+import pytest
+
+from garage_tpu.model.s3.lifecycle_worker import (
+    LifecycleWorker,
+    LifecycleWorkerPersisted,
+    next_date,
+    today,
+)
+from garage_tpu.model.s3.object_table import (
+    Object,
+    ObjectVersion,
+    ObjectVersionData,
+    ObjectVersionHeaders,
+    ObjectVersionMeta,
+)
+from garage_tpu.utils.crdt import now_msec
+from garage_tpu.utils.data import gen_uuid
+from garage_tpu.utils.persister import Persister
+
+from test_model import complete_version, make_garage_cluster, shutdown
+
+pytestmark = pytest.mark.asyncio
+
+
+def days_ago_ms(n: int) -> int:
+    return now_msec() - n * 86_400_000
+
+
+async def make_lifecycle_env(tmp_path, rules):
+    garages = await make_garage_cluster(tmp_path)
+    g = garages[0]
+    helper = g.helper()
+    bucket = await helper.create_bucket("lcbkt")
+    bucket.params().lifecycle_config.update(rules)
+    await g.bucket_table.insert(bucket)
+    return garages, g, bucket
+
+
+def make_worker(tmp_path, g) -> LifecycleWorker:
+    pers = Persister(str(tmp_path / "lw"), "state", LifecycleWorkerPersisted)
+    return LifecycleWorker(g, pers)
+
+
+async def run_pass(w: LifecycleWorker):
+    while (await w.work()).name in ("BUSY", "THROTTLED"):
+        pass
+
+
+async def test_expiration_after_days(tmp_path):
+    garages, g, bucket = await make_lifecycle_env(tmp_path, [
+        {"enabled": True, "prefix": "", "expiration_days": 2},
+    ])
+    # old object: version written 5 days ago → expired
+    old = Object(bucket.id, "old.txt",
+                 [complete_version(gen_uuid(), days_ago_ms(5), b"x" * 10)])
+    # fresh object: written now → kept
+    fresh = Object(bucket.id, "fresh.txt",
+                   [complete_version(gen_uuid(), now_msec(), b"y" * 10)])
+    await g.object_table.insert(old)
+    await g.object_table.insert(fresh)
+
+    w = make_worker(tmp_path, g)
+    assert w.date == today()
+    await run_pass(w)
+    assert w.objects_expired == 1
+
+    got_old = await g.object_table.get(bucket.id, "old.txt")
+    assert got_old.last_data_version() is None  # delete marker is newest
+    got_fresh = await g.object_table.get(bucket.id, "fresh.txt")
+    assert got_fresh.last_data_version() is not None
+
+    # completion persisted: a new worker for the same day is idle
+    w2 = make_worker(tmp_path, g)
+    assert w2.date is None
+    assert w2.last_completed == today()
+    await shutdown(garages)
+
+
+async def test_expiration_at_date_and_prefix(tmp_path):
+    garages, g, bucket = await make_lifecycle_env(tmp_path, [
+        {"enabled": True, "prefix": "logs/",
+         "expiration_date": (today() - datetime.timedelta(days=1)).isoformat()},
+    ])
+    o1 = Object(bucket.id, "logs/a",
+                [complete_version(gen_uuid(), days_ago_ms(3), b"z")])
+    o2 = Object(bucket.id, "data/a",
+                [complete_version(gen_uuid(), days_ago_ms(3), b"z")])
+    await g.object_table.insert(o1)
+    await g.object_table.insert(o2)
+    w = make_worker(tmp_path, g)
+    await run_pass(w)
+    assert w.objects_expired == 1
+    assert (await g.object_table.get(bucket.id, "logs/a")).last_data_version() is None
+    assert (await g.object_table.get(bucket.id, "data/a")).last_data_version() is not None
+    await shutdown(garages)
+
+
+async def test_abort_incomplete_mpu(tmp_path):
+    garages, g, bucket = await make_lifecycle_env(tmp_path, [
+        {"enabled": True, "prefix": "", "abort_incomplete_days": 1},
+    ])
+    h = ObjectVersionHeaders.new()
+    stale = ObjectVersion.uploading(gen_uuid(), days_ago_ms(4), True, h)
+    recent = ObjectVersion.uploading(gen_uuid(), now_msec(), True, h)
+    await g.object_table.insert(Object(bucket.id, "up.bin", [stale]))
+    await g.object_table.insert(Object(bucket.id, "up2.bin", [recent]))
+    w = make_worker(tmp_path, g)
+    await run_pass(w)
+    assert w.mpu_aborted == 1
+    got = await g.object_table.get(bucket.id, "up.bin")
+    assert all(v.is_aborted() or not v.is_uploading() for v in got.versions())
+    got2 = await g.object_table.get(bucket.id, "up2.bin")
+    assert any(v.is_uploading() for v in got2.versions())
+    await shutdown(garages)
+
+
+async def test_disabled_rules_and_size_filter(tmp_path):
+    garages, g, bucket = await make_lifecycle_env(tmp_path, [
+        {"enabled": False, "prefix": "", "expiration_days": 1},
+        {"enabled": True, "prefix": "", "expiration_days": 1, "size_gt": 100},
+    ])
+    small = Object(bucket.id, "small",
+                   [complete_version(gen_uuid(), days_ago_ms(5), b"s" * 10)])
+    big = Object(bucket.id, "big",
+                 [complete_version(gen_uuid(), days_ago_ms(5), b"b" * 200)])
+    await g.object_table.insert(small)
+    await g.object_table.insert(big)
+    w = make_worker(tmp_path, g)
+    await run_pass(w)
+    assert w.objects_expired == 1
+    assert (await g.object_table.get(bucket.id, "small")).last_data_version() is not None
+    assert (await g.object_table.get(bucket.id, "big")).last_data_version() is None
+    await shutdown(garages)
+
+
+async def test_next_date_boundary():
+    # a version written at 2026-01-01T23:59 counts from 2026-01-02
+    ts = int(datetime.datetime(
+        2026, 1, 1, 23, 59, tzinfo=datetime.timezone.utc
+    ).timestamp() * 1000)
+    assert next_date(ts) == datetime.date(2026, 1, 2)

@@ -325,3 +325,24 @@ async def test_object_counters(tmp_path):
     assert t.get(BYTES) == 30
     assert t.get(UNFINISHED_UPLOADS, 0) == 0
     await shutdown(garages)
+
+
+async def test_worker_vars_persist_across_restart(tmp_path):
+    """`worker set` tunables survive a daemon restart (ref
+    block/manager.rs:209-227 + resync.rs:143-173 persisted vars)."""
+    garages = await make_garage_cluster(tmp_path)
+    g = garages[0]
+    g.spawn_workers()
+    g.bg_vars.set("resync-worker-count", 4)
+    g.bg_vars.set("resync-tranquility", 5)
+    g.bg_vars.set("scrub-tranquility", 9)
+    assert g.bg_vars.get("resync-worker-count") == 4
+    assert g.bg_vars.all()["scrub-tranquility"] == 9
+    await shutdown(garages)
+
+    g2 = Garage(mkconfig(tmp_path, 0))
+    g2.spawn_workers()
+    assert g2.block_resync.n_workers == 4
+    assert g2.block_resync.tranquility == 5
+    assert g2.scrub_worker.state.tranquility == 9
+    await g2.shutdown()

@@ -480,4 +480,196 @@ async def test_website_cors_lifecycle_config(tmp_path):
     assert status == 204
     status, _, _ = await client.req("GET", "/cfg", query=[("lifecycle", "")])
     assert status == 404
+
+    # AWS <And>-wrapped filter with size predicates (boto3 emits this form
+    # whenever a Filter has 2+ predicates); round-trip must preserve them
+    lx2 = (
+        "<LifecycleConfiguration><Rule>"
+        "<ID>r2</ID><Status>Enabled</Status>"
+        "<Filter><And><Prefix>logs/</Prefix>"
+        "<ObjectSizeGreaterThan>100</ObjectSizeGreaterThan>"
+        "<ObjectSizeLessThan>5000</ObjectSizeLessThan></And></Filter>"
+        "<Expiration><Days>3</Days></Expiration>"
+        "</Rule></LifecycleConfiguration>"
+    ).encode()
+    status, _, _ = await client.req("PUT", "/cfg", query=[("lifecycle", "")], body=lx2)
+    assert status == 200
+    status, _, body = await client.req("GET", "/cfg", query=[("lifecycle", "")])
+    assert b"<And>" in body and b"logs/" in body
+    assert b"<ObjectSizeGreaterThan>100<" in body
+    assert b"<ObjectSizeLessThan>5000<" in body
+
+    # malformed numeric filter → 400, not 500
+    bad = lx2.replace(b">100<", b">abc<")
+    status, _, _ = await client.req("PUT", "/cfg", query=[("lifecycle", "")], body=bad)
+    assert status == 400
+    await stop_all(garages, server)
+
+
+# --- PostObject (browser form uploads, ref api/s3/post_object.rs) ----------
+
+
+def _post_form(client, fields, file_data, filename="f.bin"):
+    """Build a multipart/form-data body like a browser would."""
+    boundary = "gtboundary42"
+    parts = []
+    for k, v in fields.items():
+        parts.append(
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="{k}"\r\n\r\n{v}\r\n'.encode()
+        )
+    parts.append(
+        f"--{boundary}\r\nContent-Disposition: form-data; name=\"file\"; "
+        f'filename="{filename}"\r\nContent-Type: '
+        "application/octet-stream\r\n\r\n".encode()
+        + file_data + b"\r\n"
+    )
+    parts.append(f"--{boundary}--\r\n".encode())
+    return b"".join(parts), f"multipart/form-data; boundary={boundary}"
+
+
+def _make_policy(client, bucket, conditions, expire_secs=3600):
+    import base64
+    import datetime as dt
+    import json
+
+    now = dt.datetime.now(dt.timezone.utc)
+    exp = (now + dt.timedelta(seconds=expire_secs)).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    date0 = now.strftime("%Y%m%dT%H%M%SZ")
+    cred0 = f"{client.key_id}/{date0[:8]}/{client.region}/s3/aws4_request"
+    # real browser policies always cover the credential/date fields
+    conditions = conditions + [
+        {"x-amz-credential": cred0},
+        {"x-amz-date": date0},
+    ]
+    policy = {"expiration": exp, "conditions": conditions}
+    policy_b64 = base64.b64encode(json.dumps(policy).encode()).decode()
+    date = now.strftime("%Y%m%dT%H%M%SZ")
+    cred = f"{client.key_id}/{date[:8]}/{client.region}/s3/aws4_request"
+    sk = signing_key(client.secret, date[:8], client.region)
+    sig = hmac_mod.new(sk, policy_b64.encode(), hashlib.sha256).hexdigest()
+    return policy_b64, cred, sig, date
+
+
+async def post_object(client, bucket, fields, file_data, **kw):
+    body, ctype = _post_form(client, fields, file_data, **kw)
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"{client.base}/{bucket}", data=body,
+            headers={"Content-Type": ctype},
+            allow_redirects=False,
+        ) as r:
+            return r.status, r.headers.copy(), await r.read()
+
+
+async def test_post_object(tmp_path):
+    garages, server, client, key = await make_api_cluster(tmp_path)
+    await client.req("PUT", "/postbkt")
+    data = b"form upload payload" * 100
+
+    policy_b64, cred, sig, date = _make_policy(client, "postbkt", [
+        {"bucket": "postbkt"},
+        ["starts-with", "$key", "up/"],
+        ["content-length-range", 1, 10_000_000],
+    ])
+    st, h, body = await post_object(client, "postbkt", {
+        "key": "up/${filename}",
+        "bucket": "postbkt",
+        "policy": policy_b64,
+        "x-amz-credential": cred,
+        "x-amz-signature": sig,
+        "x-amz-date": date,
+    }, data, filename="hello.bin")
+    assert st == 204, (st, body[:300])
+
+    st, _, got = await client.req("GET", "/postbkt/up/hello.bin")
+    assert st == 200 and got == data
+
+    # policy violation: key outside the allowed prefix
+    st, _, body = await post_object(client, "postbkt", {
+        "key": "outside.bin",
+        "bucket": "postbkt",
+        "policy": policy_b64,
+        "x-amz-credential": cred,
+        "x-amz-signature": sig,
+        "x-amz-date": date,
+    }, data)
+    assert st == 400, (st, body[:300])
+
+    # field not covered by the policy → rejected
+    st, _, body = await post_object(client, "postbkt", {
+        "key": "up/a.bin",
+        "bucket": "postbkt",
+        "policy": policy_b64,
+        "x-amz-credential": cred,
+        "x-amz-signature": sig,
+        "x-amz-date": date,
+        "x-amz-meta-extra": "nope",
+    }, data)
+    assert st == 400, (st, body[:300])
+
+    # bad signature → 403
+    st, _, body = await post_object(client, "postbkt", {
+        "key": "up/b.bin",
+        "bucket": "postbkt",
+        "policy": policy_b64,
+        "x-amz-credential": cred,
+        "x-amz-signature": "0" * 64,
+        "x-amz-date": date,
+    }, data)
+    assert st == 403, (st, body[:300])
+
+    # file too large for content-length-range
+    policy2, cred2, sig2, date2 = _make_policy(client, "postbkt", [
+        {"bucket": "postbkt"},
+        ["starts-with", "$key", ""],
+        ["content-length-range", 1, 10],
+    ])
+    st, _, body = await post_object(client, "postbkt", {
+        "key": "up/big.bin",
+        "bucket": "postbkt",
+        "policy": policy2,
+        "x-amz-credential": cred2,
+        "x-amz-signature": sig2,
+        "x-amz-date": date2,
+    }, data)
+    assert st == 400, (st, body[:300])
+    st, _, _ = await client.req("GET", "/postbkt/up/big.bin")
+    assert st == 404  # aborted upload left no object
+
+    # success_action_status=201 returns the XML response
+    policy3, cred3, sig3, date3 = _make_policy(client, "postbkt", [
+        {"bucket": "postbkt"},
+        ["starts-with", "$key", ""],
+        {"success_action_status": "201"},
+    ])
+    st, h, body = await post_object(client, "postbkt", {
+        "key": "up/xml.bin",
+        "bucket": "postbkt",
+        "policy": policy3,
+        "x-amz-credential": cred3,
+        "x-amz-signature": sig3,
+        "x-amz-date": date3,
+        "success_action_status": "201",
+    }, b"x")
+    assert st == 201 and b"<PostResponse" in body and b"up/xml.bin" in body
+    # Location must have the '/' between bucket path and key
+    assert "/postbkt/up/xml.bin" in h.get("Location", ""), h.get("Location")
+
+    # expired policy → 400
+    policy4, cred4, sig4, date4 = _make_policy(client, "postbkt", [
+        {"bucket": "postbkt"}, ["starts-with", "$key", ""],
+    ], expire_secs=-60)
+    st, _, body = await post_object(client, "postbkt", {
+        "key": "up/late.bin",
+        "bucket": "postbkt",
+        "policy": policy4,
+        "x-amz-credential": cred4,
+        "x-amz-signature": sig4,
+        "x-amz-date": date4,
+    }, b"x")
+    assert st == 400, (st, body[:300])
+
     await stop_all(garages, server)
