@@ -32,6 +32,17 @@ def _build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("server", help="run the storage daemon")
     sub.add_parser("node-id", help="print this node's id")
+
+    cdb = sub.add_parser(
+        "convert-db",
+        help="offline copy of metadata between db engines "
+             "(ref cli/convert_db.rs)",
+    )
+    cdb.add_argument("-i", dest="input_path", required=True)
+    cdb.add_argument("-a", dest="input_engine", required=True,
+                     help="sqlite | native | memory")
+    cdb.add_argument("-o", dest="output_path", required=True)
+    cdb.add_argument("-b", dest="output_engine", required=True)
     sub.add_parser("status", help="cluster status")
     sub.add_parser("stats", help="node statistics")
 
@@ -162,6 +173,44 @@ async def _amain(args) -> None:
         from .server import run_server
 
         await run_server(args.config)
+        return
+
+    if args.command == "convert-db":
+        from .db import open_db
+
+        src = open_db(args.input_engine, args.input_path)
+        dst = open_db(args.output_engine, args.output_path)
+        n_trees = n_rows = 0
+        for name in src.list_trees():
+            st = src.open_tree(name)
+            dt = dst.open_tree(name)
+            if not dt.is_empty():
+                print(f"error: output tree {name!r} is not empty", file=sys.stderr)
+                sys.exit(1)
+            # batch rows per commit: one insert per row would pay a full
+            # commit (and read-old-value) each (ref cli/convert_db.rs uses
+            # the engines' bulk import)
+            batch = []
+            for kv in st.items():
+                batch.append(kv)
+                if len(batch) >= 2000:
+                    rows = batch
+                    dst.transaction(lambda tx, rows=rows: [
+                        tx.insert(dt, k, v) for k, v in rows
+                    ])
+                    n_rows += len(batch)
+                    batch = []
+            if batch:
+                rows = batch
+                dst.transaction(lambda tx, rows=rows: [
+                    tx.insert(dt, k, v) for k, v in rows
+                ])
+                n_rows += len(batch)
+            n_trees += 1
+        src.close()
+        dst.close()
+        print(f"converted {n_trees} trees / {n_rows} rows "
+              f"({args.input_engine} -> {args.output_engine})")
         return
 
     if args.command == "node-id":

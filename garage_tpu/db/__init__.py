@@ -221,4 +221,10 @@ def open_db(engine: str, path: Optional[str] = None, **kw) -> Db:
         from .memory_adapter import MemoryDb
 
         return Db(MemoryDb())
+    if engine in ("native", "logdb"):
+        from .native_adapter import NativeDb
+
+        if path is None:
+            raise DbError("native engine requires a path")
+        return Db(NativeDb(path, **kw))
     raise DbError(f"unknown db engine {engine!r}")

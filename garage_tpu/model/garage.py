@@ -64,9 +64,15 @@ class Garage:
         if db is not None:
             self.db = db
         else:
+            is_native = config.db_engine in ("native", "logdb")
+            kw = {"fsync": config.metadata_fsync} if is_native else {}
             self.db = open_db(
                 config.db_engine,
-                path=os.path.join(config.metadata_dir, "db.sqlite"),
+                path=os.path.join(
+                    config.metadata_dir,
+                    "db.logdb" if is_native else "db.sqlite",
+                ),
+                **kw,
             )
 
         self.system = System(config, self.replication_mode)

@@ -99,7 +99,7 @@ class Config:
     rpc_public_addr: Optional[str] = None
     rpc_secret: Optional[str] = None
     bootstrap_peers: List[str] = field(default_factory=list)
-    db_engine: str = "sqlite"           # sqlite | memory (ref model/garage.rs:114-213)
+    db_engine: str = "sqlite"           # sqlite | native | memory (ref model/garage.rs:114-213)
     metadata_fsync: bool = True
     data_fsync: bool = False
     s3_api_bind_addr: Optional[str] = "0.0.0.0:3900"

@@ -12,10 +12,12 @@ from garage_tpu.db import TxAbort, open_db
 from garage_tpu.db.counted_tree import CountedTree
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "native"])
 def db(request, tmp_path):
     if request.param == "sqlite":
         d = open_db("sqlite", str(tmp_path / "db.sqlite"))
+    elif request.param == "native":
+        d = open_db("native", str(tmp_path / "db.logdb"))
     else:
         d = open_db("memory")
     yield d
@@ -168,3 +170,162 @@ def test_sqlite_snapshot(tmp_path):
     d2 = open_db("sqlite", str(tmp_path / "snap.sqlite"))
     assert d2.open_tree("t").get(b"k") == b"v"
     d2.close()
+
+
+# --- native engine specifics (logdb.cpp) -----------------------------------
+
+
+def test_native_durability_across_reopen(tmp_path):
+    p = str(tmp_path / "db.logdb")
+    d = open_db("native", p)
+    t = d.open_tree("t")
+    for i in range(100):
+        t.insert(i.to_bytes(4, "big"), b"val%d" % i)
+    t.remove((7).to_bytes(4, "big"))
+    d.transaction(lambda tx: (
+        tx.insert(t, b"txk", b"txv"), tx.remove(t, (8).to_bytes(4, "big"))
+    ))
+    d.close()
+
+    d2 = open_db("native", p)
+    t2 = d2.open_tree("t")
+    assert len(t2) == 99  # 100 - 2 removed + 1 tx insert
+    assert t2.get((7).to_bytes(4, "big")) is None
+    assert t2.get((8).to_bytes(4, "big")) is None
+    assert t2.get(b"txk") == b"txv"
+    assert t2.get((42).to_bytes(4, "big")) == b"val42"
+    d2.close()
+
+
+def test_native_torn_write_recovery(tmp_path):
+    """A torn (partial) trailing group must be invisible after reopen —
+    recovery truncates to the last commit record."""
+    p = str(tmp_path / "db.logdb")
+    d = open_db("native", p)
+    t = d.open_tree("t")
+    t.insert(b"good", b"committed")
+    d.close()
+
+    import struct
+
+    with open(p, "ab") as f:
+        # a valid-looking PUT record with correct CRC but NO commit after it
+        body = struct.pack("<BIII", 1, 0, 4, 4) + b"torn" + b"torn"
+        import zlib
+
+        f.write(struct.pack("<I", zlib.crc32(body)) + body)
+        # plus some garbage
+        f.write(b"\xde\xad\xbe\xef")
+
+    d2 = open_db("native", p)
+    t2 = d2.open_tree("t")
+    assert t2.get(b"good") == b"committed"
+    assert t2.get(b"torn") is None
+    # the file was truncated back; new writes go to the clean tail
+    t2.insert(b"after", b"recovery")
+    d2.close()
+    d3 = open_db("native", p)
+    assert d3.open_tree("t").get(b"after") == b"recovery"
+    d3.close()
+
+
+def test_native_compaction_preserves_data(tmp_path):
+    import os
+
+    p = str(tmp_path / "db.logdb")
+    d = open_db("native", p)
+    t = d.open_tree("t")
+    # churn: many overwrites → mostly-dead log
+    for round_ in range(20):
+        for i in range(50):
+            t.insert(i.to_bytes(4, "big"), os.urandom(500))
+    before = os.path.getsize(p)
+    d.backend.compact()
+    after = os.path.getsize(p)
+    assert after < before / 3
+    assert len(t) == 50
+    vals = dict(t.items())
+    d.close()
+    d2 = open_db("native", p)
+    assert dict(d2.open_tree("t").items()) == vals
+    d2.close()
+
+
+def test_native_snapshot(tmp_path):
+    p = str(tmp_path / "db.logdb")
+    d = open_db("native", p)
+    t = d.open_tree("t")
+    t.insert(b"k", b"v")
+    d.snapshot(str(tmp_path / "snap.logdb"))
+    t.insert(b"k2", b"after-snapshot")
+    d.close()
+    d2 = open_db("native", str(tmp_path / "snap.logdb"))
+    t2 = d2.open_tree("t")
+    assert t2.get(b"k") == b"v" and t2.get(b"k2") is None
+    d2.close()
+
+
+def test_convert_db_preserves_garage_state(tmp_path):
+    """convert-db sqlite→native: a node's full metadata survives the
+    engine swap (ref cli/convert_db.rs)."""
+    import subprocess
+    import sys
+
+    sqlite_p = str(tmp_path / "db.sqlite")
+    native_p = str(tmp_path / "db.logdb")
+    d = open_db("sqlite", sqlite_p)
+    trees = {}
+    for name in ("object:table", "bucket_v2:table", "key:table",
+                 "block_local_rc"):
+        t = d.open_tree(name)
+        trees[name] = {}
+        for i in range(25):
+            k = b"%s-%d" % (name.encode(), i)
+            v = b"payload-%d" % i * 3
+            t.insert(k, v)
+            trees[name][k] = v
+    d.close()
+
+    r = subprocess.run(
+        [sys.executable, "-m", "garage_tpu", "convert-db",
+         "-i", sqlite_p, "-a", "sqlite", "-o", native_p, "-b", "native"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "4 trees / 100 rows" in r.stdout
+
+    d2 = open_db("native", native_p)
+    for name, kv in trees.items():
+        assert dict(d2.open_tree(name).items()) == kv
+    d2.close()
+
+    # refuse to overwrite non-empty output
+    r2 = subprocess.run(
+        [sys.executable, "-m", "garage_tpu", "convert-db",
+         "-i", sqlite_p, "-a", "sqlite", "-o", native_p, "-b", "native"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        timeout=60,
+    )
+    assert r2.returncode == 1 and "not empty" in r2.stderr
+
+
+def test_native_runtime_compaction_bounds_log(tmp_path):
+    """Churn past the dead-bytes threshold must trigger compaction during
+    normal writes, not only at reopen."""
+    import os
+
+    p = str(tmp_path / "db.logdb")
+    d = open_db("native", p)
+    t = d.open_tree("t")
+    val = os.urandom(4096)
+    # ~40 MiB of overwrites of the same 64 keys (live ≈ 256 KiB)
+    for _ in range(160):
+        for i in range(64):
+            t.insert(i.to_bytes(4, "big"), val)
+    size = os.path.getsize(p)
+    assert size < 8 * (1 << 20), f"log grew unbounded: {size}"
+    assert len(t) == 64
+    d.close()
