@@ -93,7 +93,9 @@ class AdminApiServer:
         })
 
     async def handle_metrics(self, request) -> web.Response:
-        """Prometheus exposition (ref api_server.rs:271-335)."""
+        """Prometheus exposition of every layer's metrics (ref
+        api/admin/api_server.rs:271-335 + rpc/table/block/api metric
+        structs)."""
         tok = self.garage.config.admin_metrics_token
         if tok is not None:
             self._check_token(request, tok)
@@ -109,17 +111,12 @@ class AdminApiServer:
         gauge("cluster_available", 1 if h.status != "unavailable" else 0)
         gauge("cluster_connected_nodes", h.connected_nodes)
         gauge("cluster_known_nodes", h.known_nodes)
+        # refresh per-table observed gauges, then render the registry that
+        # the rpc/table/block/api layers record into
         for t in g.tables:
-            n = t.schema.TABLE_NAME
-            gauge(f'table_merkle_todo{{table_name="{n}"}}', t.data.merkle_todo_len())
-            gauge(f'table_gc_todo{{table_name="{n}"}}', t.data.gc_todo_len())
-        gauge("block_resync_queue_length", g.block_resync.queue_len())
-        gauge("block_resync_errored_blocks", g.block_resync.errors_len())
-        gauge("block_rc_entries", g.block_manager.rc_len())
-        gauge("block_bytes_read_total", g.block_manager.bytes_read)
-        gauge("block_bytes_written_total", g.block_manager.bytes_written)
-        gauge("block_corruptions_total", g.block_manager.corruptions)
-        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+            t.observe_gauges()
+        body = "\n".join(lines) + "\n" + g.system.metrics.render()
+        return web.Response(text=body, content_type="text/plain")
 
     async def handle_status(self, request) -> web.Response:
         self._admin(request)

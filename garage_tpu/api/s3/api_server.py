@@ -56,6 +56,19 @@ class S3ApiServer:
         # metrics (ref generic_server.rs:63-95)
         self.request_counter = 0
         self.error_counter = 0
+        m = getattr(garage.system, "metrics", None)
+        if m is not None:
+            reg = m.__dict__.setdefault("_api_shared", {})
+            if not reg:
+                reg["requests"] = m.counter(
+                    "api_request_counter", "API requests received")
+                reg["errors"] = m.counter(
+                    "api_error_counter", "API requests answered with an error")
+                reg["duration"] = m.histogram(
+                    "api_request_duration_seconds", "API request latency")
+            self._m = reg
+        else:
+            self._m = None
 
     # --- server lifecycle ---
 
@@ -81,28 +94,40 @@ class S3ApiServer:
 
     async def handle_request(self, request: web.Request) -> web.StreamResponse:
         self.request_counter += 1
-        try:
-            return await self._handle(request)
-        except (ApiError, GarageError, NoSuchBucket, NoSuchKey) as e:
-            self.error_counter += 1
-            status = getattr(e, "status", 500)
-            if status >= 500:
-                logger.exception("S3 API internal error")
-            else:
-                logger.debug("S3 API error %s: %s", status, e)
-            return web.Response(
-                status=status,
-                body=error_xml(e, request.path, bytes(gen_uuid()).hex()[:16]),
-                content_type="application/xml",
-            )
-        except Exception as e:  # noqa: BLE001 — uniform 500 rendering
-            self.error_counter += 1
-            logger.exception("S3 API unexpected error")
-            return web.Response(
-                status=500,
-                body=error_xml(e, request.path, ""),
-                content_type="application/xml",
-            )
+        import contextlib
+
+        if self._m is not None:
+            self._m["requests"].inc(api="s3")
+            timer = self._m["duration"].time(api="s3")
+        else:
+            timer = contextlib.nullcontext()
+        with timer:
+            try:
+                return await self._handle(request)
+            except (ApiError, GarageError, NoSuchBucket, NoSuchKey) as e:
+                self.error_counter += 1
+                status = getattr(e, "status", 500)
+                if self._m is not None:
+                    self._m["errors"].inc(api="s3", status=str(status))
+                if status >= 500:
+                    logger.exception("S3 API internal error")
+                else:
+                    logger.debug("S3 API error %s: %s", status, e)
+                return web.Response(
+                    status=status,
+                    body=error_xml(e, request.path, bytes(gen_uuid()).hex()[:16]),
+                    content_type="application/xml",
+                )
+            except Exception as e:  # noqa: BLE001 — uniform 500 rendering
+                self.error_counter += 1
+                if self._m is not None:
+                    self._m["errors"].inc(api="s3", status="500")
+                logger.exception("S3 API unexpected error")
+                return web.Response(
+                    status=500,
+                    body=error_xml(e, request.path, ""),
+                    content_type="application/xml",
+                )
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         headers = {k.lower(): v for k, v in request.headers.items()}

@@ -208,11 +208,39 @@ def _part_offset(blocks, pn: int) -> int:
     return off
 
 
+class _BlockPump:
+    """Prefetch pump for one block: streams its decompressed chunks into a
+    bounded queue (constant memory) while earlier blocks are still being
+    written to the client — the buffered(PREFETCH) pipeline of
+    ref get.rs:458-466, minus the whole-block buffering."""
+
+    QUEUE_CHUNKS = 16  # ≈ 16 × 16 KiB transport chunks per in-flight block
+
+    def __init__(self, garage, h: Hash, order_tag: int):
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=self.QUEUE_CHUNKS)
+        self.task = asyncio.ensure_future(self._run(garage, h, order_tag))
+
+    async def _run(self, garage, h: Hash, order_tag: int) -> None:
+        try:
+            async for chunk in garage.block_manager.rpc_get_block_streaming(
+                h, order_tag
+            ):
+                await self.q.put(chunk)
+            await self.q.put(None)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # propagated to the writer loop
+            await self.q.put(e)
+
+
 async def _stream_blocks_range(
     ctx, hdrs: Dict[str, str], status: int, blocks, begin: int, end: int
 ) -> web.StreamResponse:
     """Stream the [begin, end) byte range assembled from its intersecting
-    blocks, prefetching ahead (ref get.rs:432-512 body_from_blocks_range)."""
+    blocks (ref get.rs:432-512 body_from_blocks_range): each block is
+    streamed chunk-by-chunk from the replica (with mid-transfer node
+    failover inside rpc_get_block_streaming), with the next PREFETCH
+    blocks' streams already being pumped."""
     garage = ctx.garage
     hdrs["Content-Length"] = str(end - begin)
     resp = web.StreamResponse(status=status, headers=hdrs)
@@ -228,26 +256,38 @@ async def _stream_blocks_range(
             continue
         todo.append((Hash(h), max(0, begin - b0), min(sz, end - b0)))
 
-    async def fetch(i_h):
-        i, h = i_h
-        return await garage.block_manager.rpc_get_block(h, order_tag=i)
+    n = len(todo)
+    pumps: Dict[int, _BlockPump] = {}
+    all_pumps: List[_BlockPump] = []
 
-    # prefetch pipeline: keep PREFETCH+1 block fetches in flight
-    tasks: List[asyncio.Task] = []
+    def spawn(idx: int) -> None:
+        pumps[idx] = p = _BlockPump(garage, todo[idx][0], idx)
+        all_pumps.append(p)
+
     try:
-        n = len(todo)
         for i in range(min(PREFETCH + 1, n)):
-            tasks.append(asyncio.ensure_future(fetch((i, todo[i][0]))))
+            spawn(i)
         for i in range(n):
-            data = await tasks[i]
+            pump = pumps.pop(i)
             nxt = i + PREFETCH + 1
             if nxt < n:
-                tasks.append(asyncio.ensure_future(fetch((nxt, todo[nxt][0]))))
+                spawn(nxt)
             s0, s1 = todo[i][1], todo[i][2]
-            await resp.write(data[s0:s1])
+            pos = 0
+            while True:
+                item = await pump.q.get()
+                if item is None:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                c0, c1 = pos, pos + len(item)
+                pos = c1
+                lo, hi = max(c0, s0), min(c1, s1)
+                if hi > lo:
+                    await resp.write(item[lo - c0 : hi - c0])
         await resp.write_eof()
     finally:
-        for t in tasks:
-            if not t.done():
-                t.cancel()
+        for p in all_pumps:
+            if not p.task.done():
+                p.task.cancel()
     return resp

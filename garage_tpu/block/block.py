@@ -6,8 +6,11 @@ the content hash for plain data and the zstd frame checksum for compressed
 data (block.rs:66-78); `from_buffer` compresses when it shrinks the block
 (block.rs:80-91).
 
-The hash/verify primitives route through the BlockCodec so single-block
-ops and batched scrub ops share one implementation.
+`verify` routes through a BlockCodec when one is supplied (the
+BlockManager read path passes its codec — `codec.verify_one`, whose
+default is defined in terms of the same batch_verify the scrub path
+uses); without a codec it falls back to hashlib directly (standalone
+DataBlock uses in tests/tools).
 """
 
 from __future__ import annotations
@@ -70,15 +73,20 @@ class DataBlock:
     def header(self) -> DataBlockHeader:
         return DataBlockHeader(self.compressed)
 
-    def verify(self, hash: Hash, algo: str = "blake2s") -> None:
+    def verify(self, hash: Hash, algo: str = "blake2s", codec=None) -> None:
         """ref block.rs:66-78: plain → content hash must match; compressed →
         zstd frame checksum validates (content hash covers the *uncompressed*
-        bytes, which we don't have without decompressing)."""
+        bytes, which we don't have without decompressing).  With `codec`,
+        plain-block hashing goes through codec.verify_one — the same seam
+        the batched scrub path uses."""
         if self.compressed:
             try:
                 zstandard.ZstdDecompressor().decompress(self.inner)
             except zstandard.ZstdError as e:
                 raise CorruptData(f"zstd verify failed: {e}") from None
+        elif codec is not None:
+            if not codec.verify_one(self.inner, hash):
+                raise CorruptData(f"hash mismatch for block {hash.hex()[:16]}")
         else:
             if bytes(block_hash(self.inner, algo)) != bytes(hash):
                 raise CorruptData(f"hash mismatch for block {hash.hex()[:16]}")

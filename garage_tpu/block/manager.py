@@ -12,8 +12,10 @@ Equivalent of reference src/block/manager.rs (SURVEY.md §2.5):
   - 256-way sharded mutation locks (manager.rs:115) serialize writes to the
     same block without a global lock.
 
-TPU-first: single-block verify routes through the same BlockCodec used by
-the batch scrub path, so cpu/tpu backends share semantics.
+TPU-first: read-path verify goes through `codec.verify_one` — defined by
+default in terms of the same batch_verify the scrub path uses (the TPU
+codec overrides it with a bit-identical host hash so single reads never
+pay a device roundtrip; batched scrub/resync still run on device).
 """
 
 from __future__ import annotations
@@ -84,10 +86,33 @@ class BlockManager:
         # attached after construction (circular dep): BlockResyncManager
         self.resync = None
 
-        # metrics counters (ref block/metrics.rs)
+        # metrics counters (ref block/metrics.rs:7-127)
         self.bytes_read = 0
         self.bytes_written = 0
         self.corruptions = 0
+        m = getattr(system, "metrics", None)
+        if m is not None:
+            m.gauge("block_compression_level", "Configured zstd level",
+                    fn=lambda: self.compression_level or 0)
+            m.gauge("block_rc_entries", "Refcounted block entries",
+                    fn=self.rc_len)
+            m.gauge("block_resync_queue_length", "Blocks awaiting resync",
+                    fn=lambda: self.resync.queue_len() if self.resync else 0)
+            m.gauge("block_resync_errored_blocks",
+                    "Blocks in resync error backoff",
+                    fn=lambda: self.resync.errors_len() if self.resync else 0)
+            m.gauge("block_bytes_read_total", "Block payload bytes read",
+                    fn=lambda: self.bytes_read)
+            m.gauge("block_bytes_written_total", "Block payload bytes written",
+                    fn=lambda: self.bytes_written)
+            m.gauge("block_corruptions_total", "Corrupted blocks detected",
+                    fn=lambda: self.corruptions)
+            self.m_read_dur = m.histogram(
+                "block_read_duration_seconds", "Local block read+verify")
+            self.m_write_dur = m.histogram(
+                "block_write_duration_seconds", "Local block write")
+        else:
+            self.m_read_dur = self.m_write_dur = None
 
     # --- paths ---
 
@@ -120,8 +145,12 @@ class BlockManager:
     # --- local read/write (ref manager.rs:478-590,689-784) ---
 
     async def write_block(self, h: Hash, data: DataBlock) -> None:
-        async with self._lock_for(h):
-            await asyncio.to_thread(self._write_block_sync, h, data)
+        import contextlib
+
+        timer = self.m_write_dur.time() if self.m_write_dur else contextlib.nullcontext()
+        with timer:
+            async with self._lock_for(h):
+                await asyncio.to_thread(self._write_block_sync, h, data)
 
     def _write_block_sync(self, h: Hash, data: DataBlock) -> None:
         root = self.data_layout.primary_dir(h)
@@ -160,6 +189,13 @@ class BlockManager:
     async def read_block(self, h: Hash) -> DataBlock:
         """Read + verify; on corruption move the file aside and requeue a
         resync so a good copy is re-fetched (ref manager.rs:528-590)."""
+        import contextlib
+
+        timer = self.m_read_dur.time() if self.m_read_dur else contextlib.nullcontext()
+        with timer:
+            return await self._read_block_inner(h)
+
+    async def _read_block_inner(self, h: Hash) -> DataBlock:
         found = self.find_block(h)
         if found is None:
             raise NoSuchBlock(f"block {bytes(h).hex()[:16]} not found locally")
@@ -167,7 +203,7 @@ class BlockManager:
         raw = await asyncio.to_thread(_read_file, path)
         block = DataBlock(raw, compressed)
         try:
-            block.verify(h, self.hash_algo)
+            block.verify(h, self.hash_algo, codec=self.codec)
         except CorruptData:
             self.corruptions += 1
             logger.error("corrupted block %s at %s", bytes(h).hex()[:16], path)
@@ -268,6 +304,57 @@ class BlockManager:
                 errors.append(f"{bytes(node).hex()[:8]}: {e}")
         raise GarageError(
             f"could not get block {bytes(h).hex()[:16]} from any node: {errors}"
+        )
+
+    async def rpc_get_block_streaming(
+        self, h: Hash, order_tag: Optional[int] = None
+    ) -> AsyncIterator[bytes]:
+        """Async-iterate a block's DECOMPRESSED bytes with mid-transfer
+        node failover: if the serving node dies mid-stream, the read
+        resumes from the next replica, skipping the bytes already
+        delivered (ref manager.rs:231-345 + the get-path streaming of
+        get.rs:432-512).  Memory stays bounded by the transport chunk
+        size — the block is never buffered whole."""
+        who = self.system.rpc.request_order(self.replication.read_nodes(h))
+        delivered = 0
+        errors = []
+        for node in who:
+            try:
+                resp, stream = await self.endpoint.call_streaming(
+                    node,
+                    {"t": "get_block", "h": bytes(h), "order": order_tag},
+                    prio=PRIO_NORMAL,
+                    timeout=BLOCK_RW_TIMEOUT,
+                )
+                if resp.get("err"):
+                    raise NoSuchBlock(resp["err"])
+                compressed = DataBlockHeader.unpack(resp["hdr"]).compressed
+                decomp = None
+                if compressed:
+                    import zstandard
+
+                    decomp = zstandard.ZstdDecompressor().decompressobj()
+                skip = delivered
+                if stream is not None:
+                    async for chunk in stream:
+                        out = decomp.decompress(chunk) if decomp else chunk
+                        if not out:
+                            continue
+                        if skip:
+                            if len(out) <= skip:
+                                skip -= len(out)
+                                continue
+                            out = out[skip:]
+                            skip = 0
+                        delivered += len(out)
+                        self.bytes_read += len(out)
+                        yield out
+                return
+            except (GarageError, OSError, asyncio.TimeoutError) as e:
+                errors.append(f"{bytes(node).hex()[:8]}: {e}")
+        raise GarageError(
+            f"could not stream block {bytes(h).hex()[:16]} from any node "
+            f"(delivered {delivered} bytes): {errors}"
         )
 
     async def need_block(self, h: Hash) -> bool:

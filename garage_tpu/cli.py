@@ -43,6 +43,12 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="sqlite | native | memory")
     cdb.add_argument("-o", dest="output_path", required=True)
     cdb.add_argument("-b", dest="output_engine", required=True)
+
+    sub.add_parser(
+        "offline-repair-counters",
+        help="rebuild index counters from local table rows; daemon must be "
+             "stopped (ref repair/offline.rs:11-47 + index_counter.rs:252+)",
+    )
     sub.add_parser("status", help="cluster status")
     sub.add_parser("stats", help="node statistics")
 
@@ -211,6 +217,26 @@ async def _amain(args) -> None:
         dst.close()
         print(f"converted {n_trees} trees / {n_rows} rows "
               f"({args.input_engine} -> {args.output_engine})")
+        return
+
+    if args.command == "offline-repair-counters":
+        from .model import Garage
+        from .utils.config import read_config
+
+        g = Garage(read_config(args.config))  # no listen: offline
+        jobs = [
+            (g.object_counter, g.object_table,
+             lambda e: (bytes(e.bucket_id), "")),
+            (g.mpu_counter, g.mpu_table,
+             lambda e: (bytes(e.bucket_id), "")),
+            (g.k2v_counter, g.k2v_item_table,
+             lambda e: (bytes(e.bucket_id), e.partition_key_str)),
+        ]
+        for counter, table, key_fn in jobs:
+            z, n = counter.offline_recount_all(table, key_fn)
+            print(f"{counter.table.schema.TABLE_NAME}: zeroed {z}, "
+                  f"recounted {n} entries")
+        await g.shutdown()
         return
 
     if args.command == "node-id":

@@ -135,7 +135,7 @@ class IndexCounter:
             return
         tk = tree_key(pk, sk)
         cur = tx.get(self.local_counter, tk)
-        local: Dict[str, List[int]] = unpack(cur) if cur is not None else {}
+        local = _decode_local(cur, pk, sk)
         ts = now_msec()
         for name, delta in deltas.items():
             ent = local.get(name)
@@ -143,7 +143,10 @@ class IndexCounter:
                 local[name] = [ts, delta]
             else:
                 local[name] = [max(ts, ent[0] + 1), ent[1] + delta]
-        tx.insert(self.local_counter, tk, pack(local))
+        # the value carries (pk, sk) so offline recount can rebuild the
+        # CounterEntry from the row alone (ref index_counter.rs
+        # LocalCounterEntry { pk, sk, values })
+        tx.insert(self.local_counter, tk, pack([pk, sk, local]))
         # propagate this node's totals through the insert queue
         node = bytes(self.system.id)
         ce = CounterEntry(
@@ -164,4 +167,130 @@ class IndexCounter:
         cur = self.local_counter.get(tree_key(pk, sk))
         if cur is None:
             return {}
-        return {name: tv[1] for name, tv in unpack(cur).items()}
+        return {name: tv[1] for name, tv in _decode_local(cur, b"", "").items()}
+
+    # --- offline repair (ref index_counter.rs:252-377) ---
+
+    def offline_recount_all(self, counted_table, counter_key) -> Tuple[int, int]:
+        """Rebuild every local counter from the counted table's local rows.
+
+        Two passes, mirroring the reference: (1) zero every existing local
+        counter with a bumped timestamp (so the zero wins the per-node max-
+        timestamp merge everywhere), (2) walk the counted table's store and
+        re-accumulate each entry's counts.  Both passes queue propagation
+        of this node's totals; the insert-queue worker pushes them when the
+        daemon next runs.  MUST run offline — concurrent table updates
+        between the passes would be double- or un-counted.
+
+        `counter_key(entry) -> (pk, sk)` maps a counted entry to its
+        counter row (bucket id for objects/MPUs; (bucket, partition) for
+        K2V).  Returns (n_zeroed, n_recounted_entries).
+        """
+        db = self.local_counter.db
+        node = bytes(self.system.id)
+        now = now_msec()
+        n_zeroed = 0
+
+        # pass 1: zero old counters
+        cursor = b""
+        while True:
+            batch = []
+            k = cursor
+            while len(batch) < RECOUNT_BATCH:
+                nxt = self.local_counter.get_gt(k)
+                if nxt is None:
+                    break
+                batch.append(nxt)
+                k = nxt[0]
+            if not batch:
+                break
+            cursor = batch[-1][0]
+
+            def zero_batch(tx, batch=batch):
+                for tk, v in batch:
+                    pk, sk, local = _decode_local_full(v)
+                    for name, tv in local.items():
+                        local[name] = [max(tv[0] + 1, now), 0]
+                    tx.insert(self.local_counter, tk, pack([pk, sk, local]))
+                    if pk is not None:
+                        ce = CounterEntry(pk, sk, {
+                            name: {node: list(tv)}
+                            for name, tv in local.items()
+                        })
+                        self.table.data.queue_insert(tx, ce)
+
+            db.transaction(zero_batch)
+            n_zeroed += len(batch)
+
+        # pass 2: recount from the counted table's rows
+        n_entries = 0
+        store = counted_table.data.store
+        cursor = b""
+        while True:
+            batch = []
+            k = cursor
+            while len(batch) < RECOUNT_BATCH:
+                nxt = store.get_gt(k)
+                if nxt is None:
+                    break
+                batch.append(nxt)
+                k = nxt[0]
+            if not batch:
+                break
+            cursor = batch[-1][0]
+            # aggregate within the batch to one write per counter row
+            agg: Dict[bytes, Tuple[bytes, str, Dict[str, int]]] = {}
+            for _k, raw in batch:
+                ent = counted_table.data.decode_entry(raw)
+                pk, sk = counter_key(ent)
+                tk = tree_key(pk, sk)
+                slot = agg.setdefault(tk, (bytes(pk), sk, {}))
+                for name, v in ent.counts():
+                    slot[2][name] = slot[2].get(name, 0) + v
+                n_entries += 1
+
+            def add_batch(tx, agg=agg):
+                ts = now_msec()
+                for tk, (pk, sk, counts) in agg.items():
+                    cur = tx.get(self.local_counter, tk)
+                    local = _decode_local(cur, pk, sk)
+                    for name, v in counts.items():
+                        ent = local.get(name)
+                        if ent is None:
+                            local[name] = [max(ts, now + 1), v]
+                        else:
+                            local[name] = [max(ts, ent[0] + 1), ent[1] + v]
+                    tx.insert(self.local_counter, tk, pack([pk, sk, local]))
+                    ce = CounterEntry(pk, sk, {
+                        name: {node: list(tv)} for name, tv in local.items()
+                    })
+                    self.table.data.queue_insert(tx, ce)
+
+            db.transaction(add_batch)
+
+        logger.info(
+            "counter recount (%s): zeroed %d rows, recounted %d entries",
+            self.table.schema.TABLE_NAME, n_zeroed, n_entries,
+        )
+        return n_zeroed, n_entries
+
+
+RECOUNT_BATCH = 1000  # ref index_counter.rs recount batches
+
+
+def _decode_local(cur: Optional[bytes], pk: bytes, sk: str) -> Dict[str, List[int]]:
+    """Value → {name: [ts, v]}, accepting the legacy bare-dict format."""
+    if cur is None:
+        return {}
+    v = unpack(cur)
+    if isinstance(v, dict):
+        return v  # legacy rows without (pk, sk)
+    return v[2]
+
+
+def _decode_local_full(cur: bytes):
+    """Value → (pk | None, sk, {name: [ts, v]})."""
+    v = unpack(cur)
+    if isinstance(v, dict):
+        return None, "", v
+    return bytes(v[0]), v[1], v[2]

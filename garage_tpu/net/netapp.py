@@ -19,10 +19,13 @@ Design notes (asyncio-native, not a port):
     authenticated, not encrypted — same trust model as deployments of the
     reference that run RPC on a private network.
 
-Known simplification (round 1): incoming per-stream buffers are bounded by
-blocking the connection reader (head-of-line) rather than per-stream flow
-control; bodies are consumed promptly by the block layer so the window is
-rarely hit.
+Flow control (round 2): per-stream credit windows.  A sender may have at
+most STREAM_WINDOW chunks of one stream in flight; the receiver grants
+more credit (K_WIN frames at PRIO_HIGH) as the consumer drains the
+stream.  A slow consumer therefore stalls only its own stream's sender —
+the connection reader never blocks on a full stream buffer, so unrelated
+RPCs on the same connection keep flowing (the reference's netapp has the
+same property via per-stream channels).
 """
 
 from __future__ import annotations
@@ -57,6 +60,7 @@ from .frame import (
     K_PONG,
     K_REQ,
     K_RESP,
+    K_WIN,
     MAX_FRAME,
     N_PRIO,
     PRIO_HIGH,
@@ -71,7 +75,8 @@ NodeID = FixedBytes32
 
 MAGIC = b"GTPU/1\n"
 _OUT_QUEUE_LIMIT = 16       # frames buffered per priority level
-_IN_STREAM_LIMIT = 128      # chunks buffered per incoming stream (~2 MiB)
+_IN_STREAM_LIMIT = 128      # legacy bound (loopback streams only)
+STREAM_WINDOW = 64          # flow-control window per stream (64 × 16 KiB = 1 MiB)
 
 
 def gen_node_key() -> Ed25519PrivateKey:
@@ -111,14 +116,25 @@ def load_or_gen_node_key(path: str) -> Ed25519PrivateKey:
 
 
 class ByteStream:
-    """Incoming streaming body: async-iterate 16 KiB chunks."""
+    """Incoming streaming body: async-iterate 16 KiB chunks.
 
-    def __init__(self, limit: int = _IN_STREAM_LIMIT):
-        self._q: asyncio.Queue = asyncio.Queue(maxsize=limit)
+    Connection-fed streams are flow-controlled: the remote sender holds at
+    most STREAM_WINDOW chunks in flight, and `on_consumed` (an async
+    callable) grants credit back as the consumer drains — so the queue
+    stays bounded WITHOUT ever blocking the connection reader.  Loopback
+    streams (no on_consumed) rely on the local producer awaiting _push."""
+
+    def __init__(self, on_consumed=None):
+        self._q: asyncio.Queue = asyncio.Queue()
         self._err: Optional[str] = None
+        self._on_consumed = on_consumed
+        self._consumed = 0
 
     async def _push(self, chunk: Optional[bytes]):
         await self._q.put(chunk)
+
+    def _push_nowait(self, chunk: Optional[bytes]):
+        self._q.put_nowait(chunk)
 
     def _fail(self, err: str):
         self._err = err
@@ -138,6 +154,14 @@ class ByteStream:
             if self._err is not None:
                 raise RpcError(f"stream error: {self._err}")
             raise StopAsyncIteration
+        if self._on_consumed is not None:
+            self._consumed += 1
+            if self._consumed >= STREAM_WINDOW // 2:
+                n, self._consumed = self._consumed, 0
+                try:
+                    await self._on_consumed(n)
+                except Exception:  # conn gone: the stream will fail anyway
+                    pass
         return chunk
 
     async def read_all(self) -> bytes:
@@ -225,6 +249,35 @@ class _OutMux:
             self.cv.notify_all()
 
 
+class _Credit:
+    """Sender-side flow-control window for one outgoing stream."""
+
+    __slots__ = ("n", "_ev", "_failed")
+
+    def __init__(self, n: int):
+        self.n = n
+        self._ev = asyncio.Event()
+        self._failed = False
+
+    async def take(self) -> None:
+        while self.n <= 0:
+            if self._failed:
+                raise RpcError("connection lost (flow control)")
+            self._ev.clear()
+            await self._ev.wait()
+        if self._failed:
+            raise RpcError("connection lost (flow control)")
+        self.n -= 1
+
+    def grant(self, n: int) -> None:
+        self.n += n
+        self._ev.set()
+
+    def fail(self) -> None:
+        self._failed = True
+        self._ev.set()
+
+
 class Connection:
     """One authenticated, multiplexed peer connection."""
 
@@ -245,6 +298,7 @@ class Connection:
         self._out = _OutMux()
         self._pending: Dict[int, asyncio.Future] = {}   # stream -> resp future
         self._in_streams: Dict[int, ByteStream] = {}
+        self._send_credit: Dict[int, "_Credit"] = {}    # outgoing stream windows
         self._pings: Dict[bytes, asyncio.Future] = {}
         self._tasks: list = []
         self._closed = False
@@ -311,9 +365,15 @@ class Connection:
             self._pending.pop(sid, None)
 
     async def _pump_body(self, sid: int, prio: int, body: AsyncIterator[bytes]):
+        credit = _Credit(STREAM_WINDOW)
+        self._send_credit[sid] = credit
         try:
             async for chunk in body:
                 for i in range(0, len(chunk), CHUNK):
+                    # flow control: at most STREAM_WINDOW chunks of this
+                    # stream in flight; the receiver grants more (K_WIN)
+                    # as its consumer drains
+                    await credit.take()
                     await self._out.put(Frame(K_DATA, prio, sid, bytes(chunk[i : i + CHUNK])))
             await self._out.put(Frame(K_EOS, prio, sid, b""))
         except asyncio.CancelledError:
@@ -324,6 +384,8 @@ class Connection:
                 await self._out.put(Frame(K_ERR, prio, sid, str(e).encode()))
             except RpcError:
                 pass
+        finally:
+            self._send_credit.pop(sid, None)
 
     async def ping(self, timeout: float = 10.0) -> float:
         token = os.urandom(8)
@@ -373,6 +435,18 @@ class Connection:
         finally:
             await self._shutdown()
 
+    def _make_in_stream(self, sid: int) -> ByteStream:
+        """Flow-controlled incoming stream: grants window credit back to the
+        sender as the consumer drains (K_WIN at PRIO_HIGH so grants are
+        never stuck behind bulk data)."""
+
+        async def grant(n: int, _sid=sid):
+            await self._out.put(
+                Frame(K_WIN, PRIO_HIGH, _sid, struct.pack(">I", n))
+            )
+
+        return ByteStream(on_consumed=grant)
+
     async def _dispatch(self, kind: int, prio: int, sid: int, payload: bytes):
         if kind == K_REQ:
             hlen = struct.unpack(">I", payload[:4])[0]
@@ -380,7 +454,7 @@ class Connection:
             msg = payload[4 + hlen :]
             body = None
             if header.get("b"):
-                body = ByteStream()
+                body = self._make_in_stream(sid)
                 self._in_streams[sid] = body
             asyncio.get_running_loop().create_task(
                 self._handle_request(sid, prio, header["p"], msg, body)
@@ -393,7 +467,7 @@ class Connection:
             rheader = msgpack.unpackb(payload[4 : 4 + hlen], raw=False)
             stream = None
             if rheader.get("b"):
-                stream = ByteStream()
+                stream = self._make_in_stream(sid)
                 self._in_streams[sid] = stream
             fut = self._pending.get(sid)
             if fut is not None and not fut.done():
@@ -401,7 +475,13 @@ class Connection:
         elif kind == K_DATA:
             stream = self._in_streams.get(sid)
             if stream is not None:
-                await stream._push(payload)  # blocks reader when full (HOL)
+                # never blocks: the sender respects the credit window, so
+                # the queue holds at most ~STREAM_WINDOW chunks
+                stream._push_nowait(payload)
+        elif kind == K_WIN:
+            credit = self._send_credit.get(sid)
+            if credit is not None:
+                credit.grant(struct.unpack(">I", payload[:4])[0])
         elif kind == K_EOS:
             stream = self._in_streams.pop(sid, None)
             if stream is not None:
@@ -462,6 +542,9 @@ class Connection:
         for stream in self._in_streams.values():
             stream._fail("connection lost")
         self._in_streams.clear()
+        for credit in self._send_credit.values():
+            credit.fail()  # release pumps blocked on flow control
+        self._send_credit.clear()
         try:
             self.writer.close()
         except Exception:

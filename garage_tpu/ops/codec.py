@@ -54,6 +54,14 @@ class BlockCodec:
         got = self.batch_hash(blocks)
         return np.array([bytes(a) == bytes(b) for a, b in zip(got, hashes)], dtype=bool)
 
+    def verify_one(self, block: bytes, hash: Hash) -> bool:
+        """Single-block verify — the get/read path (ref block.rs:66-78).
+        Default routes through batch_verify so both backends share one
+        semantics definition; device backends override to avoid paying a
+        device roundtrip for one block (their batch paths still run on
+        device — the scrub/resync producers batch)."""
+        return bool(self.batch_verify([block], [hash])[0])
+
     # --- Reed-Solomon ---
     def rs_encode(self, data: np.ndarray) -> np.ndarray:
         """(B, k, S) uint8 → (B, m, S) parity shards."""

@@ -242,6 +242,16 @@ class TpuCodec(BlockCodec):
         h = np.asarray(self._hash_jit(jnp.asarray(arr), jnp.asarray(lengths)))
         return [Hash(d) for d in digests_to_bytes(h[: len(blocks)])]
 
+    def verify_one(self, block: bytes, hash: Hash) -> bool:
+        """Single-block verify stays on the host CPU: one block cannot
+        amortize a device dispatch (the accelerator may sit behind a
+        high-latency link), and hashlib.blake2s is bit-identical to the
+        device kernel (tests/test_codec_equivalence.py).  Batched paths
+        (scrub/resync) run on device via batch_verify/scrub_encode."""
+        import hashlib
+
+        return hashlib.blake2s(block, digest_size=32).digest() == bytes(hash)
+
     def batch_verify(self, blocks: Sequence[bytes], hashes: Sequence[Hash]) -> np.ndarray:
         if len(blocks) != len(hashes):
             raise ValueError(f"{len(blocks)} blocks vs {len(hashes)} hashes")

@@ -42,11 +42,51 @@ class RequestStrategy:
 
 
 class RpcHelper:
-    def __init__(self, netapp: NetApp, peering: FullMeshPeering):
+    def __init__(self, netapp: NetApp, peering: FullMeshPeering, metrics=None):
         self.netapp = netapp
         self.peering = peering
         self.our_id = netapp.id
         self._drain_tasks: set = set()
+        # per-RPC counters + latency histogram (ref rpc/metrics.rs:38)
+        if metrics is not None:
+            self.m_requests = metrics.counter(
+                "rpc_request_counter", "Number of RPC requests emitted")
+            self.m_errors = metrics.counter(
+                "rpc_error_counter", "Number of failed RPC requests")
+            self.m_timeouts = metrics.counter(
+                "rpc_timeout_counter", "Number of RPC timeouts")
+            self.m_duration = metrics.histogram(
+                "rpc_duration_seconds", "Duration of RPCs")
+        else:
+            self.m_requests = self.m_errors = None
+            self.m_timeouts = self.m_duration = None
+
+    def _instrument(self, endpoint_path: str, coro_fn):
+        """Wrap one RPC call with counters + duration (the reference's
+        RecordDuration + per-call metrics, rpc_helper.rs:238-260)."""
+        if self.m_requests is None:
+            return coro_fn
+
+        async def timed(*a, **kw):
+            import time as _time
+
+            self.m_requests.inc(endpoint=endpoint_path)
+            t0 = _time.perf_counter()
+            try:
+                return await coro_fn(*a, **kw)
+            except asyncio.TimeoutError:
+                self.m_timeouts.inc(endpoint=endpoint_path)
+                self.m_errors.inc(endpoint=endpoint_path)
+                raise
+            except Exception:
+                self.m_errors.inc(endpoint=endpoint_path)
+                raise
+            finally:
+                self.m_duration.observe(
+                    _time.perf_counter() - t0, endpoint=endpoint_path
+                )
+
+        return timed
 
     # --- ordering (ref rpc_helper.rs:392-435) ---
 
@@ -73,7 +113,11 @@ class RpcHelper:
         prio: int = PRIO_NORMAL,
         timeout: Optional[float] = 30.0,
     ) -> Any:
-        return await endpoint.call(node, msg, prio=prio, timeout=timeout)
+        fn = self._instrument(
+            endpoint.path,
+            lambda: endpoint.call(node, msg, prio=prio, timeout=timeout),
+        )
+        return await fn()
 
     async def call_many(
         self,
@@ -120,12 +164,17 @@ class RpcHelper:
         if len(nodes) < quorum:
             raise QuorumError(quorum, 0, [f"only {len(nodes)} candidate nodes"])
 
-        def call_node(n: NodeID):
+        def _raw(n: NodeID):
             if make_call is not None:
                 return make_call(n)
             return endpoint.call(
                 n, msg, prio=strategy.rs_priority, timeout=strategy.rs_timeout
             )
+
+        timed = self._instrument(endpoint.path, lambda n: _raw(n))
+
+        def call_node(n: NodeID):
+            return timed(n)
 
         if strategy.rs_interrupt_after_quorum:
             return await self._quorum_read(nodes, call_node, quorum)

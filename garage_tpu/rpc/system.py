@@ -108,7 +108,13 @@ class System:
         self.netapp = NetApp(self.node_key, config.rpc_secret)
         self.id = self.netapp.id
         self.peering = FullMeshPeering(self.netapp)
-        self.rpc = RpcHelper(self.netapp, self.peering)
+        # per-node metrics registry: every layer records into it and the
+        # admin /metrics endpoint renders it (ref util/metrics.rs + the
+        # per-layer metric structs)
+        from ..utils.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self.rpc = RpcHelper(self.netapp, self.peering, metrics=self.metrics)
 
         self._layout_persister: Persister = Persister(
             config.metadata_dir, "cluster_layout", ClusterLayout

@@ -212,6 +212,9 @@ class TableData:
 
     # --- counts ---
 
+    def store_len(self) -> int:
+        return len(self.store)
+
     def merkle_todo_len(self) -> int:
         return len(self.merkle_todo)
 

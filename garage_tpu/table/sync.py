@@ -53,6 +53,24 @@ class TableSyncer:
         )
         self.endpoint.set_handler(self._handle)
         self.worker: Optional[SyncWorker] = None
+        # sync item counters (ref table/metrics.rs sync_items_sent/received)
+        m = getattr(system, "metrics", None)
+        if m is not None:
+            reg = m.__dict__.setdefault("_sync_shared", {})
+            if not reg:
+                reg["sent"] = m.counter(
+                    "table_sync_items_sent",
+                    "Items sent to other nodes during anti-entropy")
+                reg["recv"] = m.counter(
+                    "table_sync_items_received",
+                    "Items received from other nodes during anti-entropy")
+            self._m = reg
+        else:
+            self._m = None
+
+    def _count(self, which: str, n: int) -> None:
+        if self._m is not None and n:
+            self._m[which].inc(n, table_name=self.data.schema.TABLE_NAME)
 
     def make_worker(self) -> "SyncWorker":
         self.worker = SyncWorker(self)
@@ -132,6 +150,7 @@ class TableSyncer:
         await self.endpoint.call(
             who, {"t": "items", "vs": values}, prio=PRIO_BACKGROUND
         )
+        self._count("sent", len(values))
 
     # --- offload (ref sync.rs:170-269) ---
 
@@ -161,6 +180,7 @@ class TableSyncer:
                 {"t": "items", "vs": values},
                 RequestStrategy(rs_quorum=len(nodes), rs_priority=PRIO_BACKGROUND),
             )
+            self._count("sent", len(values))
             for k, v in batch:
                 self.data.delete_if_equal(k, v)
             logger.info(
@@ -180,6 +200,7 @@ class TableSyncer:
             return {"node": node}, None
         if t == "items":
             self.data.update_many([bytes(v) for v in msg["vs"]])
+            self._count("recv", len(msg["vs"]))
             return {"ok": True}, None
         raise GarageError(f"unknown sync rpc {t!r}")
 

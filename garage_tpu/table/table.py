@@ -56,10 +56,56 @@ class Table:
         self.gc = None
         self._repair_tasks: set = set()  # strong refs: loop holds tasks weakly
 
+        # per-table request metrics (ref table/metrics.rs): shared metric
+        # families across tables with a table_name label
+        m = getattr(system, "metrics", None)
+        self._tname = schema.TABLE_NAME
+        if m is not None:
+            reg = m.__dict__.setdefault("_table_shared", {})
+            if not reg:
+                reg["gets"] = m.counter(
+                    "table_get_request_counter", "Table get/get_range requests")
+                reg["puts"] = m.counter(
+                    "table_put_request_counter", "Table insert requests")
+                reg["get_dur"] = m.histogram(
+                    "table_get_request_duration_seconds", "Table read latency")
+                reg["put_dur"] = m.histogram(
+                    "table_put_request_duration_seconds", "Table write latency")
+                reg["size"] = m.gauge(
+                    "table_size", "Number of items in table")
+                reg["merkle_todo"] = m.gauge(
+                    "table_merkle_updater_todo_queue_length",
+                    "Merkle updater backlog")
+                reg["gc_todo"] = m.gauge(
+                    "table_gc_todo_queue_length", "Tombstone GC backlog")
+            self._m = reg
+        else:
+            self._m = None
+
+    def observe_gauges(self) -> None:
+        """Refresh this table's size/backlog gauges (called at scrape)."""
+        if self._m is None:
+            return
+        self._m["size"].set(self.data.store_len(), table_name=self._tname)
+        self._m["merkle_todo"].set(
+            self.data.merkle_todo_len(), table_name=self._tname)
+        self._m["gc_todo"].set(self.data.gc_todo_len(), table_name=self._tname)
+
     # --- client operations ---
 
     async def insert(self, entry: Entry) -> None:
         """ref table.rs:104-137."""
+        if self._m is not None:
+            self._m["puts"].inc(table_name=self._tname)
+            timer = self._m["put_dur"].time(table_name=self._tname)
+        else:
+            import contextlib
+
+            timer = contextlib.nullcontext()
+        with timer:
+            await self._insert_inner(entry)
+
+    async def _insert_inner(self, entry: Entry) -> None:
         h = hash_partition_key(entry.partition_key)
         who = self.replication.write_nodes(h)
         e_enc = entry.encode()
@@ -107,8 +153,20 @@ class Table:
                 f"insert_many: {failed}/{len(entries)} entries below write quorum"
             )
 
+    def _read_timer(self):
+        if self._m is not None:
+            self._m["gets"].inc(table_name=self._tname)
+            return self._m["get_dur"].time(table_name=self._tname)
+        import contextlib
+
+        return contextlib.nullcontext()
+
     async def get(self, p: Any, s: Any) -> Optional[Entry]:
         """Quorum read with read-repair (ref table.rs:228-284)."""
+        with self._read_timer():
+            return await self._get_inner(p, s)
+
+    async def _get_inner(self, p: Any, s: Any) -> Optional[Entry]:
         h = hash_partition_key(p)
         who = self.replication.read_nodes(h)
         tk = self.data.tree_key(p, s)
@@ -156,6 +214,14 @@ class Table:
     ) -> List[Entry]:
         """Quorum range read, merged per key, with read-repair of divergent
         items (ref table.rs:314-407)."""
+        with self._read_timer():
+            return await self._get_range_inner(
+                p, start_sort_key, filter, limit, reverse
+            )
+
+    async def _get_range_inner(
+        self, p, start_sort_key=None, filter=None, limit=100, reverse=False
+    ) -> List[Entry]:
         h = hash_partition_key(p)
         who = self.replication.read_nodes(h)
         msg = {

@@ -1,0 +1,179 @@
+"""Lightweight metrics registry — counters, gauges, histograms with
+Prometheus text exposition.
+
+Equivalent of the reference's OpenTelemetry metric helpers
+(ref util/metrics.rs:8-63) plus the per-layer metric structs
+(rpc/metrics.rs:38, table/metrics.rs, block/metrics.rs:7-127,
+api/generic_server.rs:63-95).  The reference pushes through the OTel
+SDK to its Prometheus exporter; here the registry IS the exporter: every
+`System` owns one (`system.metrics`), all layers record into it, and the
+admin API renders it (`/metrics`).  No OTel dependency — the sample
+model (monotonic counters, label sets, cumulative histogram buckets)
+follows the Prometheus exposition format directly.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# latency buckets (seconds), roughly the OTel default boundaries
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class Counter:
+    """Monotonic counter, optionally labelled."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._vals: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        self._vals[key] = self._vals.get(key, 0.0) + n
+
+    def get(self, **labels) -> float:
+        return self._vals.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._vals.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {_num(v)}")
+        if not self._vals:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Gauge:
+    """Point-in-time value: set directly or observed via a callback at
+    render time (the reference's ValueObserver pattern)."""
+
+    def __init__(self, name: str, help: str = "",
+                 fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help
+        self.fn = fn
+        self._vals: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def set(self, v: float, **labels) -> None:
+        self._vals[tuple(sorted(labels.items()))] = v
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        if self.fn is not None:
+            try:
+                out.append(f"{self.name} {_num(self.fn())}")
+            except Exception:  # noqa: BLE001 — observers must never break scrape
+                pass
+        for key, v in sorted(self._vals.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {_num(v)}")
+        return out
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics)."""
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(buckets)
+        # labels -> [bucket counts..., +inf count, sum, count]
+        self._vals: Dict[Tuple[Tuple[str, str], ...], list] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        slot = self._vals.get(key)
+        if slot is None:
+            slot = [0] * (len(self.buckets) + 1) + [0.0, 0]
+            self._vals[key] = slot
+        i = bisect.bisect_left(self.buckets, v)
+        slot[i] += 1
+        slot[-2] += v
+        slot[-1] += 1
+
+    def time(self, **labels):
+        """Context manager recording elapsed seconds (the reference's
+        RecordDuration combinator, util/metrics.rs:8-57)."""
+        return _Timer(self, labels)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for key, slot in sorted(self._vals.items()):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += slot[i]
+                lab = key + (("le", _num(b)),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+            cum += slot[len(self.buckets)]
+            lab = key + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {_num(slot[-2])}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {slot[-1]}")
+        return out
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+        return False
+
+
+def _num(v) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class MetricsRegistry:
+    """One per System; layers create their metrics through it and the
+    admin endpoint renders everything."""
+
+    def __init__(self):
+        self._metrics: List[object] = []
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        m = Counter(name, help)
+        self._metrics.append(m)
+        return m
+
+    def gauge(self, name: str, help: str = "",
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        m = Gauge(name, help, fn)
+        self._metrics.append(m)
+        return m
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        m = Histogram(name, help, buckets)
+        self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"

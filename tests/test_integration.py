@@ -221,12 +221,21 @@ async def test_admin_http_api(cluster):
             assert r.status == 200
             st = await r.json()
             assert len(st["roles"]) == 3
-        async with s.get(f"{base}/metrics") as r:
-            body = await r.text()
-            assert "cluster_healthy" in body
         async with s.post(f"{base}/v1/key", headers=hdrs, json={"name": "k"}) as r:
             k = await r.json()
             assert k["accessKeyId"].startswith("GK")
         async with s.get(f"{base}/v1/key", headers=hdrs) as r:
             keys = await r.json()
             assert any(x["id"] == k["accessKeyId"] for x in keys)
+        async with s.get(f"{base}/metrics") as r:
+            body = await r.text()
+            assert "cluster_healthy" in body
+            # per-layer families (ref rpc/table/block/api metric structs);
+            # the key insert above drove quorum RPCs + table writes on
+            # this node, so the labelled samples must exist
+            assert 'rpc_request_counter{endpoint="garage/table/key' in body
+            assert "rpc_duration_seconds_bucket" in body
+            assert 'table_put_request_counter{table_name="key"' in body
+            assert "table_size{" in body
+            assert "block_resync_queue_length" in body
+            assert "api_request_counter" in body

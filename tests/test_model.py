@@ -346,3 +346,47 @@ async def test_worker_vars_persist_across_restart(tmp_path):
     assert g2.block_resync.tranquility == 5
     assert g2.scrub_worker.state.tranquility == 9
     await g2.shutdown()
+
+
+async def test_offline_counter_recount_fixes_drift(tmp_path):
+    """Deliberately corrupt a bucket's object counter, then rebuild it with
+    offline_recount_all (ref index_counter.rs:252+ + repair/offline.rs)."""
+    garages = await make_garage_cluster(tmp_path)
+    for x in garages:
+        x.spawn_workers()
+    g = garages[0]
+    bucket_id = gen_uuid()
+    for i in range(4):
+        await g.object_table.insert(Object(
+            bucket_id, f"o{i}", [complete_version(gen_uuid(), 100, b"z" * 25)]
+        ))
+
+    async def wait_totals(want_objects):
+        for _ in range(100):
+            t = await g.object_counter.get_totals(bytes(bucket_id))
+            if t.get(OBJECTS) == want_objects:
+                return t
+            await asyncio.sleep(0.05)
+        return await g.object_counter.get_totals(bytes(bucket_id))
+
+    t = await wait_totals(4)
+    assert t.get(OBJECTS) == 4 and t.get(BYTES) == 100
+
+    # corrupt: phantom deltas on every node (drifted counters)
+    for x in garages:
+        x.db.transaction(lambda tx, x=x: x.object_counter.count(
+            tx, bytes(bucket_id), "", [], [(OBJECTS, 1000), (BYTES, 1_000_000)]
+        ))
+    t = await wait_totals(1004)
+    assert t.get(OBJECTS) == 1004
+
+    # recount on every node (its own local rows), then wait for the
+    # insert-queue propagation to converge
+    for x in garages:
+        z, n = x.object_counter.offline_recount_all(
+            x.object_table, lambda e: (bytes(e.bucket_id), "")
+        )
+        assert n >= 1
+    t = await wait_totals(4)
+    assert t.get(OBJECTS) == 4 and t.get(BYTES) == 100
+    await shutdown(garages)

@@ -240,3 +240,76 @@ async def test_large_message_and_binary():
     resp = await a.endpoint("test/bin").call(b.id, {"data": blob})
     assert resp["data"] == blob
     await shutdown(a, b)
+
+
+async def test_slow_stream_consumer_does_not_stall_other_rpcs():
+    """Per-stream flow control: a paused stream consumer must only stall
+    its own stream's sender, not unrelated RPCs on the same connection
+    (round 1 had head-of-line blocking in the connection reader)."""
+    a, b, _ = await make_pair()
+
+    async def big_stream(remote, msg, body):
+        async def resp_body():
+            # 8 MiB — far beyond any in-flight window
+            for _ in range(512):
+                yield b"z" * 16384
+
+        return {"ok": True}, resp_body()
+
+    async def quick(remote, msg, body):
+        return {"pong": msg["i"]}, None
+
+    b.endpoint("test/big").set_handler(big_stream)
+    b.endpoint("test/quick").set_handler(quick)
+
+    resp, stream = await a.endpoint("test/big").call_streaming(b.id, {})
+    # consume ONE chunk then stop — the stream stays stalled
+    it = stream.__aiter__()
+    await it.__anext__()
+
+    # unrelated RPCs on the same a<->b connection must still complete fast
+    t0 = asyncio.get_event_loop().time()
+    results = await asyncio.wait_for(
+        asyncio.gather(*[
+            a.endpoint("test/quick").call(b.id, {"i": i}) for i in range(20)
+        ]),
+        timeout=5.0,
+    )
+    assert [r["pong"] for r in results] == list(range(20))
+    assert asyncio.get_event_loop().time() - t0 < 3.0
+
+    # and the stalled stream still completes when consumption resumes
+    rest = await stream.read_all()
+    total = 16384 + len(rest)
+    assert total == 512 * 16384
+    await shutdown(a, b)
+
+
+async def test_flow_control_bounds_receiver_buffer():
+    """The sender respects the credit window: with a stalled consumer, at
+    most ~STREAM_WINDOW chunks ever sit in the receiving queue."""
+    from garage_tpu.net.netapp import STREAM_WINDOW
+
+    a, b, _ = await make_pair()
+    sent = {"n": 0}
+
+    async def handler(remote, msg, body):
+        async def resp_body():
+            for _ in range(1000):
+                sent["n"] += 1
+                yield b"y" * 16384
+
+        return {}, resp_body()
+
+    b.endpoint("test/win").set_handler(handler)
+    _resp, stream = await a.endpoint("test/win").call_streaming(b.id, {})
+    await asyncio.sleep(0.5)  # consumer never reads
+    assert sent["n"] <= STREAM_WINDOW + 2, sent["n"]
+    assert stream._q.qsize() <= STREAM_WINDOW + 2
+    # drain: everything arrives
+    got = 0
+    async for c in stream:
+        got += len(c)
+    assert got == 1000 * 16384
+    assert sent["n"] == 1000
+    await shutdown(a, b)
