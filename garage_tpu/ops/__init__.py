@@ -27,4 +27,10 @@ def make_codec(backend: str = "cpu", **kw) -> BlockCodec:
     if backend == "tpu":
         from .tpu_codec import TpuCodec
         return TpuCodec(CodecParams(**kw))
+    if backend == "hybrid":
+        from .hybrid_codec import HybridCodec
+        # async: the daemon must come up on the CPU floor even if JAX
+        # backend init hangs on a dead device tunnel; the device codec
+        # attaches in the background when ready
+        return HybridCodec(CodecParams(**kw), build_device="async")
     raise ValueError(f"unknown codec backend {backend!r}")

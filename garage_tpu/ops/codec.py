@@ -36,6 +36,8 @@ class CodecParams:
     compression_level: Optional[int] = 1
     batch_blocks: int = 256
     shard_mesh: int = 1       # devices to shard codec batches over (tpu)
+    hybrid_group_blocks: int = 64   # work-stealing quantum (hybrid backend)
+    hybrid_window: int = 1          # device in-flight groups (hybrid backend)
 
 
 class BlockCodec:

@@ -1,0 +1,438 @@
+"""Hybrid BlockCodec — adaptive host+device scrub with work stealing.
+
+Why this exists.  The TPU codec's throughput is capped by the host→device
+link: behind a constrained tunnel the sustained transfer rate can drop to
+the same order as — or below — one CPU core's hashing rate, and it varies
+over time (burst quotas, shared tenancy).  Statically routing all scrub
+work to either backend therefore leaves throughput on the floor.  The
+hybrid codec runs BOTH: the caller's thread drives the CPU codec (the
+guaranteed floor — hashlib + the native GF kernel), while a feeder thread
+streams groups to the device codec, keeping a bounded in-flight window.
+Work distribution is a classic stealing deque — CPU pulls groups from the
+left, the device from the right — so the split adapts to whatever rate
+each side actually sustains, with no rate model to mistune:
+
+  total throughput ≈ cpu_rate + min(link_rate, device_rate)
+
+and the device is never on the critical path: at the tail of a pass the
+CPU *hedges* — after a short grace period it recomputes the groups the
+device still holds in flight, first writer wins, and the feeder thread is
+left to drain its transfers in the background rather than joined.  A
+stalled link therefore costs at most one grace period, not a sync.
+
+The reference has no equivalent — its scrub is a strictly sequential
+per-block CPU loop (ref src/block/repair.rs:438-490, block.rs:66-78
+verify); this is the TPU-first replacement identified in SURVEY.md §7.
+
+Semantics are those of BlockCodec: results are bit-identical whichever
+backend processed a group (tests/test_hybrid_codec.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.data import Hash
+from .codec import BlockCodec, CodecParams
+from .cpu_codec import CpuCodec
+
+logger = logging.getLogger("garage_tpu.ops.hybrid")
+
+# Feeders are daemon threads (a stalled device link must never wedge
+# process exit), but exiting the interpreter while one is blocked inside a
+# device transfer aborts the process from C++ (PJRT raises through a dying
+# runtime).  Track live feeders and give them a bounded drain at exit.
+_LIVE_FEEDERS: "collections.deque[threading.Thread]" = collections.deque()
+_FEEDER_EXIT_GRACE_S = 15.0
+
+
+def _drain_feeders_at_exit() -> None:
+    deadline = time.monotonic() + _FEEDER_EXIT_GRACE_S
+    while _LIVE_FEEDERS:
+        t = _LIVE_FEEDERS.popleft()
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
+import atexit  # noqa: E402  (registration belongs right next to the state)
+
+atexit.register(_drain_feeders_at_exit)
+
+
+class HybridCodec(BlockCodec):
+    """CPU floor + opportunistic device offload, per-group work stealing."""
+
+    def __init__(self, params: CodecParams,
+                 device_codec: Optional[BlockCodec] = None,
+                 build_device="sync"):
+        """build_device selects how the device codec is constructed:
+          "sync"  — build now (the caller has already probed the device
+                    alive, e.g. bench.py after its subprocess probe);
+          "async" — build on a background thread and attach when ready.
+                    This is what the daemon config path uses: JAX backend
+                    init can hang unboundedly on a dead device tunnel, and
+                    a storage daemon must come up and scrub on its CPU
+                    floor regardless (the device joins in when/if init
+                    completes);
+          False   — never build; pure CPU floor."""
+        super().__init__(params)
+        self.cpu = CpuCodec(params)
+        self.tpu = device_codec
+        # group = the stealing quantum; must be k-aligned so each group's
+        # parity layout is self-contained (k=0: replication-only config, no
+        # RS — groups need no alignment and scrub is verify-only)
+        k = max(1, params.rs_data)
+        g = max(params.hybrid_group_blocks, k)
+        self.group_blocks = g - (g % k)
+        self.window = max(1, params.hybrid_window)
+        # accounting (read by bench.py and the admin worker registry)
+        self.bytes_cpu = 0
+        self.bytes_tpu = 0
+        self._stats_lock = threading.Lock()
+        if self.tpu is None and build_device:
+            if build_device == "async":
+                threading.Thread(
+                    target=self._build_device, name="codec-hybrid-devinit",
+                    daemon=True,
+                ).start()
+            else:
+                self._build_device()
+
+    def _build_device(self) -> None:
+        try:
+            from .tpu_codec import TpuCodec
+
+            self.tpu = TpuCodec(self.params)  # atomic attach
+        except Exception:
+            logger.warning(
+                "device codec unavailable; hybrid runs CPU-only",
+                exc_info=True,
+            )
+
+    def pop_stats(self) -> Tuple[int, int]:
+        with self._stats_lock:
+            s = (self.bytes_cpu, self.bytes_tpu)
+            self.bytes_cpu = self.bytes_tpu = 0
+        return s
+
+    def warm(self, nbytes: int) -> None:
+        """Pre-compile the device executable for `nbytes`-sized blocks
+        without spending link bandwidth (AOT lowering)."""
+        if self.tpu is not None and hasattr(self.tpu, "warm_scrub"):
+            try:
+                self.tpu.warm_scrub(self.group_blocks, nbytes)
+            except Exception:
+                logger.warning("device warmup failed", exc_info=True)
+
+    # --- the hybrid engine ---
+
+    def _run_groups(self, blocks: Sequence[bytes], hashes: Sequence[Hash],
+                    compute_parity: bool, fetch_parity: bool,
+                    cuts: Optional[Sequence[int]] = None):
+        """Split into k-aligned groups, process them on both backends via a
+        stealing deque, return per-group (ok, parity|None) in order.
+
+        compute_parity: whether the CPU side runs the RS encode at all (the
+        device kernel is fused and always encodes — one executable for both
+        the verify-only and scrub paths).  fetch_parity: whether device-side
+        parity is copied back to host RAM (skipping the copy spares
+        device→host bandwidth for callers that discard parity).  cuts:
+        extra boundaries (block indices) no group may straddle — scrub_many
+        passes its batch edges so no RS codeword ever mixes two batches."""
+        n = len(blocks)
+        g = self.group_blocks
+        starts: List[int] = []
+        edges = sorted(set([0, n] + list(cuts or [])))
+        for lo, hi in zip(edges, edges[1:]):
+            starts.extend(range(lo, hi, g))
+        groups = [
+            (i, blocks[i:j], hashes[i:j])
+            for i, j in zip(starts, starts[1:] + [n])
+        ]
+        if self.params.rs_data == 0:
+            compute_parity = False  # replication-only config: verify-only
+            fetch_parity = False
+        results: List[Optional[Tuple[np.ndarray, Optional[np.ndarray]]]] = (
+            [None] * len(groups)
+        )
+        # rs_data == 0 also routes to CPU: the device path is the fused
+        # verify+encode executable, which needs the RS matrix
+        if self.tpu is None or len(groups) == 1 or self.params.rs_data == 0:
+            for gi, (idx, gb, gh) in enumerate(groups):
+                results[gi] = self._cpu_group(gb, gh, compute_parity,
+                                              fetch_parity)
+                with self._stats_lock:
+                    self.bytes_cpu += sum(len(b) for b in groups[gi][1])
+            return results
+
+        dq = collections.deque(range(len(groups)))
+        lock = threading.Lock()
+        done = threading.Event()
+        remaining = [len(groups)]
+
+        def set_result(gi, val, side, nbytes) -> bool:
+            """First writer wins (the tail is hedged: CPU may redo a group
+            the device still has in flight).  Byte accounting happens under
+            the same lock as the winning write, so pop_stats() called right
+            after the pass always sees cpu+tpu == total."""
+            with lock:
+                if results[gi] is not None:
+                    return False
+                results[gi] = val
+                with self._stats_lock:
+                    if side == "cpu":
+                        self.bytes_cpu += nbytes
+                    else:
+                        self.bytes_tpu += nbytes
+                remaining[0] -= 1
+                if remaining[0] == 0:
+                    done.set()
+                return True
+
+        cpu_t0 = time.monotonic()
+        cpu_bytes_this_call = [0]
+
+        def feeder():
+            # device side: pop from the RIGHT, keep ≤ window groups in
+            # flight; sync oldest before submitting past the window.
+            inflight: collections.deque = collections.deque()
+            try:
+                while True:
+                    with lock:
+                        if not dq:
+                            break
+                        gi = dq.pop()
+                    _idx, gb, gh = groups[gi]
+                    ok_dev, parity_dev, cnt = self.tpu.scrub_submit(gb, gh)
+                    nbytes = sum(len(b) for b in gb)
+                    maxlen = max(len(b) for b in gb)
+                    inflight.append(
+                        (gi, ok_dev, parity_dev, cnt, nbytes, maxlen)
+                    )
+                    if len(inflight) > self.window:
+                        t_c = time.monotonic()
+                        item = inflight.popleft()
+                        self._tpu_collect(item, set_result, fetch_parity)
+                        # Give up on a pathologically slow link: feeding it
+                        # costs host CPU (transfer staging/protocol) that
+                        # the CPU verifier could spend directly.  If one
+                        # group's turnaround exceeds what the CPU needs for
+                        # TWO groups at its observed rate, stop feeding —
+                        # the CPU absorbs the rest, bounding the worst case
+                        # near the pure-CPU floor while keeping the upside
+                        # of a healthy link.
+                        collect_dt = time.monotonic() - t_c
+                        cpu_dt = time.monotonic() - cpu_t0
+                        cpu_rate = (cpu_bytes_this_call[0] / cpu_dt
+                                    if cpu_dt > 0 else 0.0)
+                        if cpu_rate > 0 and collect_dt > 2 * item[4] / cpu_rate:
+                            logger.info(
+                                "hybrid feeder: link too slow (%.0f KiB/s), "
+                                "ceding remaining groups to CPU",
+                                item[4] / max(collect_dt, 1e-9) / 1024,
+                            )
+                            break
+                while inflight:
+                    self._tpu_collect(inflight.popleft(), set_result,
+                                      fetch_parity)
+            except BaseException as e:
+                # Device failure must never fail a scrub: groups without a
+                # result are hedge-verified on CPU below.
+                logger.warning(
+                    "device feeder failed; CPU absorbs its groups: %r", e
+                )
+
+        t = threading.Thread(target=feeder, name="codec-hybrid-feeder",
+                             daemon=True)
+        _LIVE_FEEDERS.append(t)
+        while len(_LIVE_FEEDERS) > 8:  # drop long-finished entries
+            old = _LIVE_FEEDERS.popleft()
+            if old.is_alive():
+                _LIVE_FEEDERS.append(old)
+                break
+        t.start()
+        while True:
+            with lock:
+                if not dq:
+                    break
+                gi = dq.popleft()
+            _idx, gb, gh = groups[gi]
+            val = self._cpu_group(gb, gh, compute_parity, fetch_parity)
+            nbytes = sum(len(b) for b in gb)
+            set_result(gi, val, "cpu", nbytes)
+            cpu_bytes_this_call[0] += nbytes
+
+        # Tail: the device still holds in-flight groups.  Waiting for a
+        # metered/stalled link can dwarf the whole pass, so hedge: give the
+        # device a quarter of the time the CPU would need to redo the
+        # stragglers, then recompute them on CPU — first writer wins, the
+        # device's late results are discarded.  The feeder thread is NOT
+        # joined: it syncs its remaining transfers in the background.
+        with lock:
+            pending = [gi for gi, r in enumerate(results) if r is None]
+        if pending:
+            cpu_dt = time.monotonic() - cpu_t0
+            cpu_rate = cpu_bytes_this_call[0] / cpu_dt if cpu_dt > 0 else 0.0
+            pend_bytes = sum(
+                len(b) for gi in pending for b in groups[gi][1]
+            )
+            grace = 0.25 * pend_bytes / cpu_rate if cpu_rate > 0 else 1.0
+            done.wait(timeout=grace)
+            for gi in pending:
+                with lock:
+                    if results[gi] is not None:
+                        continue
+                _idx, gb, gh = groups[gi]
+                val = self._cpu_group(gb, gh, compute_parity, fetch_parity)
+                set_result(gi, val, "cpu", sum(len(b) for b in gb))
+            done.wait()  # every slot now has a writer; returns immediately
+        return results
+
+    def _cpu_group(self, gb, gh, compute_parity, fetch_parity):
+        """Verify (+ optionally encode) one group on the CPU codec.  Byte
+        accounting is the caller's job (only winning writes count)."""
+        ok = self.cpu.batch_verify(gb, gh)
+        parity = None
+        if compute_parity:
+            k = self.params.rs_data
+            pad = (-len(gb)) % k
+            maxlen = max(len(b) for b in gb)
+            arr = np.zeros((len(gb) + pad, maxlen), dtype=np.uint8)
+            for i, b in enumerate(gb):
+                arr[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+            parity = self.cpu.rs_encode(
+                arr.reshape(arr.shape[0] // k, k, maxlen)
+            )
+            if not fetch_parity:
+                parity = None
+        return ok, parity
+
+    def _tpu_collect(self, item, set_result, fetch_parity):
+        gi, ok_dev, parity_dev, cnt, nbytes, maxlen = item
+        ok = np.asarray(ok_dev)[:cnt]
+        parity = None
+        if fetch_parity:
+            # trim device-side shape padding back to the group's true extent
+            # (pad blocks/columns are zero → zero parity, GF-linear), so
+            # results are identical whichever backend took the group
+            k = self.params.rs_data
+            nrows = (cnt + k - 1) // k
+            parity = np.asarray(parity_dev)[:nrows, :, :maxlen]
+        set_result(gi, (ok, parity), "tpu", nbytes)
+
+    # --- BlockCodec interface ---
+
+    def batch_hash(self, blocks: Sequence[bytes]) -> List[Hash]:
+        # hashing without expectations: no corruption checks to fuse, so the
+        # CPU pool is already optimal for small batches; large batches split.
+        return self.cpu.batch_hash(blocks)
+
+    def batch_verify(self, blocks: Sequence[bytes], hashes: Sequence[Hash]) -> np.ndarray:
+        if len(blocks) != len(hashes):
+            raise ValueError(f"{len(blocks)} blocks vs {len(hashes)} hashes")
+        if not blocks:
+            return np.zeros((0,), dtype=bool)
+        results = self._run_groups(blocks, hashes, compute_parity=False,
+                                   fetch_parity=False)
+        return np.concatenate([r[0] for r in results])
+
+    @staticmethod
+    def _assemble_parity(parities, maxlen: int) -> Optional[np.ndarray]:
+        """Concatenate per-group parity into the canonical (ceil(B/k), m,
+        maxlen) array (contract of scrub_encode_batch, shared with
+        TpuCodec).  Groups are k-aligned and consecutive, so their codeword
+        rows concatenate exactly as a whole-batch reshape would; shorter
+        groups are zero-padded to maxlen columns (zero data → zero parity,
+        GF-linear)."""
+        rows = []
+        for p in parities:
+            if p is None:
+                return None
+            if p.shape[-1] < maxlen:
+                p = np.pad(p, [(0, 0), (0, 0), (0, maxlen - p.shape[-1])])
+            rows.append(p)
+        return np.concatenate(rows, axis=0)
+
+    def scrub_encode_batch(self, blocks: Sequence[bytes], hashes: Sequence[Hash],
+                           fetch_parity: bool = True):
+        """Fused verify + RS(k,m) parity across both backends.
+
+        Same contract as TpuCodec.scrub_encode_batch: (ok (B,), parity
+        (ceil(B/k), m, maxlen) | None).  With fetch_parity=False (or
+        rs_data=0), parity is None — device-side parity stays on the device
+        (callers that discard parity avoid paying device→host bandwidth);
+        CPU-side parity is still computed, the work is identical.
+        """
+        if not blocks:
+            return np.zeros((0,), dtype=bool), None
+        results = self._run_groups(blocks, hashes, compute_parity=True,
+                                   fetch_parity=fetch_parity)
+        ok = np.concatenate([r[0] for r in results])
+        parity = None
+        if fetch_parity and self.params.rs_data > 0:
+            parity = self._assemble_parity(
+                [r[1] for r in results], max(len(b) for b in blocks)
+            )
+        return ok, parity
+
+    def scrub_many(self, batches, fetch_parity: bool = False):
+        """Fused verify+encode over MANY batches through ONE stealing deque.
+
+        batches: sequence of (blocks, hashes) pairs (the scrub worker's
+        read-ahead).  Processing all batches in one pass amortizes the
+        device pipeline across batch boundaries — there is a single hedged
+        tail for the whole stream instead of one per batch, which matters
+        when the device link carries seconds of in-flight data.  Returns a
+        list of (ok, parity|None) per input batch, parity in the canonical
+        scrub_encode_batch shape computed from that batch's blocks only.
+        """
+        all_blocks: List[bytes] = []
+        all_hashes: List[Hash] = []
+        counts = []
+        for blocks, hashes in batches:
+            if len(blocks) != len(hashes):
+                raise ValueError(f"{len(blocks)} blocks vs {len(hashes)} hashes")
+            all_blocks.extend(blocks)
+            all_hashes.extend(hashes)
+            counts.append(len(blocks))
+        if not all_blocks:
+            return [(np.zeros((0,), dtype=bool), None) for _ in counts]
+        # batch edges are hard cuts: no group (= RS codeword span) straddles
+        # two batches, so each batch's parity is computed from its own
+        # blocks only
+        edges = list(np.cumsum(counts)[:-1])
+        results = self._run_groups(all_blocks, all_hashes,
+                                   compute_parity=True,
+                                   fetch_parity=fetch_parity,
+                                   cuts=[int(e) for e in edges])
+        ok = np.concatenate([r[0] for r in results])
+        out = []
+        pos = 0
+        gi = 0
+        g = self.group_blocks
+        for cnt in counts:
+            parity = None
+            ngroups = (cnt + g - 1) // g
+            if fetch_parity and cnt and self.params.rs_data > 0:
+                parity = self._assemble_parity(
+                    [results[i][1] for i in range(gi, gi + ngroups)],
+                    max(len(b) for b in all_blocks[pos:pos + cnt]),
+                )
+            gi += ngroups
+            out.append((ok[pos:pos + cnt], parity))
+            pos += cnt
+        return out
+
+    def verify_one(self, block: bytes, hash: Hash) -> bool:
+        return self.cpu.verify_one(block, hash)
+
+    def rs_encode(self, data: np.ndarray) -> np.ndarray:
+        return self.cpu.rs_encode(data)
+
+    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int]) -> np.ndarray:
+        return self.cpu.rs_reconstruct(shards, present)

@@ -321,6 +321,56 @@ class TpuCodec(BlockCodec):
 
     # --- fused pipelined scrub (the north-star hot path) ---
 
+    def _pad_group(self, blocks: Sequence[bytes], hashes: Sequence[Hash]):
+        """Pad a block group to the compiled lane/byte shape: (arr, lengths,
+        expected) with pad lanes carrying the empty-message digest so they
+        verify clean and don't inflate the corruption count."""
+        import hashlib as _hl
+
+        arr, lengths = self._pad_batch(blocks)
+        k = self.params.rs_data
+        pad_lanes = (-arr.shape[0]) % k
+        if pad_lanes:
+            arr = np.pad(arr, [(0, pad_lanes), (0, 0)])
+            lengths = np.pad(lengths, (0, pad_lanes))
+        empty = np.frombuffer(
+            _hl.blake2s(b"", digest_size=32).digest(), dtype="<u4"
+        )
+        expected = np.broadcast_to(empty, (arr.shape[0], 8)).copy()
+        expected[: len(blocks)] = np.stack(
+            [np.frombuffer(bytes(h), dtype="<u4") for h in hashes]
+        )
+        return arr, lengths, expected
+
+    def scrub_submit(self, blocks: Sequence[bytes], hashes: Sequence[Hash]):
+        """Enqueue one group's fused verify+encode WITHOUT synchronizing.
+
+        Returns (ok_dev, parity_dev, n): device arrays plus the true block
+        count.  Callers keep several groups in flight to hide the
+        host→device link latency (the accelerator may sit behind a
+        constrained tunnel), then sync each with `np.asarray(ok_dev)[:n]`.
+        """
+        arr, lengths, expected = self._pad_group(blocks, hashes)
+        _h, ok, _bad, parity = self.scrub_encode_submit(arr, lengths, expected)
+        return ok, parity, len(blocks)
+
+    def warm_scrub(self, nblocks: int, nbytes: int) -> None:
+        """AOT-compile the fused scrub executable for the padded shape of an
+        (nblocks × nbytes) group and populate the persistent XLA compilation
+        cache — without transferring any data (device links may be
+        bandwidth-metered, so warmup must not spend bytes)."""
+        k = self.params.rs_data
+        bsz = self._batch_size(max(nblocks, 1))
+        bsz += (-bsz) % k
+        padded = self._bucket(max(nbytes, 1))
+        shapes = (
+            jax.ShapeDtypeStruct((bsz, padded), jnp.uint8),
+            jax.ShapeDtypeStruct((bsz,), jnp.int32),
+            jax.ShapeDtypeStruct((bsz, 8), jnp.uint32),
+            jax.ShapeDtypeStruct(self._K_enc.shape, self._K_enc.dtype),
+        )
+        self._scrub_jit.lower(*shapes, k=k).compile()
+
     def scrub_encode_submit(self, arr: np.ndarray, lengths: np.ndarray,
                             expected: np.ndarray):
         """Enqueue ONE device dispatch doing verify + RS(k,m) parity for a
@@ -334,25 +384,21 @@ class TpuCodec(BlockCodec):
             self._K_enc, k=self.params.rs_data,
         )
 
-    def scrub_encode_batch(self, blocks: Sequence[bytes], hashes: Sequence[Hash]):
-        """Synchronous convenience wrapper: (ok (B,), parity (B//k, m, S))."""
-        arr, lengths = self._pad_batch(blocks)
+    def scrub_encode_batch(self, blocks: Sequence[bytes], hashes: Sequence[Hash],
+                           fetch_parity: bool = True):
+        """Synchronous fused verify+encode.  Contract shared with
+        HybridCodec.scrub_encode_batch: returns (ok (B,), parity
+        (ceil(B/k), m, maxlen) | None) — parity trimmed of lane/column
+        padding (pad rows/columns are zero blocks → zero parity); with
+        fetch_parity=False it stays on the device and None is returned."""
+        ok, parity, n = self.scrub_submit(blocks, hashes)
+        ok = np.asarray(ok)[:n]
+        if not fetch_parity:
+            return ok, None
         k = self.params.rs_data
-        pad_lanes = (-arr.shape[0]) % k
-        if pad_lanes:
-            arr = np.pad(arr, [(0, pad_lanes), (0, 0)])
-            lengths = np.pad(lengths, (0, pad_lanes))
-        import hashlib as _hl
-
-        empty = np.frombuffer(
-            _hl.blake2s(b"", digest_size=32).digest(), dtype="<u4"
-        )
-        expected = np.broadcast_to(empty, (arr.shape[0], 8)).copy()
-        expected[: len(blocks)] = np.stack(
-            [np.frombuffer(bytes(h), dtype="<u4") for h in hashes]
-        )
-        _h, ok, _bad, parity = self.scrub_encode_submit(arr, lengths, expected)
-        return np.asarray(ok)[: len(blocks)], np.asarray(parity)
+        nrows = (n + k - 1) // k
+        maxlen = max(len(b) for b in blocks)
+        return ok, np.asarray(parity)[:nrows, :, :maxlen]
 
 
 # --- multi-chip sharded variants (dryrun_multichip + pod-scale batches) -----

@@ -65,12 +65,14 @@ def secret_from_file(path: str) -> str:
 @dataclass
 class CodecConfig:
     """TPU block-codec settings (new vs reference — the BlockCodec seam)."""
-    backend: str = "cpu"            # cpu | tpu
+    backend: str = "cpu"            # cpu | tpu | hybrid (cpu + device stealing)
     hash_algo: str = "blake2s"      # blake2s (TPU-offloadable) | blake2b | sha256
     rs_data: int = 8                # Reed-Solomon k (0 = replication only, no RS)
     rs_parity: int = 4              # Reed-Solomon m
     batch_blocks: int = 256         # blocks per device batch (scrub/resync producers)
     shard_mesh: int = 1             # devices to shard codec batches over
+    hybrid_group_blocks: int = 64   # hybrid backend: work-stealing quantum
+    hybrid_window: int = 1          # hybrid backend: device in-flight groups
 
     def make(self, compression_level: Optional[int] = 1):
         """Build the configured BlockCodec (`backend` selects the impl)."""
@@ -84,6 +86,8 @@ class CodecConfig:
             batch_blocks=self.batch_blocks,
             compression_level=compression_level,
             shard_mesh=self.shard_mesh,
+            hybrid_group_blocks=self.hybrid_group_blocks,
+            hybrid_window=self.hybrid_window,
         )
 
 
@@ -177,8 +181,10 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
     if bad:
         raise ConfigError(f"unknown [codec] keys: {sorted(bad)}")
     cfg.codec = CodecConfig(**codec)
-    if cfg.codec.backend not in ("cpu", "tpu"):
-        raise ConfigError(f"codec.backend must be cpu|tpu, got {cfg.codec.backend!r}")
+    if cfg.codec.backend not in ("cpu", "tpu", "hybrid"):
+        raise ConfigError(
+            f"codec.backend must be cpu|tpu|hybrid, got {cfg.codec.backend!r}"
+        )
     if (cfg.codec.rs_data == 0) != (cfg.codec.rs_parity == 0):
         raise ConfigError("codec.rs_data and codec.rs_parity must both be 0 or both be >0")
 

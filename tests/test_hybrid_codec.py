@@ -1,0 +1,255 @@
+"""HybridCodec: work-stealing split between CPU and device backends.
+
+Checks the hybrid scheduler's contract: results are bit-identical to the
+CPU codec whichever backend processed a group, the device contributes when
+healthy, and a slow or broken device never blocks or corrupts a scrub
+(the CPU absorbs the deque).  Runs on the virtual CPU platform — "device"
+here is the JAX CPU backend or a scripted fake.
+"""
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+
+from garage_tpu.ops import make_codec
+from garage_tpu.ops.codec import CodecParams
+from garage_tpu.ops.cpu_codec import CpuCodec
+from garage_tpu.ops.hybrid_codec import HybridCodec
+from garage_tpu.utils.data import Hash
+
+K, M = 4, 2
+
+
+def _params(**kw):
+    kw.setdefault("rs_data", K)
+    kw.setdefault("rs_parity", M)
+    kw.setdefault("hybrid_group_blocks", 8)
+    kw.setdefault("hybrid_window", 2)
+    return CodecParams(**kw)
+
+
+def _mk_blocks(n, size=2048, seed=0):
+    rng = np.random.default_rng(seed)
+    blocks = [rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+              for _ in range(n)]
+    hashes = [Hash(hashlib.blake2s(b, digest_size=32).digest())
+              for b in blocks]
+    return blocks, hashes
+
+
+class _FakeDevice:
+    """Scripted device codec: CPU math with controllable latency/failure."""
+
+    def __init__(self, params, delay=0.0, fail=False):
+        self.cpu = CpuCodec(params)
+        self.params = params
+        self.delay = delay
+        self.fail = fail
+        self.submitted = 0
+
+    def scrub_submit(self, blocks, hashes):
+        self.submitted += 1
+        if self.fail:
+            raise RuntimeError("injected device failure")
+        if self.delay:
+            time.sleep(self.delay)
+        ok = self.cpu.batch_verify(blocks, hashes)
+        k = self.params.rs_data
+        pad = (-len(blocks)) % k
+        maxlen = max(len(b) for b in blocks)
+        arr = np.zeros((len(blocks) + pad, maxlen), dtype=np.uint8)
+        for i, b in enumerate(blocks):
+            arr[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        parity = self.cpu.rs_encode(arr.reshape(-1, k, maxlen))
+        return ok, parity, len(blocks)
+
+
+def test_hybrid_matches_cpu_with_corruption():
+    blocks, hashes = _mk_blocks(40)
+    bad = dict(enumerate(blocks))
+    bad[7] = b"\xff" + blocks[7][1:]
+    bad[23] = blocks[23][:-1] + b"\x00"
+    blocks = [bad[i] for i in range(len(blocks))]
+    hy = make_codec("hybrid", **vars(_params()))
+    cpu = CpuCodec(_params())
+    ok = hy.batch_verify(blocks, hashes)
+    assert ok.shape == (40,)
+    expect = cpu.batch_verify(blocks, hashes)
+    assert np.array_equal(ok, expect)
+    assert not ok[7] and not ok[23]
+    assert ok.sum() == 38
+
+
+def _cpu_reference_parity(blocks, k=K, m=M):
+    """Whole-batch reference: zero-pad to (ceil(n/k)*k, maxlen), reshape to
+    codewords, encode with the CPU codec."""
+    cpu = CpuCodec(_params())
+    maxlen = max(len(b) for b in blocks)
+    pad = (-len(blocks)) % k
+    arr = np.zeros((len(blocks) + pad, maxlen), dtype=np.uint8)
+    for i, b in enumerate(blocks):
+        arr[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return cpu.rs_encode(arr.reshape(-1, k, maxlen))
+
+
+def test_hybrid_parity_identical_across_backends():
+    # canonical parity must equal the whole-batch CPU reference, including
+    # a partial trailing group exercising the device-side shape trim
+    blocks, hashes = _mk_blocks(19, size=1000)
+    hy = HybridCodec(_params())
+    ok, parity = hy.scrub_encode_batch(blocks, hashes)
+    assert ok.all()
+    expect = _cpu_reference_parity(blocks)
+    assert parity.shape == expect.shape
+    assert np.array_equal(parity, expect)
+
+
+def test_scrub_encode_batch_contract_tpu_vs_hybrid():
+    # the same method on the tpu and hybrid backends must return the same
+    # shapes and bits (backend-swap safety), incl. the fetch_parity kwarg
+    from garage_tpu.ops.tpu_codec import TpuCodec
+
+    blocks, hashes = _mk_blocks(19, size=768, seed=5)
+    tpu = TpuCodec(_params())
+    hy = HybridCodec(_params())
+    ok_t, par_t = tpu.scrub_encode_batch(blocks, hashes)
+    ok_h, par_h = hy.scrub_encode_batch(blocks, hashes)
+    assert np.array_equal(ok_t, ok_h)
+    assert par_t.shape == par_h.shape
+    assert np.array_equal(par_t, par_h)
+    assert np.array_equal(par_t, _cpu_reference_parity(blocks))
+    ok_t2, none_t = tpu.scrub_encode_batch(blocks, hashes, fetch_parity=False)
+    ok_h2, none_h = hy.scrub_encode_batch(blocks, hashes, fetch_parity=False)
+    assert none_t is None and none_h is None
+    assert np.array_equal(ok_t2, ok_h2)
+
+
+def test_hybrid_steals_from_slow_device():
+    # device sleeps per group: the CPU must drain most of the deque and the
+    # call must complete well before the device could have done it alone
+    p = _params()
+    dev = _FakeDevice(p, delay=0.15)
+    hy = HybridCodec(p, device_codec=dev)
+    blocks, hashes = _mk_blocks(80)
+    t0 = time.monotonic()
+    ok = hy.batch_verify(blocks, hashes)
+    dt = time.monotonic() - t0
+    assert ok.all()
+    bytes_cpu, bytes_tpu = hy.pop_stats()
+    assert bytes_cpu > 0, "CPU side never stole work"
+    assert bytes_cpu + bytes_tpu == sum(len(b) for b in blocks)
+    ngroups = 10
+    assert dt < dev.delay * ngroups, "CPU stealing did not shorten the pass"
+
+
+def test_hybrid_absorbs_device_failure():
+    p = _params()
+    hy = HybridCodec(p, device_codec=_FakeDevice(p, fail=True))
+    blocks, hashes = _mk_blocks(32)
+    ok, parity = hy.scrub_encode_batch(blocks, hashes)
+    assert ok.all()
+    assert np.array_equal(parity, _cpu_reference_parity(blocks))
+    _, bytes_tpu = hy.pop_stats()
+    assert bytes_tpu == 0
+
+
+def test_hybrid_real_device_backend_equivalence():
+    # the real TpuCodec as device (JAX CPU platform here): full pipeline
+    # through jitted kernels, concurrent feeder thread included.
+    # make_codec builds the device codec asynchronously (daemon-safe);
+    # wait for the attach before asserting it participates.
+    blocks, hashes = _mk_blocks(48, size=512, seed=3)
+    hy = make_codec("hybrid", **vars(_params()))
+    for _ in range(200):
+        if hy.tpu is not None:
+            break
+        time.sleep(0.05)
+    assert hy.tpu is not None
+    ok, parity = hy.scrub_encode_batch(blocks, hashes)
+    assert ok.all()
+    assert np.array_equal(parity, _cpu_reference_parity(blocks))
+
+
+def test_hybrid_scrub_many_stream():
+    # multi-batch stream through one deque; per-batch result slicing with a
+    # corruption planted in the middle batch
+    hy = HybridCodec(_params())
+    stream = []
+    for s in range(3):
+        blocks, hashes = _mk_blocks(16, seed=s)
+        stream.append((list(blocks), hashes))
+    stream[1][0][5] = b"\x00" * 2048
+    out = hy.scrub_many(stream, fetch_parity=True)
+    assert len(out) == 3
+    ok0, par0 = out[0]
+    ok1, _ = out[1]
+    assert ok0.all() and out[2][0].all()
+    assert not ok1[5] and ok1.sum() == 15
+    assert np.array_equal(par0, _cpu_reference_parity(stream[0][0]))
+    assert np.array_equal(out[2][1], _cpu_reference_parity(stream[2][0]))
+    bytes_cpu, bytes_tpu = hy.pop_stats()
+    assert bytes_cpu + bytes_tpu == 3 * 16 * 2048
+
+
+def test_hybrid_scrub_many_unaligned_batches_parity_is_per_batch():
+    # batch sizes NOT multiples of the group quantum: groups are cut at
+    # batch edges, so each batch's parity comes from its own blocks only
+    hy = HybridCodec(_params())  # group_blocks rounds to 8
+    b0, h0 = _mk_blocks(11, size=256, seed=10)
+    b1, h1 = _mk_blocks(13, size=256, seed=11)
+    out = hy.scrub_many([(b0, h0), (b1, h1)], fetch_parity=True)
+    cpu = CpuCodec(_params())
+    g = hy.group_blocks
+    for (blocks, _h), (ok, parity) in zip([(b0, h0), (b1, h1)], out):
+        assert ok.all() and len(ok) == len(blocks)
+        # reference: per-group codewords WITHIN this batch only (groups are
+        # cut at batch edges, then at the g quantum)
+        expect_rows = []
+        for lo in range(0, len(blocks), g):
+            gb = blocks[lo:lo + g]
+            pad = (-len(gb)) % K
+            arr = np.zeros((len(gb) + pad, 256), dtype=np.uint8)
+            for i, b in enumerate(gb):
+                arr[i] = np.frombuffer(b, dtype=np.uint8)
+            expect_rows.append(cpu.rs_encode(arr.reshape(-1, K, 256)))
+        expect = np.concatenate(expect_rows, axis=0)
+        assert parity.shape == expect.shape and np.array_equal(parity, expect)
+
+
+def test_hybrid_replication_only_config():
+    # rs_data=0 (replication-only, no RS) must construct and verify fine
+    p = CodecParams(rs_data=0, rs_parity=0, hybrid_group_blocks=8)
+    hy = HybridCodec(p, build_device=False)
+    blocks, hashes = _mk_blocks(20)
+    ok = hy.batch_verify(blocks, hashes)
+    assert ok.all()
+    ok2, parity = hy.scrub_encode_batch(blocks, hashes)
+    assert ok2.all() and parity is None
+
+
+def test_hybrid_build_device_false_skips_device():
+    hy = HybridCodec(_params(), build_device=False)
+    assert hy.tpu is None
+    blocks, hashes = _mk_blocks(24)
+    assert hy.batch_verify(blocks, hashes).all()
+
+
+def test_hybrid_concurrent_calls_thread_safety():
+    # two threads scrubbing through one codec instance must not cross wires
+    hy = HybridCodec(_params())
+    blocks_a, hashes_a = _mk_blocks(24, seed=1)
+    blocks_b, hashes_b = _mk_blocks(24, seed=2)
+    out = {}
+
+    def run(name, b, h):
+        out[name] = hy.batch_verify(b, h)
+
+    ts = [threading.Thread(target=run, args=("a", blocks_a, hashes_a)),
+          threading.Thread(target=run, args=("b", blocks_b, hashes_b))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert out["a"].all() and out["b"].all()
