@@ -76,6 +76,9 @@ class K2VApiServer:
                 body=error_xml(e, request.path, bytes(gen_uuid()).hex()[:16]),
                 content_type="application/xml",
             )
+        except ConnectionError as e:  # incl. ConnectionResetError
+            logger.debug("client disconnected mid-request: %s", e)
+            raise
         except Exception as e:  # noqa: BLE001
             logger.exception("K2V API error")
             return web.Response(

@@ -23,6 +23,7 @@ from ...model.helper import (
     NoSuchKey,
 )
 from ...utils.data import gen_uuid
+from ...utils.metrics import maybe_time
 from ..common import (
     AccessDeniedError,
     ApiError,
@@ -58,15 +59,16 @@ class S3ApiServer:
         self.error_counter = 0
         m = getattr(garage.system, "metrics", None)
         if m is not None:
-            reg = m.__dict__.setdefault("_api_shared", {})
-            if not reg:
-                reg["requests"] = m.counter(
-                    "api_request_counter", "API requests received")
-                reg["errors"] = m.counter(
-                    "api_error_counter", "API requests answered with an error")
-                reg["duration"] = m.histogram(
-                    "api_request_duration_seconds", "API request latency")
-            self._m = reg
+            # families shared across API servers via registry name-dedup;
+            # each server records with its own api= label
+            self._m = {
+                "requests": m.counter(
+                    "api_request_counter", "API requests received"),
+                "errors": m.counter(
+                    "api_error_counter", "API requests answered with an error"),
+                "duration": m.histogram(
+                    "api_request_duration_seconds", "API request latency"),
+            }
         else:
             self._m = None
 
@@ -94,16 +96,17 @@ class S3ApiServer:
 
     async def handle_request(self, request: web.Request) -> web.StreamResponse:
         self.request_counter += 1
-        import contextlib
-
         if self._m is not None:
             self._m["requests"].inc(api="s3")
-            timer = self._m["duration"].time(api="s3")
-        else:
-            timer = contextlib.nullcontext()
-        with timer:
+        with maybe_time(self._m and self._m["duration"], api="s3"):
             try:
                 return await self._handle(request)
+            except ConnectionError as e:  # incl. ConnectionResetError
+                # the CLIENT hung up mid-response (aborted download, closed
+                # tab) — normal operation, not a server error; nothing can
+                # be written back on a dead transport anyway
+                logger.debug("client disconnected mid-request: %s", e)
+                raise
             except (ApiError, GarageError, NoSuchBucket, NoSuchKey) as e:
                 self.error_counter += 1
                 status = getattr(e, "status", 500)

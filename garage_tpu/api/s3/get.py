@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import email.utils
+import logging
 from typing import Dict, List, Optional, Tuple
 
 from aiohttp import web
@@ -25,6 +26,8 @@ from ..common import (
 )
 
 PREFETCH = 2  # buffered(2) block prefetch (ref get.rs:458-466)
+
+logger = logging.getLogger("garage_tpu.api.s3")
 
 
 async def get_object_version(ctx, key: str):
@@ -221,16 +224,19 @@ class _BlockPump:
         self.task = asyncio.ensure_future(self._run(garage, h, order_tag))
 
     async def _run(self, garage, h: Hash, order_tag: int) -> None:
+        gen = garage.block_manager.rpc_get_block_streaming(h, order_tag)
         try:
-            async for chunk in garage.block_manager.rpc_get_block_streaming(
-                h, order_tag
-            ):
+            async for chunk in gen:
                 await self.q.put(chunk)
             await self.q.put(None)
         except asyncio.CancelledError:
             raise
         except Exception as e:  # propagated to the writer loop
             await self.q.put(e)
+        finally:
+            # explicit close (not GC finalizers): the generator's cleanup
+            # cancels the block stream so the serving node stops pumping
+            await gen.aclose()
 
 
 async def _stream_blocks_range(
@@ -286,6 +292,11 @@ async def _stream_blocks_range(
                 if hi > lo:
                     await resp.write(item[lo - c0 : hi - c0])
         await resp.write_eof()
+    except ConnectionError as e:
+        # the client hung up mid-download — normal operation (aborted
+        # transfer, closed tab); stop the block pumps and return the
+        # partially-written response so aiohttp closes out quietly
+        logger.debug("client disconnected mid-download: %s", e)
     finally:
         for p in all_pumps:
             if not p.task.done():

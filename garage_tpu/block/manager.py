@@ -30,6 +30,7 @@ from ..net.frame import PRIO_BACKGROUND, PRIO_NORMAL
 from ..rpc.system import System
 from ..utils.data import Hash, block_hash
 from ..utils.error import CorruptData, GarageError, NoSuchBlock
+from ..utils.metrics import maybe_time
 from ..utils.persister import Persister
 from .block import DataBlock, DataBlockHeader
 from .layout import DataLayout
@@ -145,10 +146,7 @@ class BlockManager:
     # --- local read/write (ref manager.rs:478-590,689-784) ---
 
     async def write_block(self, h: Hash, data: DataBlock) -> None:
-        import contextlib
-
-        timer = self.m_write_dur.time() if self.m_write_dur else contextlib.nullcontext()
-        with timer:
+        with maybe_time(self.m_write_dur):
             async with self._lock_for(h):
                 await asyncio.to_thread(self._write_block_sync, h, data)
 
@@ -189,10 +187,7 @@ class BlockManager:
     async def read_block(self, h: Hash) -> DataBlock:
         """Read + verify; on corruption move the file aside and requeue a
         resync so a good copy is re-fetched (ref manager.rs:528-590)."""
-        import contextlib
-
-        timer = self.m_read_dur.time() if self.m_read_dur else contextlib.nullcontext()
-        with timer:
+        with maybe_time(self.m_read_dur):
             return await self._read_block_inner(h)
 
     async def _read_block_inner(self, h: Hash) -> DataBlock:
@@ -296,9 +291,13 @@ class BlockManager:
                     prio=PRIO_NORMAL,
                     timeout=BLOCK_RW_TIMEOUT,
                 )
-                if resp.get("err"):
-                    raise NoSuchBlock(resp["err"])
-                raw = await stream.read_all() if stream is not None else b""
+                try:
+                    if resp.get("err"):
+                        raise NoSuchBlock(resp["err"])
+                    raw = await stream.read_all() if stream is not None else b""
+                finally:
+                    if stream is not None:
+                        await stream.aclose()  # no-op if fully consumed
                 return DataBlock(raw, DataBlockHeader.unpack(resp["hdr"]).compressed)
             except Exception as e:
                 errors.append(f"{bytes(node).hex()[:8]}: {e}")
@@ -335,20 +334,28 @@ class BlockManager:
 
                     decomp = zstandard.ZstdDecompressor().decompressobj()
                 skip = delivered
-                if stream is not None:
-                    async for chunk in stream:
-                        out = decomp.decompress(chunk) if decomp else chunk
-                        if not out:
-                            continue
-                        if skip:
-                            if len(out) <= skip:
-                                skip -= len(out)
+                try:
+                    if stream is not None:
+                        async for chunk in stream:
+                            out = decomp.decompress(chunk) if decomp else chunk
+                            if not out:
                                 continue
-                            out = out[skip:]
-                            skip = 0
-                        delivered += len(out)
-                        self.bytes_read += len(out)
-                        yield out
+                            if skip:
+                                if len(out) <= skip:
+                                    skip -= len(out)
+                                    continue
+                                out = out[skip:]
+                                skip = 0
+                            delivered += len(out)
+                            self.bytes_read += len(out)
+                            yield out
+                finally:
+                    # abandoning mid-stream (consumer closed this generator,
+                    # node failover, decompress error) must cancel the
+                    # sender's pump, or it parks in its credit window until
+                    # the connection dies; no-op after full consumption
+                    if stream is not None:
+                        await stream.aclose()
                 return
             except (GarageError, OSError, asyncio.TimeoutError) as e:
                 errors.append(f"{bytes(node).hex()[:8]}: {e}")

@@ -135,7 +135,7 @@ class IndexCounter:
             return
         tk = tree_key(pk, sk)
         cur = tx.get(self.local_counter, tk)
-        local = _decode_local(cur, pk, sk)
+        local = _decode_local(cur)
         ts = now_msec()
         for name, delta in deltas.items():
             ent = local.get(name)
@@ -167,7 +167,7 @@ class IndexCounter:
         cur = self.local_counter.get(tree_key(pk, sk))
         if cur is None:
             return {}
-        return {name: tv[1] for name, tv in _decode_local(cur, b"", "").items()}
+        return {name: tv[1] for name, tv in _decode_local(cur).items()}
 
     # --- offline repair (ref index_counter.rs:252-377) ---
 
@@ -253,7 +253,7 @@ class IndexCounter:
                 ts = now_msec()
                 for tk, (pk, sk, counts) in agg.items():
                     cur = tx.get(self.local_counter, tk)
-                    local = _decode_local(cur, pk, sk)
+                    local = _decode_local(cur)
                     for name, v in counts.items():
                         ent = local.get(name)
                         if ent is None:
@@ -278,7 +278,7 @@ class IndexCounter:
 RECOUNT_BATCH = 1000  # ref index_counter.rs recount batches
 
 
-def _decode_local(cur: Optional[bytes], pk: bytes, sk: str) -> Dict[str, List[int]]:
+def _decode_local(cur: Optional[bytes]) -> Dict[str, List[int]]:
     """Value → {name: [ts, v]}, accepting the legacy bare-dict format."""
     if cur is None:
         return {}

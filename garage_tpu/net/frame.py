@@ -35,6 +35,7 @@ K_PING = 6       # payload = 8-byte token, echoed in PONG
 K_PONG = 7
 K_GOODBYE = 8    # clean shutdown notice
 K_WIN = 9        # per-stream flow-control credit grant: payload = u32 chunks
+K_CANCEL = 10    # receiver abandoned an incoming stream: sender stops pumping
 
 CHUNK = 16 * 1024          # streaming body chunk size
 MAX_FRAME = 16 * 1024 * 1024  # sanity bound on one frame payload

@@ -52,6 +52,7 @@ from ..utils.error import RpcError
 from .frame import (
     CHUNK,
     HDR_SIZE,
+    K_CANCEL,
     K_DATA,
     K_EOS,
     K_ERR,
@@ -118,39 +119,54 @@ def load_or_gen_node_key(path: str) -> Ed25519PrivateKey:
 class ByteStream:
     """Incoming streaming body: async-iterate 16 KiB chunks.
 
-    Connection-fed streams are flow-controlled: the remote sender holds at
-    most STREAM_WINDOW chunks in flight, and `on_consumed` (an async
-    callable) grants credit back as the consumer drains — so the queue
-    stays bounded WITHOUT ever blocking the connection reader.  Loopback
-    streams (no on_consumed) rely on the local producer awaiting _push."""
+    The queue is BOUNDED (STREAM_WINDOW + 2 chunks ≈ 2 MiB): connection-fed
+    streams stay within it because the remote sender respects the credit
+    window (`on_consumed` grants credit back as the consumer drains; a
+    sender that violates the window fails the stream instead of growing the
+    buffer), and loopback streams get real backpressure because the local
+    producer awaits `_push` on the full queue.
 
-    def __init__(self, on_consumed=None):
-        self._q: asyncio.Queue = asyncio.Queue()
+    A consumer that stops early MUST call `aclose()` — it tells the sender
+    to stop pumping (K_CANCEL for connection streams, producer-task cancel
+    for loopback); abandoning the object without it parks the remote pump
+    in its credit window until the connection closes."""
+
+    def __init__(self, on_consumed=None, on_cancel=None,
+                 maxsize: int = STREAM_WINDOW + 2):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
         self._err: Optional[str] = None
         self._on_consumed = on_consumed
+        self._on_cancel = on_cancel
         self._consumed = 0
+        self._done = False
 
     async def _push(self, chunk: Optional[bytes]):
         await self._q.put(chunk)
 
     def _push_nowait(self, chunk: Optional[bytes]):
-        self._q.put_nowait(chunk)
+        try:
+            self._q.put_nowait(chunk)
+        except asyncio.QueueFull:
+            # only a sender ignoring the credit window can get here
+            self._fail("flow-control window violated by sender")
 
     def _fail(self, err: str):
         self._err = err
         try:
             self._q.put_nowait(None)
         except asyncio.QueueFull:
-            pass
+            pass  # consumer drains the queue, then sees _err
 
     def __aiter__(self):
         return self
 
     async def __anext__(self) -> bytes:
         if self._err is not None and self._q.empty():
+            self._done = True
             raise RpcError(f"stream error: {self._err}")
         chunk = await self._q.get()
         if chunk is None:
+            self._done = True
             if self._err is not None:
                 raise RpcError(f"stream error: {self._err}")
             raise StopAsyncIteration
@@ -163,6 +179,19 @@ class ByteStream:
                 except Exception:  # conn gone: the stream will fail anyway
                     pass
         return chunk
+
+    async def aclose(self) -> None:
+        """Abandon the stream: the sender stops pumping and both sides drop
+        their per-stream state.  No-op after full consumption."""
+        if self._done:
+            return
+        self._done = True
+        self._err = "cancelled by receiver"
+        if self._on_cancel is not None:
+            try:
+                await self._on_cancel()
+            except Exception:
+                pass
 
     async def read_all(self) -> bytes:
         return b"".join([c async for c in self])
@@ -249,25 +278,40 @@ class _OutMux:
             self.cv.notify_all()
 
 
+class _StreamCancelled(Exception):
+    """Receiver abandoned the stream (K_CANCEL) — stop pumping, silently."""
+
+
+async def _cancel_task(task: Optional[asyncio.Task]) -> None:
+    """Loopback streams' on_cancel hook: stop the local producer task."""
+    if task is not None and not task.done():
+        task.cancel()
+
+
 class _Credit:
     """Sender-side flow-control window for one outgoing stream."""
 
-    __slots__ = ("n", "_ev", "_failed")
+    __slots__ = ("n", "_ev", "_failed", "_cancelled")
 
     def __init__(self, n: int):
         self.n = n
         self._ev = asyncio.Event()
         self._failed = False
+        self._cancelled = False
 
     async def take(self) -> None:
         while self.n <= 0:
-            if self._failed:
-                raise RpcError("connection lost (flow control)")
+            self._check()
             self._ev.clear()
             await self._ev.wait()
+        self._check()
+        self.n -= 1
+
+    def _check(self) -> None:
+        if self._cancelled:
+            raise _StreamCancelled()
         if self._failed:
             raise RpcError("connection lost (flow control)")
-        self.n -= 1
 
     def grant(self, n: int) -> None:
         self.n += n
@@ -275,6 +319,10 @@ class _Credit:
 
     def fail(self) -> None:
         self._failed = True
+        self._ev.set()
+
+    def cancel(self) -> None:
+        self._cancelled = True
         self._ev.set()
 
 
@@ -378,6 +426,8 @@ class Connection:
             await self._out.put(Frame(K_EOS, prio, sid, b""))
         except asyncio.CancelledError:
             raise
+        except _StreamCancelled:
+            pass  # receiver already dropped its end; nothing to tell it
         except Exception as e:
             logger.debug("body pump error on stream %d: %s", sid, e)
             try:
@@ -386,6 +436,14 @@ class Connection:
                 pass
         finally:
             self._send_credit.pop(sid, None)
+            # release upstream resources (file handles, generators) promptly
+            # — `async for` does not close a broken-out-of async generator
+            aclose = getattr(body, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    pass
 
     async def ping(self, timeout: float = 10.0) -> float:
         token = os.urandom(8)
@@ -445,7 +503,16 @@ class Connection:
                 Frame(K_WIN, PRIO_HIGH, _sid, struct.pack(">I", n))
             )
 
-        return ByteStream(on_consumed=grant)
+        async def cancel(_sid=sid):
+            # drop local state first so in-flight K_DATA frames are ignored,
+            # then tell the sender to stop pumping
+            self._in_streams.pop(_sid, None)
+            try:
+                await self._out.put(Frame(K_CANCEL, PRIO_HIGH, _sid, b""))
+            except RpcError:
+                pass  # connection gone — sender state died with it
+
+        return ByteStream(on_consumed=grant, on_cancel=cancel)
 
     async def _dispatch(self, kind: int, prio: int, sid: int, payload: bytes):
         if kind == K_REQ:
@@ -482,6 +549,10 @@ class Connection:
             credit = self._send_credit.get(sid)
             if credit is not None:
                 credit.grant(struct.unpack(">I", payload[:4])[0])
+        elif kind == K_CANCEL:
+            credit = self._send_credit.get(sid)
+            if credit is not None:
+                credit.cancel()
         elif kind == K_EOS:
             stream = self._in_streams.pop(sid, None)
             if stream is not None:
@@ -738,7 +809,7 @@ class NetApp:
         in_stream: Optional[ByteStream] = None
         pump = None
         if body is not None:
-            in_stream = ByteStream()
+            in_stream = ByteStream(on_cancel=lambda: _cancel_task(pump))
 
             async def _feed():
                 try:
@@ -747,6 +818,13 @@ class NetApp:
                     await in_stream._push(None)
                 except Exception as e:
                     in_stream._fail(str(e))
+                finally:
+                    aclose = getattr(body, "aclose", None)
+                    if aclose is not None:
+                        try:
+                            await aclose()
+                        except Exception:
+                            pass
 
             pump = asyncio.get_running_loop().create_task(_feed())
         try:
@@ -758,17 +836,27 @@ class NetApp:
                 pump.cancel()
         out_stream = None
         if resp_body is not None:
-            out_stream = ByteStream()
+            out_pump = None
+            out_stream = ByteStream(on_cancel=lambda: _cancel_task(out_pump))
 
             async def _feed_out():
                 try:
                     async for chunk in resp_body:
+                        # backpressure: blocks on the bounded queue until
+                        # the consumer drains (or cancels the task)
                         await out_stream._push(bytes(chunk))
                     await out_stream._push(None)
                 except Exception as e:
                     out_stream._fail(str(e))
+                finally:
+                    aclose = getattr(resp_body, "aclose", None)
+                    if aclose is not None:
+                        try:
+                            await aclose()
+                        except Exception:
+                            pass
 
-            asyncio.get_running_loop().create_task(_feed_out())
+            out_pump = asyncio.get_running_loop().create_task(_feed_out())
         return resp, out_stream
 
     async def shutdown(self):

@@ -54,17 +54,17 @@ class TableSyncer:
         self.endpoint.set_handler(self._handle)
         self.worker: Optional[SyncWorker] = None
         # sync item counters (ref table/metrics.rs sync_items_sent/received)
+        # — families shared across tables via registry name-dedup
         m = getattr(system, "metrics", None)
         if m is not None:
-            reg = m.__dict__.setdefault("_sync_shared", {})
-            if not reg:
-                reg["sent"] = m.counter(
+            self._m = {
+                "sent": m.counter(
                     "table_sync_items_sent",
-                    "Items sent to other nodes during anti-entropy")
-                reg["recv"] = m.counter(
+                    "Items sent to other nodes during anti-entropy"),
+                "recv": m.counter(
                     "table_sync_items_received",
-                    "Items received from other nodes during anti-entropy")
-            self._m = reg
+                    "Items received from other nodes during anti-entropy"),
+            }
         else:
             self._m = None
 

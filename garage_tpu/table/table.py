@@ -24,6 +24,7 @@ from ..rpc.rpc_helper import RequestStrategy
 from ..rpc.system import System
 from ..utils.data import FixedBytes32, Hash
 from ..utils.error import GarageError
+from ..utils.metrics import maybe_time
 from .data import TableData
 from .merkle import MerkleUpdater
 from .replication import TableReplication
@@ -56,29 +57,28 @@ class Table:
         self.gc = None
         self._repair_tasks: set = set()  # strong refs: loop holds tasks weakly
 
-        # per-table request metrics (ref table/metrics.rs): shared metric
-        # families across tables with a table_name label
+        # per-table request metrics (ref table/metrics.rs): metric families
+        # are shared across tables (registry dedups by name); each table
+        # records with its own table_name label
         m = getattr(system, "metrics", None)
         self._tname = schema.TABLE_NAME
         if m is not None:
-            reg = m.__dict__.setdefault("_table_shared", {})
-            if not reg:
-                reg["gets"] = m.counter(
-                    "table_get_request_counter", "Table get/get_range requests")
-                reg["puts"] = m.counter(
-                    "table_put_request_counter", "Table insert requests")
-                reg["get_dur"] = m.histogram(
-                    "table_get_request_duration_seconds", "Table read latency")
-                reg["put_dur"] = m.histogram(
-                    "table_put_request_duration_seconds", "Table write latency")
-                reg["size"] = m.gauge(
-                    "table_size", "Number of items in table")
-                reg["merkle_todo"] = m.gauge(
+            self._m = {
+                "gets": m.counter(
+                    "table_get_request_counter", "Table get/get_range requests"),
+                "puts": m.counter(
+                    "table_put_request_counter", "Table insert requests"),
+                "get_dur": m.histogram(
+                    "table_get_request_duration_seconds", "Table read latency"),
+                "put_dur": m.histogram(
+                    "table_put_request_duration_seconds", "Table write latency"),
+                "size": m.gauge("table_size", "Number of items in table"),
+                "merkle_todo": m.gauge(
                     "table_merkle_updater_todo_queue_length",
-                    "Merkle updater backlog")
-                reg["gc_todo"] = m.gauge(
-                    "table_gc_todo_queue_length", "Tombstone GC backlog")
-            self._m = reg
+                    "Merkle updater backlog"),
+                "gc_todo": m.gauge(
+                    "table_gc_todo_queue_length", "Tombstone GC backlog"),
+            }
         else:
             self._m = None
 
@@ -97,12 +97,8 @@ class Table:
         """ref table.rs:104-137."""
         if self._m is not None:
             self._m["puts"].inc(table_name=self._tname)
-            timer = self._m["put_dur"].time(table_name=self._tname)
-        else:
-            import contextlib
-
-            timer = contextlib.nullcontext()
-        with timer:
+        with maybe_time(self._m and self._m["put_dur"],
+                        table_name=self._tname):
             await self._insert_inner(entry)
 
     async def _insert_inner(self, entry: Entry) -> None:
@@ -156,10 +152,8 @@ class Table:
     def _read_timer(self):
         if self._m is not None:
             self._m["gets"].inc(table_name=self._tname)
-            return self._m["get_dur"].time(table_name=self._tname)
-        import contextlib
-
-        return contextlib.nullcontext()
+        return maybe_time(self._m and self._m["get_dur"],
+                          table_name=self._tname)
 
     async def get(self, p: Any, s: Any) -> Optional[Entry]:
         """Quorum read with read-repair (ref table.rs:228-284)."""

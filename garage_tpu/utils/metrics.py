@@ -148,29 +148,65 @@ def _num(v) -> str:
     return str(int(f)) if f.is_integer() else repr(f)
 
 
+class _NullTimer:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_TIMER = _NullTimer()
+
+
+def maybe_time(hist: Optional[Histogram], **labels):
+    """Histogram timer, or a no-op context when the layer runs without a
+    metrics registry (tests, bare library use) — keeps hot paths free of
+    per-call conditionals."""
+    return hist.time(**labels) if hist is not None else _NULL_TIMER
+
+
 class MetricsRegistry:
     """One per System; layers create their metrics through it and the
-    admin endpoint renders everything."""
+    admin endpoint renders everything.
+
+    Families are deduplicated BY NAME: two components asking for the same
+    family name share one metric object (several Table instances all
+    record into `table_merge_duration_seconds` with their own labels),
+    and the exposition never emits duplicate `# TYPE` blocks — Prometheus
+    rejects those at scrape time.  Asking for an existing name with a
+    different metric type is a programming error and raises."""
 
     def __init__(self):
         self._metrics: List[object] = []
+        self._by_name: Dict[str, object] = {}
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        m = Counter(name, help)
+    def _get_or_create(self, cls, name: str, *args):
+        m = self._by_name.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}"
+                )
+            return m
+        m = cls(name, *args)
+        self._by_name[name] = m
         self._metrics.append(m)
         return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
 
     def gauge(self, name: str, help: str = "",
               fn: Optional[Callable[[], float]] = None) -> Gauge:
-        m = Gauge(name, help, fn)
-        self._metrics.append(m)
-        return m
+        """Note: on dedup the FIRST registration's observer callback wins;
+        per-instance values should use labelled `set()` instead of `fn`."""
+        return self._get_or_create(Gauge, name, help, fn)
 
     def histogram(self, name: str, help: str = "",
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
-        m = Histogram(name, help, buckets)
-        self._metrics.append(m)
-        return m
+        return self._get_or_create(Histogram, name, help, buckets)
 
     def render(self) -> str:
         lines: List[str] = []

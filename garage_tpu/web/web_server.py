@@ -55,6 +55,9 @@ class WebServer:
             return await self._serve(request, bucket_name)
         except web.HTTPException:
             raise
+        except ConnectionError as e:  # incl. ConnectionResetError
+            logger.debug("client disconnected mid-request: %s", e)
+            raise
         except Exception:
             self.error_counter += 1
             logger.exception("web request failed")

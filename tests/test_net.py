@@ -313,3 +313,112 @@ async def test_flow_control_bounds_receiver_buffer():
     assert got == 1000 * 16384
     assert sent["n"] == 1000
     await shutdown(a, b)
+
+
+async def test_receiver_cancel_stops_remote_pump():
+    """aclose() on a partially-consumed stream sends K_CANCEL: the serving
+    side's pump stops (no more chunks produced) and its per-stream state is
+    dropped, while the connection keeps serving other RPCs."""
+    a, b, conn = await make_pair()
+    produced = {"n": 0}
+    closed = {"gen": False}
+
+    async def handler(remote, msg, body):
+        async def resp_body():
+            try:
+                for _ in range(10_000):
+                    produced["n"] += 1
+                    yield b"c" * 16384
+            finally:
+                closed["gen"] = True
+
+        return {}, resp_body()
+
+    async def quick(remote, msg, body):
+        return {"pong": True}, None
+
+    b.endpoint("test/cancelme").set_handler(handler)
+    b.endpoint("test/quick2").set_handler(quick)
+
+    _resp, stream = await a.endpoint("test/cancelme").call_streaming(b.id, {})
+    it = stream.__aiter__()
+    for _ in range(3):
+        await it.__anext__()
+    await stream.aclose()
+
+    # the sender's pump must wind down: production stops near the credit
+    # window and the response-body generator is closed
+    for _ in range(100):
+        if closed["gen"]:
+            break
+        await asyncio.sleep(0.05)
+    assert closed["gen"], "sender generator never closed after cancel"
+    assert produced["n"] < 10_000
+    b_conn = list(b.conns.values())[0]
+    for _ in range(100):
+        if not b_conn._send_credit:
+            break
+        await asyncio.sleep(0.05)
+    assert not b_conn._send_credit, "sender per-stream state leaked"
+    # receiver side state dropped too
+    assert not conn._in_streams
+
+    # connection still healthy
+    resp = await a.endpoint("test/quick2").call(b.id, {})
+    assert resp == {"pong": True}
+    # aclose is idempotent and safe after full consumption elsewhere
+    await stream.aclose()
+    await shutdown(a, b)
+
+
+async def test_loopback_stream_backpressure_and_cancel():
+    """Loopback (self-call) streams: the bounded queue blocks the local
+    producer (no unbounded RAM growth), and aclose cancels the producer
+    task and closes its generator."""
+    a = NetApp(gen_node_key(), "s")
+    produced = {"n": 0}
+    closed = {"gen": False}
+
+    async def handler(remote, msg, body):
+        async def resp_body():
+            try:
+                for _ in range(10_000):
+                    produced["n"] += 1
+                    yield b"L" * 16384
+            finally:
+                closed["gen"] = True
+
+        return {}, resp_body()
+
+    a.endpoint("test/loop").set_handler(handler)
+    _resp, stream = await a.endpoint("test/loop").call_streaming(a.id, {})
+    await asyncio.sleep(0.3)  # producer runs against a never-reading consumer
+    from garage_tpu.net.netapp import STREAM_WINDOW
+
+    assert produced["n"] <= STREAM_WINDOW + 4, (
+        f"loopback producer ran {produced['n']} chunks ahead (no backpressure)"
+    )
+    it = stream.__aiter__()
+    await it.__anext__()
+    await stream.aclose()
+    for _ in range(100):
+        if closed["gen"]:
+            break
+        await asyncio.sleep(0.05)
+    assert closed["gen"], "loopback producer not cancelled by aclose"
+    assert produced["n"] < 10_000
+    await a.shutdown()
+
+
+async def test_flow_control_violation_fails_stream():
+    """A sender ignoring the credit window must fail the stream, not grow
+    the receive buffer without bound."""
+    s = ByteStream()  # no on_consumed: stand-alone, bounded queue
+    for i in range(s._q.maxsize):
+        s._push_nowait(b"x")
+    s._push_nowait(b"overflow")  # exceeds the bound -> stream fails
+    got = []
+    with pytest.raises(RpcError, match="flow-control"):
+        async for c in s:
+            got.append(c)
+    assert len(got) == s._q.maxsize  # delivered what fit, then errored
