@@ -98,39 +98,52 @@ class S3ApiServer:
         self.request_counter += 1
         if self._m is not None:
             self._m["requests"].inc(api="s3")
-        with maybe_time(self._m and self._m["duration"], api="s3"):
-            try:
-                return await self._handle(request)
-            except ConnectionError as e:  # incl. ConnectionResetError
-                # the CLIENT hung up mid-response (aborted download, closed
-                # tab) — normal operation, not a server error; nothing can
-                # be written back on a dead transport anyway
-                logger.debug("client disconnected mid-request: %s", e)
-                raise
-            except (ApiError, GarageError, NoSuchBucket, NoSuchKey) as e:
-                self.error_counter += 1
-                status = getattr(e, "status", 500)
-                if self._m is not None:
-                    self._m["errors"].inc(api="s3", status=str(status))
-                if status >= 500:
-                    logger.exception("S3 API internal error")
-                else:
-                    logger.debug("S3 API error %s: %s", status, e)
-                return web.Response(
-                    status=status,
-                    body=error_xml(e, request.path, bytes(gen_uuid()).hex()[:16]),
-                    content_type="application/xml",
-                )
-            except Exception as e:  # noqa: BLE001 — uniform 500 rendering
-                self.error_counter += 1
-                if self._m is not None:
-                    self._m["errors"].inc(api="s3", status="500")
-                logger.exception("S3 API unexpected error")
-                return web.Response(
-                    status=500,
-                    body=error_xml(e, request.path, ""),
-                    content_type="application/xml",
-                )
+        # fresh trace per request (ref generic_server.rs:187-200); child
+        # spans (table ops, quorum RPCs, block IO) parent under it via the
+        # context variable.  new_trace is a shared no-op when tracing is
+        # off (set_attr included).
+        trace = self.garage.system.tracer.new_trace(
+            f"S3 {request.method}", api="s3", method=request.method,
+            path=request.path,
+        )
+        with trace, maybe_time(self._m and self._m["duration"], api="s3"):
+            resp = await self._handle_with_errors(request)
+            trace.set_attr("status", resp.status)
+            return resp
+
+    async def _handle_with_errors(self, request) -> web.StreamResponse:
+        try:
+            return await self._handle(request)
+        except ConnectionError as e:  # incl. ConnectionResetError
+            # the CLIENT hung up mid-response (aborted download, closed
+            # tab) — normal operation, not a server error; nothing can
+            # be written back on a dead transport anyway
+            logger.debug("client disconnected mid-request: %s", e)
+            raise
+        except (ApiError, GarageError, NoSuchBucket, NoSuchKey) as e:
+            self.error_counter += 1
+            status = getattr(e, "status", 500)
+            if self._m is not None:
+                self._m["errors"].inc(api="s3", status=str(status))
+            if status >= 500:
+                logger.exception("S3 API internal error")
+            else:
+                logger.debug("S3 API error %s: %s", status, e)
+            return web.Response(
+                status=status,
+                body=error_xml(e, request.path, bytes(gen_uuid()).hex()[:16]),
+                content_type="application/xml",
+            )
+        except Exception as e:  # noqa: BLE001 — uniform 500 rendering
+            self.error_counter += 1
+            if self._m is not None:
+                self._m["errors"].inc(api="s3", status="500")
+            logger.exception("S3 API unexpected error")
+            return web.Response(
+                status=500,
+                body=error_xml(e, request.path, ""),
+                content_type="application/xml",
+            )
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         headers = {k.lower(): v for k, v in request.headers.items()}

@@ -145,8 +145,15 @@ class BlockManager:
 
     # --- local read/write (ref manager.rs:478-590,689-784) ---
 
+    def _span(self, op: str, h: Hash):
+        """Per-block-op tracing span (ref block/manager.rs:492-501);
+        Tracer.span is a shared no-op when tracing is off."""
+        return self.system.tracer.span(
+            f"Block {op}", block=bytes(h).hex()[:16], op=op
+        )
+
     async def write_block(self, h: Hash, data: DataBlock) -> None:
-        with maybe_time(self.m_write_dur):
+        with self._span("write", h), maybe_time(self.m_write_dur):
             async with self._lock_for(h):
                 await asyncio.to_thread(self._write_block_sync, h, data)
 
@@ -187,7 +194,7 @@ class BlockManager:
     async def read_block(self, h: Hash) -> DataBlock:
         """Read + verify; on corruption move the file aside and requeue a
         resync so a good copy is re-fetched (ref manager.rs:528-590)."""
-        with maybe_time(self.m_read_dur):
+        with self._span("read", h), maybe_time(self.m_read_dur):
             return await self._read_block_inner(h)
 
     async def _read_block_inner(self, h: Hash) -> DataBlock:

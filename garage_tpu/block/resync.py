@@ -191,6 +191,13 @@ class BlockResyncManager:
     # --- the convergence step (ref resync.rs:361-471) ---
 
     async def resync_block(self, h: Hash) -> None:
+        # per-resync tracing span (ref block/resync.rs:286-303)
+        with self.manager.system.tracer.span(
+            "Block resync", block=bytes(h).hex()[:16]
+        ):
+            await self._resync_block_inner(h)
+
+    async def _resync_block_inner(self, h: Hash) -> None:
         mgr = self.manager
         rc = mgr.rc.get(h)
         present = mgr.is_block_present(h)

@@ -258,6 +258,11 @@ class Garage:
 
     async def shutdown(self) -> None:
         await self.bg.shutdown()
+        tracer = getattr(self.system, "tracer", None)
+        if tracer is not None:
+            await tracer.stop()  # final span flush before the node exits
+            if tracer.exporter is not None:
+                await tracer.exporter.close()
         await self.system.shutdown()
         if self._owns_db:
             self.db.close()

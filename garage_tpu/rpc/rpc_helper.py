@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
@@ -42,11 +43,13 @@ class RequestStrategy:
 
 
 class RpcHelper:
-    def __init__(self, netapp: NetApp, peering: FullMeshPeering, metrics=None):
+    def __init__(self, netapp: NetApp, peering: FullMeshPeering, metrics=None,
+                 tracer=None):
         self.netapp = netapp
         self.peering = peering
         self.our_id = netapp.id
         self._drain_tasks: set = set()
+        self.tracer = tracer
         # per-RPC counters + latency histogram (ref rpc/metrics.rs:38)
         if metrics is not None:
             self.m_requests = metrics.counter(
@@ -176,9 +179,21 @@ class RpcHelper:
         def call_node(n: NodeID):
             return timed(n)
 
-        if strategy.rs_interrupt_after_quorum:
-            return await self._quorum_read(nodes, call_node, quorum)
-        return await self._quorum_write(nodes, call_node, quorum)
+        # quorum-call span with the reference's attributes
+        # (rpc/rpc_helper.rs:238-260: to, quorum, strategy); attrs are only
+        # built when tracing is on
+        tr = self.tracer
+        span = tr.span(
+            f"RPC {endpoint.path}",
+            to=",".join(bytes(n).hex()[:8] for n in nodes),
+            quorum=quorum,
+            strategy=("interrupt_after_quorum"
+                      if strategy.rs_interrupt_after_quorum else "all_sent"),
+        ) if tr is not None and tr.enabled else nullcontext()
+        with span:
+            if strategy.rs_interrupt_after_quorum:
+                return await self._quorum_read(nodes, call_node, quorum)
+            return await self._quorum_write(nodes, call_node, quorum)
 
     async def _quorum_read(self, nodes, call_node, quorum) -> List[Any]:
         ordered = self.request_order(nodes)

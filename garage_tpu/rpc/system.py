@@ -112,9 +112,17 @@ class System:
         # admin /metrics endpoint renders it (ref util/metrics.rs + the
         # per-layer metric structs)
         from ..utils.metrics import MetricsRegistry
+        from ..utils.tracing import init_tracing
 
         self.metrics = MetricsRegistry()
-        self.rpc = RpcHelper(self.netapp, self.peering, metrics=self.metrics)
+        # tracer next to the metrics registry: spans export to
+        # admin.trace_sink when configured, no-op otherwise (ref
+        # garage/tracing_setup.rs:13-37)
+        self.tracer = init_tracing(
+            getattr(config, "admin_trace_sink", None), bytes(self.id)
+        )
+        self.rpc = RpcHelper(self.netapp, self.peering, metrics=self.metrics,
+                             tracer=self.tracer)
 
         self._layout_persister: Persister = Persister(
             config.metadata_dir, "cluster_layout", ClusterLayout
@@ -321,6 +329,7 @@ class System:
     async def run(self):
         await self.netapp.listen(self.config.rpc_bind_addr)
         self.peering.start()
+        self.tracer.start()  # flush loop; no-op unless trace_sink configured
         loop = asyncio.get_running_loop()
         self._tasks = [
             loop.create_task(self._status_exchange_loop()),

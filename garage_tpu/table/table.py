@@ -97,8 +97,9 @@ class Table:
         """ref table.rs:104-137."""
         if self._m is not None:
             self._m["puts"].inc(table_name=self._tname)
-        with maybe_time(self._m and self._m["put_dur"],
-                        table_name=self._tname):
+        with self._span("insert"), \
+                maybe_time(self._m and self._m["put_dur"],
+                           table_name=self._tname):
             await self._insert_inner(entry)
 
     async def _insert_inner(self, entry: Entry) -> None:
@@ -149,6 +150,13 @@ class Table:
                 f"insert_many: {failed}/{len(entries)} entries below write quorum"
             )
 
+    def _span(self, op: str):
+        """Per-table-op tracing span (ref table/table.rs:105-110);
+        Tracer.span is a shared no-op when tracing is off."""
+        return self.system.tracer.span(
+            f"Table {self._tname} {op}", table=self._tname, op=op
+        )
+
     def _read_timer(self):
         if self._m is not None:
             self._m["gets"].inc(table_name=self._tname)
@@ -157,7 +165,7 @@ class Table:
 
     async def get(self, p: Any, s: Any) -> Optional[Entry]:
         """Quorum read with read-repair (ref table.rs:228-284)."""
-        with self._read_timer():
+        with self._span("get"), self._read_timer():
             return await self._get_inner(p, s)
 
     async def _get_inner(self, p: Any, s: Any) -> Optional[Entry]:
@@ -208,7 +216,7 @@ class Table:
     ) -> List[Entry]:
         """Quorum range read, merged per key, with read-repair of divergent
         items (ref table.rs:314-407)."""
-        with self._read_timer():
+        with self._span("get_range"), self._read_timer():
             return await self._get_range_inner(
                 p, start_sort_key, filter, limit, reverse
             )

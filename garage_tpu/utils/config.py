@@ -114,6 +114,7 @@ class Config:
     admin_api_bind_addr: Optional[str] = None
     admin_metrics_token: Optional[str] = None
     admin_token: Optional[str] = None
+    admin_trace_sink: Optional[str] = None  # OTLP/HTTP collector endpoint
     k2v_api_bind_addr: Optional[str] = None
     codec: CodecConfig = field(default_factory=CodecConfig)
     # raw parsed TOML for anything not modeled
@@ -169,6 +170,7 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
 
     admin = raw.get("admin", {})
     cfg.admin_api_bind_addr = admin.get("api_bind_addr", cfg.admin_api_bind_addr)
+    cfg.admin_trace_sink = admin.get("trace_sink", cfg.admin_trace_sink)
     cfg.admin_metrics_token = admin.get("metrics_token", cfg.admin_metrics_token)
     cfg.admin_token = admin.get("admin_token", cfg.admin_token)
 

@@ -1,0 +1,262 @@
+"""Distributed tracing — spans + OTLP/HTTP export.
+
+Equivalent of the reference's OpenTelemetry integration: OTLP exporter
+configured from `admin.trace_sink` (ref garage/tracing_setup.rs:13-37,
+util/config.rs:173-175), spans created per API request with a fresh
+trace id (ref api/generic_server.rs:187-200), per table op (ref
+table/table.rs:105-110), per RPC quorum call with quorum attributes (ref
+rpc/rpc_helper.rs:238-260), and per block write/read/resync (ref
+block/manager.rs:492-501, resync.rs:286-303).
+
+No OTel SDK dependency: spans are plain records, parenting rides a
+contextvar (task-local, so concurrent requests don't cross wires), and
+the exporter speaks the OTLP/HTTP **JSON** encoding directly
+(opentelemetry-proto's canonical JSON mapping), batched on a background
+task.  A dead or slow collector drops batches after a short timeout —
+tracing must never hold up the data path.
+
+When no trace_sink is configured the tracer is disabled and `span()`
+returns a shared no-op context manager: the instrumentation points cost
+one truthiness check.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import logging
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("garage_tpu.tracing")
+
+FLUSH_INTERVAL = 3.0      # seconds between export batches
+EXPORT_TIMEOUT = 3.0      # ref tracing_setup.rs with_timeout(3s)
+MAX_BUFFER = 4096         # spans held while the collector is unreachable
+MAX_BATCH = 512
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "garage_tpu_current_span", default=None
+)
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "start_ns",
+                 "end_ns", "attrs", "error", "_tracer", "_token")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str], attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = os.urandom(8).hex()
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.start_ns = time.time_ns()
+        self.end_ns = 0
+        self.error: Optional[str] = None
+        self._token = None
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def __enter__(self) -> "Span":
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> bool:
+        self.end_ns = time.time_ns()
+        if exc is not None:
+            self.error = f"{exc_type.__name__}: {exc}"
+        _current_span.reset(self._token)
+        self._tracer._record(self)
+        return False
+
+
+class _NullSpan:
+    """Shared no-op for disabled tracers — the hot-path cost is ~nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, key, value):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Per-node tracer; lives on System next to the metrics registry."""
+
+    def __init__(self, service_instance: str = "",
+                 exporter: Optional["OtlpHttpExporter"] = None):
+        self.exporter = exporter
+        self.enabled = exporter is not None
+        self.service_instance = service_instance
+        self._buf: deque = deque(maxlen=MAX_BUFFER)
+        self.dropped = 0
+        self.exported = 0
+        self._task: Optional[asyncio.Task] = None
+
+    # --- span creation ---
+
+    def span(self, name: str, /, **attrs):
+        """Child span of the context's current span (or a new trace root
+        if none)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        parent = _current_span.get()
+        if parent is not None:
+            return Span(self, name, parent.trace_id, parent.span_id, attrs)
+        return Span(self, name, os.urandom(16).hex(), None, attrs)
+
+    def new_trace(self, name: str, /, **attrs):
+        """Root span with a FRESH trace id — one per API request (ref
+        generic_server.rs:187-200 gen_trace_id)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return Span(self, name, os.urandom(16).hex(), None, attrs)
+
+    def _record(self, span: Span) -> None:
+        if len(self._buf) == self._buf.maxlen:
+            self.dropped += 1
+        self._buf.append(span)
+
+    # --- export loop ---
+
+    def start(self) -> None:
+        if self.enabled and self._task is None:
+            self._task = asyncio.get_running_loop().create_task(
+                self._flush_loop(), name="tracing-flush"
+            )
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.flush()
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(FLUSH_INTERVAL)
+            try:
+                await self.flush()
+            except Exception:
+                logger.debug("trace flush failed", exc_info=True)
+
+    async def flush(self) -> None:
+        if self.exporter is None:
+            return
+        while self._buf:
+            batch = []
+            while self._buf and len(batch) < MAX_BATCH:
+                batch.append(self._buf.popleft())
+            try:
+                ok = await self.exporter.export(batch, self.service_instance)
+            except asyncio.CancelledError:
+                # stop() cancelling a flush mid-export: put the batch back
+                # so the final flush (or the drop accounting) sees it
+                self._buf.extendleft(reversed(batch))
+                raise
+            if ok:
+                self.exported += len(batch)
+            else:
+                self.dropped += len(batch)
+                return  # collector unreachable: don't spin on the backlog
+
+
+def _otlp_value(v: Any) -> Dict[str, Any]:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def spans_to_otlp(spans: List[Span], service_instance: str) -> Dict[str, Any]:
+    """OTLP/HTTP JSON payload (the proto3 canonical JSON mapping of
+    ExportTraceServiceRequest)."""
+    out = []
+    for s in spans:
+        rec: Dict[str, Any] = {
+            "traceId": s.trace_id,
+            "spanId": s.span_id,
+            "name": s.name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(s.start_ns),
+            "endTimeUnixNano": str(s.end_ns),
+            "attributes": [
+                {"key": k, "value": _otlp_value(v)} for k, v in s.attrs.items()
+            ],
+            "status": (
+                {"code": 2, "message": s.error} if s.error else {"code": 1}
+            ),
+        }
+        if s.parent_id:
+            rec["parentSpanId"] = s.parent_id
+        out.append(rec)
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name", "value": {"stringValue": "garage_tpu"}},
+                {"key": "service.instance.id",
+                 "value": {"stringValue": service_instance}},
+            ]},
+            "scopeSpans": [{
+                "scope": {"name": "garage_tpu"},
+                "spans": out,
+            }],
+        }]
+    }
+
+
+class OtlpHttpExporter:
+    """POSTs OTLP JSON to `<endpoint>/v1/traces` (3 s timeout, failures
+    dropped — tracing never blocks the node)."""
+
+    def __init__(self, endpoint: str):
+        self.url = endpoint.rstrip("/") + "/v1/traces"
+        self._session = None
+
+    async def export(self, spans: List[Span], service_instance: str) -> bool:
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=EXPORT_TIMEOUT)
+            )
+        try:
+            payload = spans_to_otlp(spans, service_instance)
+            async with self._session.post(self.url, json=payload) as r:
+                return r.status in (200, 202)
+        except Exception as e:
+            logger.debug("OTLP export to %s failed: %s", self.url, e)
+            return False
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+def init_tracing(trace_sink: Optional[str], node_id: bytes) -> Tracer:
+    """Build the node's tracer from `admin.trace_sink` (ref
+    tracing_setup.rs:13-37: service.instance.id = first 8 id bytes)."""
+    instance = bytes(node_id)[:8].hex()
+    if not trace_sink:
+        return Tracer(instance, None)
+    return Tracer(instance, OtlpHttpExporter(trace_sink))

@@ -1,0 +1,227 @@
+"""Tracing subsystem: span parenting, OTLP JSON export, API integration.
+
+Mirrors the reference's OTel integration points (tracing_setup.rs:13-37,
+generic_server.rs:187-200 fresh-trace-per-request, rpc_helper.rs:238-260
+quorum-call spans) against a fake OTLP/HTTP collector.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+
+from garage_tpu.utils.tracing import (
+    OtlpHttpExporter,
+    Tracer,
+    init_tracing,
+    spans_to_otlp,
+)
+
+pytestmark = pytest.mark.asyncio
+
+
+class _CollectSink:
+    """Minimal exporter stand-in capturing batches in-process."""
+
+    def __init__(self):
+        self.batches = []
+
+    async def export(self, spans, service_instance):
+        self.batches.append(list(spans))
+        return True
+
+
+async def test_span_parenting_and_fresh_traces():
+    tr = Tracer("deadbeef", exporter=_CollectSink())
+    with tr.new_trace("S3 GET", api="s3") as root:
+        with tr.span("Table object get") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            with tr.span("RPC garage/table/object") as g2:
+                assert g2.parent_id == child.span_id
+                assert g2.trace_id == root.trace_id
+    # a new trace gets a fresh id and no parent
+    with tr.new_trace("S3 PUT") as other:
+        assert other.trace_id != root.trace_id
+        assert other.parent_id is None
+    assert root.end_ns >= root.start_ns
+
+
+async def test_span_error_status_and_concurrent_tasks():
+    tr = Tracer("x", exporter=_CollectSink())
+
+    async def one(name):
+        with tr.new_trace(name) as root:
+            await asyncio.sleep(0.01)
+            with tr.span(f"{name}-child") as c:
+                await asyncio.sleep(0.01)
+                return root.trace_id, c.trace_id
+
+    # concurrent tasks must not cross-parent (contextvars are task-local)
+    pairs = await asyncio.gather(one("a"), one("b"))
+    for rid, cid in pairs:
+        assert rid == cid
+    assert pairs[0][0] != pairs[1][0]
+
+    with pytest.raises(ValueError):
+        with tr.span("failing"):
+            raise ValueError("boom")
+    failing = tr._buf[-1]
+    assert failing.error == "ValueError: boom"
+
+
+async def test_otlp_json_shape():
+    tr = Tracer("cafe", exporter=_CollectSink())
+    with tr.span("op", count=3, ratio=0.5, flag=True, name="n"):
+        pass
+    payload = spans_to_otlp(list(tr._buf), "cafe")
+    rs = payload["resourceSpans"][0]
+    attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+    assert attrs["service.name"] == {"stringValue": "garage_tpu"}
+    assert attrs["service.instance.id"] == {"stringValue": "cafe"}
+    span = rs["scopeSpans"][0]["spans"][0]
+    assert len(span["traceId"]) == 32 and len(span["spanId"]) == 16
+    sa = {a["key"]: a["value"] for a in span["attributes"]}
+    assert sa["count"] == {"intValue": "3"}
+    assert sa["ratio"] == {"doubleValue": 0.5}
+    assert sa["flag"] == {"boolValue": True}
+    assert sa["name"] == {"stringValue": "n"}
+    assert span["status"] == {"code": 1}
+    assert int(span["endTimeUnixNano"]) >= int(span["startTimeUnixNano"])
+
+
+async def test_disabled_tracer_is_noop():
+    tr = init_tracing(None, b"\x01" * 32)
+    assert not tr.enabled
+    with tr.new_trace("x") as s:
+        s.set_attr("k", "v")  # must not blow up
+        with tr.span("y"):
+            pass
+    assert len(tr._buf) == 0
+
+
+async def _fake_collector():
+    received = []
+
+    async def traces(request):
+        received.append(await request.json())
+        return web.Response(status=200)
+
+    app = web.Application()
+    app.router.add_post("/v1/traces", traces)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return received, runner, port
+
+
+async def test_exporter_posts_to_collector_and_survives_death():
+    received, runner, port = await _fake_collector()
+    tr = init_tracing(f"http://127.0.0.1:{port}", b"\xab" * 32)
+    assert tr.enabled and tr.service_instance == "ab" * 8
+    with tr.new_trace("S3 GET"):
+        with tr.span("child"):
+            pass
+    await tr.flush()
+    assert len(received) == 1
+    spans = received[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert {s["name"] for s in spans} == {"S3 GET", "child"}
+    assert tr.exported == 2
+
+    # collector dies: spans are dropped after the timeout, node unharmed
+    await runner.cleanup()
+    with tr.span("after-death"):
+        pass
+    await tr.flush()
+    assert tr.dropped >= 1
+    await tr.exporter.close()
+
+
+async def test_api_request_emits_parented_spans(tmp_path):
+    """End-to-end: a signed S3 request against an in-process server with
+    trace_sink configured produces a request root span with table/RPC
+    children in the same trace."""
+    import numpy as np
+
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.api.signature import sign_request
+    from garage_tpu.model import Garage
+    from garage_tpu.rpc.layout import ClusterLayout, NodeRole
+    from garage_tpu.utils.config import config_from_dict
+
+    received, runner, port = await _fake_collector()
+    g = Garage(config_from_dict({
+        "metadata_dir": str(tmp_path / "meta"),
+        "data_dir": str(tmp_path / "data"),
+        "replication_mode": "none",
+        "rpc_bind_addr": "127.0.0.1:0",
+        "rpc_secret": "trace-test",
+        "db_engine": "memory",
+        "bootstrap_peers": [],
+        "admin": {"trace_sink": f"http://127.0.0.1:{port}"},
+    }))
+    assert g.system.tracer.enabled
+    await g.system.netapp.listen("127.0.0.1:0")
+    lay = g.system.layout
+    lay.stage_role(bytes(g.system.id), NodeRole("dc1", 1000))
+    lay.apply_staged_changes()
+    g.system.layout = ClusterLayout.decode(lay.encode())
+    g.system._rebuild_ring()
+
+    helper = g.helper()
+    key = await helper.create_key("trace")
+    key.params().allow_create_bucket.update(True)
+    await g.key_table.insert(key)
+    server = S3ApiServer(g)
+    await server.start("127.0.0.1:0")
+    sport = server.port
+    kid, secret = key.key_id, key.params().secret_key
+
+    import aiohttp
+    import yarl
+
+    async def req(method, path, body=b""):
+        headers = {"host": f"127.0.0.1:{sport}"}
+        headers.update(sign_request(kid, secret, "garage", method, path, [],
+                                    headers, body, path_is_raw=True))
+        async with aiohttp.ClientSession() as s:
+            async with s.request(
+                method, yarl.URL(f"http://127.0.0.1:{sport}{path}",
+                                 encoded=True),
+                data=body, headers=headers,
+            ) as r:
+                return r.status
+
+    assert await req("PUT", "/tracebkt") == 200
+    payload = np.random.default_rng(0).integers(
+        0, 256, 8192, dtype=np.uint8).tobytes()
+    assert await req("PUT", "/tracebkt/obj", payload) == 200
+    assert await req("GET", "/tracebkt/obj") == 200
+
+    await g.system.tracer.flush()
+    spans = []
+    for batch in received:
+        spans.extend(batch["resourceSpans"][0]["scopeSpans"][0]["spans"])
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert "S3 PUT" in by_name and "S3 GET" in by_name
+    # the GET's table/RPC/block children share the request's trace id
+    get_root = [s for s in by_name["S3 GET"]
+                if any(a["key"] == "path" and
+                       a["value"]["stringValue"] == "/tracebkt/obj"
+                       for a in s["attributes"])][0]
+    tid = get_root["traceId"]
+    same_trace = [s for s in spans
+                  if s["traceId"] == tid and s["name"] != "S3 GET"]
+    names = {s["name"] for s in same_trace}
+    assert "Table object get" in names, names
+    assert any(n.startswith("RPC garage/table/object") for n in names), names
+    assert all("parentSpanId" in s for s in same_trace)
+
+    await server.stop()
+    await g.shutdown()
+    await runner.cleanup()
