@@ -144,6 +144,7 @@ class System:
                 self.peering.add_peer(addr, FixedBytes32(nid))
 
         self.node_status: Dict[FixedBytes32, NodeStatus] = {}
+        self._discovery = None  # external (consul/k8s) backends, built lazily
         self._tasks: List[asyncio.Task] = []
         self._stopped = asyncio.Event()
 
@@ -216,10 +217,65 @@ class System:
                 logger.debug("status exchange failed: %s", e)
             await asyncio.sleep(STATUS_EXCHANGE_INTERVAL)
 
+    def _external_discovery(self):
+        """Lazily construct the configured external discovery backends
+        (ref system.rs:336-360: consul/kubernetes are optional features)."""
+        if self._discovery is None:
+            self._discovery = []
+            if self.config.consul_discovery is not None:
+                from .discovery import ConsulDiscovery
+
+                self._discovery.append(
+                    ConsulDiscovery(self.config.consul_discovery)
+                )
+            if self.config.kubernetes_discovery is not None:
+                try:
+                    from .discovery import KubernetesDiscovery
+
+                    k8s = KubernetesDiscovery(
+                        self.config.kubernetes_discovery
+                    )
+                    self._k8s_crd_pending = not (
+                        self.config.kubernetes_discovery.skip_crd
+                    )
+                    self._discovery.append(k8s)
+                except Exception as e:  # not in a pod: log once, disable
+                    logger.warning("kubernetes discovery disabled: %s", e)
+        return self._discovery
+
+    async def _external_discovery_tick(self):
+        """Publish ourselves + learn peers from Consul/Kubernetes (ref
+        system.rs:726-808: runs every discovery tick, errors only warn)."""
+        public = self.config.rpc_public_addr
+        for d in self._external_discovery():
+            # publish and query fail independently (ref system.rs:726-808):
+            # a node with a read-only catalog token must still learn peers
+            try:
+                if getattr(self, "_k8s_crd_pending", False) and hasattr(
+                    d, "ensure_crd"
+                ):
+                    await d.ensure_crd()
+                    self._k8s_crd_pending = False
+                if public:
+                    await d.publish(
+                        bytes(self.id), socket.gethostname(), public
+                    )
+            except Exception as e:
+                logger.warning("discovery publish via %s failed: %s",
+                               type(d).__name__, e)
+            try:
+                for node_id, addr in await d.get_nodes():
+                    if node_id != bytes(self.id):
+                        self.peering.add_peer(addr, FixedBytes32(node_id))
+            except Exception as e:
+                logger.warning("discovery query via %s failed: %s",
+                               type(d).__name__, e)
+
     async def _discovery_loop(self):
         while not self._stopped.is_set():
             for addr in self.config.bootstrap_peers:
                 self.peering.add_peer(addr)
+            await self._external_discovery_tick()
             await self.peering._tick()
             # persist known peers for next restart
             peers = [
@@ -340,5 +396,10 @@ class System:
         self._stopped.set()
         for t in self._tasks:
             t.cancel()
+        for d in (self._discovery or []):
+            try:
+                await d.close()
+            except Exception:
+                pass
         await self.peering.stop()
         await self.netapp.shutdown()

@@ -92,6 +92,29 @@ class CodecConfig:
 
 
 @dataclass
+class ConsulDiscoveryConfig:
+    """[consul_discovery] (ref util/config.rs:185-210, rpc/consul.rs)."""
+    consul_http_addr: str = ""
+    service_name: str = ""
+    api: str = "catalog"            # catalog | agent
+    token: Optional[str] = None
+    tags: List[str] = field(default_factory=list)
+    meta: Dict[str, str] = field(default_factory=dict)
+    ca_cert: Optional[str] = None
+    client_cert: Optional[str] = None
+    client_key: Optional[str] = None
+    tls_skip_verify: bool = False
+
+
+@dataclass
+class KubernetesDiscoveryConfig:
+    """[kubernetes_discovery] (ref util/config.rs, rpc/kubernetes.rs)."""
+    namespace: str = ""
+    service_name: str = ""
+    skip_crd: bool = False
+
+
+@dataclass
 class Config:
     """Top-level config (ref util/config.rs:14-107)."""
     metadata_dir: str = "./meta"
@@ -117,6 +140,8 @@ class Config:
     admin_trace_sink: Optional[str] = None  # OTLP/HTTP collector endpoint
     k2v_api_bind_addr: Optional[str] = None
     codec: CodecConfig = field(default_factory=CodecConfig)
+    consul_discovery: Optional[ConsulDiscoveryConfig] = None
+    kubernetes_discovery: Optional[KubernetesDiscoveryConfig] = None
     # raw parsed TOML for anything not modeled
     raw: Dict[str, Any] = field(default_factory=dict, repr=False)
 
@@ -176,6 +201,28 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
 
     k2v = raw.get("k2v_api", {})
     cfg.k2v_api_bind_addr = k2v.get("api_bind_addr", cfg.k2v_api_bind_addr)
+
+    for section, cls, attr, required in (
+        ("consul_discovery", ConsulDiscoveryConfig, "consul_discovery",
+         ("consul_http_addr", "service_name")),
+        ("kubernetes_discovery", KubernetesDiscoveryConfig,
+         "kubernetes_discovery", ("namespace", "service_name")),
+    ):
+        sec = raw.get(section)
+        if sec is not None:
+            known = {f.name for f in dataclasses.fields(cls)}
+            bad = set(sec) - known
+            if bad:
+                raise ConfigError(f"unknown [{section}] keys: {sorted(bad)}")
+            parsed = cls(**sec)
+            missing = [k for k in required if not getattr(parsed, k)]
+            if missing:
+                raise ConfigError(f"[{section}] requires {missing}")
+            setattr(cfg, attr, parsed)
+    if cfg.consul_discovery is not None and cfg.consul_discovery.api not in (
+        "catalog", "agent"
+    ):
+        raise ConfigError("consul_discovery.api must be catalog|agent")
 
     codec = raw.get("codec", {})
     known = {f.name for f in dataclasses.fields(CodecConfig)}

@@ -1,0 +1,295 @@
+"""Consul / Kubernetes discovery against fake HTTP APIs.
+
+Mirrors ref src/rpc/consul.rs (catalog + agent publication, pubkey in
+service meta) and src/rpc/kubernetes.rs (GarageNode CRD), plus the
+System discovery-loop integration: two nodes that share only a Consul
+catalog must find and connect to each other.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from garage_tpu.rpc.discovery import (
+    META_PREFIX,
+    ConsulDiscovery,
+    KubernetesDiscovery,
+)
+from garage_tpu.utils.config import (
+    ConfigError,
+    ConsulDiscoveryConfig,
+    KubernetesDiscoveryConfig,
+    config_from_dict,
+)
+
+pytestmark = pytest.mark.asyncio
+
+
+async def _serve(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1]
+
+
+class FakeConsul:
+    """Catalog + agent registration endpoints, in-memory service store."""
+
+    def __init__(self):
+        self.services = {}   # service_id -> entry
+        self.agent_calls = 0
+
+    def app(self):
+        app = web.Application()
+        app.router.add_put("/v1/catalog/register", self.catalog_register)
+        app.router.add_put("/v1/agent/service/register", self.agent_register)
+        app.router.add_get(
+            "/v1/catalog/service/{name}", self.catalog_service)
+        return app
+
+    async def catalog_register(self, req):
+        body = await req.json()
+        svc = body["Service"]
+        self.services[svc["ID"]] = {
+            "Address": body["Address"],
+            "ServiceAddress": svc["Address"],
+            "ServicePort": svc["Port"],
+            "ServiceMeta": svc["Meta"],
+            "ServiceName": svc["Service"],
+            "ServiceTags": svc["Tags"],
+        }
+        return web.json_response(True)
+
+    async def agent_register(self, req):
+        self.agent_calls += 1
+        body = await req.json()
+        self.services[body["ID"]] = {
+            "Address": body["Address"],
+            "ServiceAddress": body["Address"],
+            "ServicePort": body["Port"],
+            "ServiceMeta": body["Meta"],
+            "ServiceName": body["Name"],
+            "ServiceTags": body["Tags"],
+        }
+        return web.json_response(True)
+
+    async def catalog_service(self, req):
+        name = req.match_info["name"]
+        return web.json_response([
+            e for e in self.services.values() if e["ServiceName"] == name
+        ])
+
+
+async def test_consul_publish_and_query_roundtrip():
+    consul = FakeConsul()
+    runner, port = await _serve(consul.app())
+    cfg = ConsulDiscoveryConfig(
+        consul_http_addr=f"http://127.0.0.1:{port}",
+        service_name="garage-rpc", tags=["t1"], meta={"x": "y"},
+    )
+    d = ConsulDiscovery(cfg)
+    nid = bytes(range(32))
+    await d.publish(nid, "host-a", "10.0.0.5:3901")
+    nodes = await d.get_nodes()
+    assert nodes == [(nid, "10.0.0.5:3901")]
+    ent = list(consul.services.values())[0]
+    assert ent["ServiceMeta"][f"{META_PREFIX}-pubkey"] == nid.hex()
+    assert ent["ServiceMeta"][f"{META_PREFIX}-hostname"] == "host-a"
+    assert ent["ServiceMeta"]["x"] == "y"
+    assert "advertised-by-garage" in ent["ServiceTags"]
+    assert "t1" in ent["ServiceTags"]
+    # invalid entries are skipped, not fatal
+    consul.services["bad"] = {"ServiceName": "garage-rpc", "Address": "z",
+                              "ServicePort": 1, "ServiceMeta": {}}
+    assert await d.get_nodes() == [(nid, "10.0.0.5:3901")]
+    await d.close()
+    await runner.cleanup()
+
+
+async def test_consul_agent_api():
+    consul = FakeConsul()
+    runner, port = await _serve(consul.app())
+    cfg = ConsulDiscoveryConfig(
+        consul_http_addr=f"http://127.0.0.1:{port}",
+        service_name="garage-rpc", api="agent", token="tkn",
+    )
+    d = ConsulDiscovery(cfg)
+    nid = bytes(reversed(range(32)))
+    await d.publish(nid, "host-b", "10.0.0.6:3901")
+    assert consul.agent_calls == 1
+    assert (await d.get_nodes()) == [(nid, "10.0.0.6:3901")]
+    await d.close()
+    await runner.cleanup()
+
+
+class FakeK8s:
+    """Namespaced GarageNode CRD store + CRD-definition endpoint."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.crd_created = False
+
+    def app(self):
+        base = "/apis/deuxfleurs.fr/v1/namespaces/{ns}/garagenodes"
+        app = web.Application()
+        async def crd_absent(_r):
+            return web.Response(status=404)
+
+        app.router.add_get(
+            "/apis/apiextensions.k8s.io/v1/customresourcedefinitions/{n}",
+            crd_absent)
+        app.router.add_post(
+            "/apis/apiextensions.k8s.io/v1/customresourcedefinitions",
+            self.create_crd)
+        app.router.add_get(base, self.list_nodes)
+        app.router.add_post(base, self.create_node)
+        app.router.add_get(base + "/{name}", self.get_node)
+        app.router.add_put(base + "/{name}", self.replace_node)
+        return app
+
+    async def create_crd(self, req):
+        self.crd_created = True
+        return web.json_response(await req.json(), status=201)
+
+    async def list_nodes(self, req):
+        sel = req.query.get("labelSelector", "")
+        k, _, v = sel.partition("=")
+        items = [n for n in self.nodes.values()
+                 if not sel or n["metadata"].get("labels", {}).get(k) == v]
+        return web.json_response({"items": items})
+
+    async def create_node(self, req):
+        obj = await req.json()
+        obj["metadata"]["resourceVersion"] = "1"
+        self.nodes[obj["metadata"]["name"]] = obj
+        return web.json_response(obj, status=201)
+
+    async def get_node(self, req):
+        n = self.nodes.get(req.match_info["name"])
+        if n is None:
+            return web.Response(status=404)
+        return web.json_response(n)
+
+    async def replace_node(self, req):
+        obj = await req.json()
+        old = self.nodes.get(obj["metadata"]["name"])
+        assert old is not None
+        assert obj["metadata"]["resourceVersion"] == (
+            old["metadata"]["resourceVersion"]
+        )
+        obj["metadata"]["resourceVersion"] = str(
+            int(old["metadata"]["resourceVersion"]) + 1
+        )
+        self.nodes[obj["metadata"]["name"]] = obj
+        return web.json_response(obj)
+
+
+async def test_kubernetes_crd_publish_query():
+    k8s = FakeK8s()
+    runner, port = await _serve(k8s.app())
+    cfg = KubernetesDiscoveryConfig(namespace="storage",
+                                    service_name="garage-rpc")
+    d = KubernetesDiscovery(cfg, api_base=f"http://127.0.0.1:{port}",
+                            token="sa-token")
+    await d.ensure_crd()
+    assert k8s.crd_created
+    nid = bytes(range(32))
+    await d.publish(nid, "pod-a", "10.1.0.7:3901")
+    assert (await d.get_nodes()) == [(nid, "10.1.0.7:3901")]
+    # republish replaces (resourceVersion round-trip, kubernetes.rs:104-110)
+    await d.publish(nid, "pod-a", "10.1.0.8:3901")
+    assert (await d.get_nodes()) == [(nid, "10.1.0.8:3901")]
+    assert len(k8s.nodes) == 1
+    # other services are filtered out by label selector
+    k8s.nodes["ff" * 32] = {
+        "metadata": {"name": "ff" * 32,
+                     "labels": {"garage.deuxfleurs.fr/service": "other"}},
+        "spec": {"address": "10.9.9.9", "port": 1},
+    }
+    assert (await d.get_nodes()) == [(nid, "10.1.0.8:3901")]
+    await d.close()
+    await runner.cleanup()
+
+
+async def test_config_parsing_and_validation():
+    cfg = config_from_dict({
+        "metadata_dir": "/tmp/x", "data_dir": "/tmp/y",
+        "rpc_secret": "s",
+        "consul_discovery": {
+            "consul_http_addr": "http://c:8500", "service_name": "g",
+            "api": "agent", "tags": ["a"],
+        },
+        "kubernetes_discovery": {
+            "namespace": "ns", "service_name": "g", "skip_crd": True,
+        },
+    })
+    assert cfg.consul_discovery.api == "agent"
+    assert cfg.kubernetes_discovery.skip_crd
+    with pytest.raises(ConfigError, match="requires"):
+        config_from_dict({"metadata_dir": "/tmp/x", "data_dir": "/tmp/y",
+                          "rpc_secret": "s",
+                          "consul_discovery": {"service_name": "g"}})
+    with pytest.raises(ConfigError, match="unknown"):
+        config_from_dict({"metadata_dir": "/tmp/x", "data_dir": "/tmp/y",
+                          "rpc_secret": "s",
+                          "kubernetes_discovery": {"namespace": "n",
+                                                   "service_name": "g",
+                                                   "bogus": 1}})
+    with pytest.raises(ConfigError, match="catalog|agent"):
+        config_from_dict({"metadata_dir": "/tmp/x", "data_dir": "/tmp/y",
+                          "rpc_secret": "s",
+                          "consul_discovery": {
+                              "consul_http_addr": "http://c",
+                              "service_name": "g", "api": "bad"}})
+
+
+async def test_system_discovers_peer_via_consul(tmp_path):
+    """Full loop: two Systems with NO bootstrap peers, sharing a fake
+    Consul, find each other through the discovery tick."""
+    from garage_tpu.rpc.system import System
+    from garage_tpu.utils.config import config_from_dict as cfd
+
+    consul = FakeConsul()
+    runner, port = await _serve(consul.app())
+
+    systems = []
+    for name in ("a", "b"):
+        cfg = cfd({
+            "metadata_dir": str(tmp_path / name),
+            "data_dir": str(tmp_path / f"{name}-data"),
+            "replication_mode": "2",
+            "rpc_bind_addr": "127.0.0.1:0",
+            "rpc_secret": "disco",
+            "bootstrap_peers": [],
+            "consul_discovery": {
+                "consul_http_addr": f"http://127.0.0.1:{port}",
+                "service_name": "garage-rpc",
+            },
+        })
+        s = System(cfg)
+        await s.netapp.listen("127.0.0.1:0")
+        # rpc_public_addr is normally static config; fill the bound port in
+        s.config.rpc_public_addr = (
+            f"127.0.0.1:{s.netapp._server.sockets[0].getsockname()[1]}"
+        )
+        systems.append(s)
+
+    a, b = systems
+    await a._external_discovery_tick()   # a registers
+    await b._external_discovery_tick()   # b registers + learns a
+    await b.peering._tick()
+    for _ in range(100):
+        if bytes(a.id) in {bytes(k) for k in b.peering.peers} and \
+           bytes(b.id) in {bytes(k) for k in a.peering.peers}:
+            break
+        await asyncio.sleep(0.05)
+    assert bytes(a.id) in {bytes(k) for k in b.peering.peers}
+    conn = b.netapp.conns.get(a.id)
+    assert conn is not None and not conn._closed
+    for s in systems:
+        for d in s._external_discovery():
+            await d.close()
+        await s.shutdown()
+    await runner.cleanup()
