@@ -23,8 +23,23 @@ from ..common import (
     s3_xml_root,
     xml_to_bytes,
 )
+from ..signature import uri_encode
 
 PAGE = 1000
+
+
+def _encoder(q) -> "tuple":
+    """encoding-type=url support (ref list.rs:881-887 uriencode_maybe +
+    router.rs encoding_type): returns (enc_fn, encoding_type|None).  Keys,
+    prefixes, delimiters and markers in the RESPONSE are uri-encoded
+    (slash included, uri_encode(s, true)) when the client asked for it —
+    how AWS SDKs transport keys with control characters safely."""
+    et = q.get("encoding-type")
+    if et is None:
+        return (lambda v: v), None
+    if et != "url":
+        raise BadRequestError(f"invalid encoding-type: {et!r}")
+    return (lambda v: uri_encode(v, encode_slash=True)), "url"
 
 
 def _after_prefix(p: str) -> str:
@@ -45,6 +60,7 @@ async def _collect(
     max_keys: int,
     marker: Optional[str] = None,
     uploads: bool = False,
+    upload_id_marker: Optional[str] = None,
 ):
     """Enumeration core (ref list.rs).  `pos` = inclusive resume position
     (None → start of prefix); `marker` = last key/prefix already returned
@@ -72,7 +88,20 @@ async def _collect(
                     return entries, prefixes, False, last_returned
                 continue
             if uploads:
-                relevant = [v for v in obj.versions() if v.is_uploading(True)]
+                # uuid order, NOT timestamp order: upload-id-marker
+                # pagination resumes by uuid, so emission order must match
+                relevant = sorted(
+                    (v for v in obj.versions() if v.is_uploading(True)),
+                    key=lambda v: bytes(v.uuid),
+                )
+                if upload_id_marker is not None and k == marker:
+                    # resume INSIDE the marker key: only uploads after the
+                    # one last returned (filtered BEFORE capacity counting,
+                    # or a page could come back empty yet truncated)
+                    relevant = [
+                        v for v in relevant
+                        if bytes(v.uuid).hex() > upload_id_marker
+                    ]
             else:
                 lv = obj.last_data_version()
                 relevant = [lv] if lv is not None else []
@@ -93,11 +122,14 @@ async def _collect(
                     last_returned = ("cp", cp)
                     pos, jumped = _after_prefix(cp), True
                     break
-            if len(entries) + len(prefixes) >= max_keys:
-                return entries, prefixes, True, last_returned
             for v in relevant:
+                # capacity check PER VERSION: a key with many concurrent
+                # uploads must truncate mid-key (resumed via
+                # upload-id-marker), not blow past max_keys
+                if len(entries) + len(prefixes) >= max_keys:
+                    return entries, prefixes, True, last_returned
                 entries.append((k, v))
-            last_returned = ("key", k)
+                last_returned = ("key", k)
         if jumped:
             continue
         if len(batch) < PAGE:
@@ -107,6 +139,7 @@ async def _collect(
 
 async def handle_list_objects(ctx) -> web.Response:
     q = ctx.request.query
+    enc, enc_type = _encoder(q)
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter") or None
     marker = q.get("marker") or None
@@ -118,16 +151,18 @@ async def handle_list_objects(ctx) -> web.Response:
     )
     out = s3_xml_root("ListBucketResult")
     ET.SubElement(out, "Name").text = ctx.bucket_name
-    ET.SubElement(out, "Prefix").text = prefix
+    ET.SubElement(out, "Prefix").text = enc(prefix)
     if marker is not None:
-        ET.SubElement(out, "Marker").text = marker
+        ET.SubElement(out, "Marker").text = enc(marker)
     if delimiter:
-        ET.SubElement(out, "Delimiter").text = delimiter
+        ET.SubElement(out, "Delimiter").text = enc(delimiter)
+    if enc_type:
+        ET.SubElement(out, "EncodingType").text = enc_type
     ET.SubElement(out, "MaxKeys").text = str(max_keys)
     ET.SubElement(out, "IsTruncated").text = "true" if truncated else "false"
     if truncated and last is not None:
-        ET.SubElement(out, "NextMarker").text = last[1]
-    _append_contents(out, entries, prefixes)
+        ET.SubElement(out, "NextMarker").text = enc(last[1])
+    _append_contents(out, entries, prefixes, enc)
     return web.Response(
         status=200, body=xml_to_bytes(out), content_type="application/xml"
     )
@@ -135,6 +170,7 @@ async def handle_list_objects(ctx) -> web.Response:
 
 async def handle_list_objects_v2(ctx) -> web.Response:
     q = ctx.request.query
+    enc, enc_type = _encoder(q)
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter") or None
     max_keys = max(0, min(int_param(q.get("max-keys"), "max-keys", 1000), 1000))
@@ -163,16 +199,18 @@ async def handle_list_objects_v2(ctx) -> web.Response:
     )
     out = s3_xml_root("ListBucketResult")
     ET.SubElement(out, "Name").text = ctx.bucket_name
-    ET.SubElement(out, "Prefix").text = prefix
+    ET.SubElement(out, "Prefix").text = enc(prefix)
     if delimiter:
-        ET.SubElement(out, "Delimiter").text = delimiter
+        ET.SubElement(out, "Delimiter").text = enc(delimiter)
+    if enc_type:
+        ET.SubElement(out, "EncodingType").text = enc_type
     ET.SubElement(out, "MaxKeys").text = str(max_keys)
     ET.SubElement(out, "KeyCount").text = str(len(entries) + len(prefixes))
     ET.SubElement(out, "IsTruncated").text = "true" if truncated else "false"
     if token is not None:
         ET.SubElement(out, "ContinuationToken").text = token
     if start_after is not None:
-        ET.SubElement(out, "StartAfter").text = start_after
+        ET.SubElement(out, "StartAfter").text = enc(start_after)
     if truncated and last is not None:
         # the token records WHAT the last item was (key vs common prefix)
         # so resumption can't conflate a key that merely ends with the
@@ -181,56 +219,78 @@ async def handle_list_objects_v2(ctx) -> web.Response:
         ET.SubElement(out, "NextContinuationToken").text = (
             base64.urlsafe_b64encode(f"{kind}:{value}".encode()).decode()
         )
-    _append_contents(out, entries, prefixes)
+    _append_contents(out, entries, prefixes, enc)
     return web.Response(
         status=200, body=xml_to_bytes(out), content_type="application/xml"
     )
 
 
-def _append_contents(out, entries, prefixes):
+def _append_contents(out, entries, prefixes, enc=lambda v: v):
     for key, v in entries:
         c = ET.SubElement(out, "Contents")
-        ET.SubElement(c, "Key").text = key
+        ET.SubElement(c, "Key").text = enc(key)
         ET.SubElement(c, "LastModified").text = _iso(v.timestamp)
         ET.SubElement(c, "ETag").text = f'"{v.etag()}"'
         ET.SubElement(c, "Size").text = str(v.size())
         ET.SubElement(c, "StorageClass").text = "STANDARD"
     for cp in prefixes:
         p = ET.SubElement(out, "CommonPrefixes")
-        ET.SubElement(p, "Prefix").text = cp
+        ET.SubElement(p, "Prefix").text = enc(cp)
 
 
 async def handle_list_multipart_uploads(ctx) -> web.Response:
     q = ctx.request.query
+    enc, enc_type = _encoder(q)
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter") or None
     max_uploads = max(0, min(int_param(q.get("max-uploads"), "max-uploads", 1000), 1000))
     key_marker = q.get("key-marker") or None
-    pos = (key_marker + "\x00") if key_marker is not None else None
+    upload_id_marker = q.get("upload-id-marker") or None
+
+    # upload-id-marker refines key-marker (ref list.rs:49,208-236): resume
+    # INSIDE the marker key, after the given upload id — without it, two
+    # pages could never split a key with many concurrent uploads
+    if key_marker is not None and upload_id_marker is not None:
+        pos = key_marker  # re-scan the marker key, filter below
+    elif key_marker is not None:
+        pos = key_marker + "\x00"
+    else:
+        pos = None
 
     entries, prefixes, truncated, last = await _collect(
-        ctx, prefix, delimiter, pos, max_uploads, marker=key_marker, uploads=True
+        ctx, prefix, delimiter, pos, max_uploads, marker=key_marker,
+        uploads=True,
+        upload_id_marker=(upload_id_marker if key_marker is not None
+                          else None),
     )
     out = s3_xml_root("ListMultipartUploadsResult")
     ET.SubElement(out, "Bucket").text = ctx.bucket_name
-    ET.SubElement(out, "Prefix").text = prefix
+    ET.SubElement(out, "Prefix").text = enc(prefix)
     if key_marker is not None:
-        ET.SubElement(out, "KeyMarker").text = key_marker
+        ET.SubElement(out, "KeyMarker").text = enc(key_marker)
+    if upload_id_marker is not None:
+        ET.SubElement(out, "UploadIdMarker").text = upload_id_marker
     if delimiter:
-        ET.SubElement(out, "Delimiter").text = delimiter
+        ET.SubElement(out, "Delimiter").text = enc(delimiter)
+    if enc_type:
+        ET.SubElement(out, "EncodingType").text = enc_type
     ET.SubElement(out, "MaxUploads").text = str(max_uploads)
     ET.SubElement(out, "IsTruncated").text = "true" if truncated else "false"
     if truncated and last is not None:
-        ET.SubElement(out, "NextKeyMarker").text = last[1]
+        ET.SubElement(out, "NextKeyMarker").text = enc(last[1])
+        if entries and last[0] == "key" and entries[-1][0] == last[1]:
+            ET.SubElement(out, "NextUploadIdMarker").text = (
+                bytes(entries[-1][1].uuid).hex()
+            )
     for key, v in entries:
         u = ET.SubElement(out, "Upload")
-        ET.SubElement(u, "Key").text = key
+        ET.SubElement(u, "Key").text = enc(key)
         ET.SubElement(u, "UploadId").text = bytes(v.uuid).hex()
         ET.SubElement(u, "Initiated").text = _iso(v.timestamp)
         ET.SubElement(u, "StorageClass").text = "STANDARD"
     for cp in prefixes:
         p = ET.SubElement(out, "CommonPrefixes")
-        ET.SubElement(p, "Prefix").text = cp
+        ET.SubElement(p, "Prefix").text = enc(cp)
     return web.Response(
         status=200, body=xml_to_bytes(out), content_type="application/xml"
     )

@@ -30,6 +30,7 @@ from ...model.s3.object_table import (
 )
 from ...model.s3.version_table import Version
 from ...utils.crdt import now_msec
+from ...utils.async_hash import AsyncHasher, async_block_hash
 from ...utils.data import Hash, block_hash, gen_uuid
 from ..common import ApiError, BadRequestError
 
@@ -110,15 +111,17 @@ async def save_stream(
     chunker = Chunker(stream, garage.config.block_size)
     first = await chunker.next() or b""
 
-    md5 = hashlib.md5()
-    sha256 = hashlib.sha256()
+    # streaming off-thread hashers (ref util/async_hash.rs): the event
+    # loop keeps serving other requests while md5/sha256 advance
+    md5 = AsyncHasher(hashlib.md5())
+    sha256 = AsyncHasher(hashlib.sha256())
 
     # small payload: store inline in the object row (put.rs:84-119)
     if len(first) < INLINE_THRESHOLD and chunker.eof and not chunker.buf:
-        md5.update(first)
-        sha256.update(first)
-        etag = md5.hexdigest()
-        _check_digests(etag, sha256.hexdigest(), content_md5, content_sha256)
+        await md5.update(first)
+        await sha256.update(first)
+        etag = await md5.hexdigest()
+        _check_digests(etag, await sha256.hexdigest(), content_md5, content_sha256)
         await check_quotas(ctx, len(first), key)
         meta = ObjectVersionMeta.new(headers, len(first), etag)
         ov = ObjectVersion(
@@ -151,11 +154,17 @@ async def save_stream(
     await garage.version_table.insert(version)
 
     try:
-        total_size, first_hash = await read_and_put_blocks(
-            ctx, version, 0, first, chunker, md5, sha256
-        )
-        etag = md5.hexdigest()
-        _check_digests(etag, sha256.hexdigest(), content_md5, content_sha256)
+        try:
+            total_size, first_hash = await read_and_put_blocks(
+                ctx, version, 0, first, chunker, md5, sha256
+            )
+            etag = await md5.hexdigest()
+        finally:
+            # error paths must release the hasher threads too
+            await md5.aclose()
+            await sha256.aclose()
+        _check_digests(etag, await sha256.hexdigest(), content_md5,
+                       content_sha256)
         await check_quotas(ctx, total_size, key)
         meta = ObjectVersionMeta.new(headers, total_size, etag)
         ov_done = ObjectVersion(
@@ -202,9 +211,9 @@ async def read_and_put_blocks(
 
     try:
         while block:
-            md5.update(block)
-            sha256.update(block)
-            h = block_hash(block, algo)
+            await md5.update(block)
+            await sha256.update(block)
+            h = await async_block_hash(block, algo)
             if first_hash is None:
                 first_hash = h
             if put_task is not None:

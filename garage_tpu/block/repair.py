@@ -183,6 +183,11 @@ class ScrubWorker(Worker):
         self._last_checkpoint = time.monotonic()
         self._cmd: asyncio.Queue = asyncio.Queue()
         self._wake = asyncio.Event()
+        # read-ahead: next prefix's file contents load while the current
+        # one verifies; checkpoints record the VERIFIED position, not the
+        # iterator's (which runs one prefix ahead)
+        self._ra_task: Optional[asyncio.Task] = None
+        self._verified_pos = self.state.position
 
     def _roots(self) -> List[str]:
         return [d.path for d in self.manager.data_layout.data_dirs]
@@ -209,6 +214,8 @@ class ScrubWorker(Worker):
             if self.iterator is None:
                 self.iterator = BlockStoreIterator(self._roots())
                 st.running, st.paused, st.position, st.corruptions = True, False, 0, 0
+                self._verified_pos = 0
+                self._drop_read_ahead()
         elif cmd == "pause":
             st.paused = True
         elif cmd == "resume":
@@ -216,13 +223,22 @@ class ScrubWorker(Worker):
         elif cmd == "cancel":
             self.iterator = None
             st.running, st.paused, st.position = False, False, 0
+            self._verified_pos = 0
+            self._drop_read_ahead()
         self._checkpoint(force=True)
+
+    def _drop_read_ahead(self) -> None:
+        if self._ra_task is not None:
+            self._ra_task.cancel()
+            self._ra_task = None
 
     def _checkpoint(self, force: bool = False) -> None:
         if self.persister is None:
             return
         if force or time.monotonic() - self._last_checkpoint > CHECKPOINT_INTERVAL:
-            self.state.position = self.iterator.position if self.iterator else 0
+            # resume must re-verify anything not actually verified yet, so
+            # the persisted position trails the (read-ahead) iterator
+            self.state.position = self._verified_pos if self.iterator else 0
             self.persister.save(self.state)
             self._last_checkpoint = time.monotonic()
 
@@ -243,8 +259,12 @@ class ScrubWorker(Worker):
         if st.paused:
             return WorkerState.IDLE
         self.tranquilizer.reset()
-        batch = await asyncio.to_thread(self.iterator.next_prefix)
-        if batch is None:
+        task = self._ra_task or asyncio.ensure_future(self._read_ahead())
+        # clear BEFORE awaiting: if the read fails, the next work() cycle
+        # must retry a fresh read, not re-await the cached exception
+        self._ra_task = None
+        item = await task
+        if item is None:
             # complete
             st.time_last_complete = now_msec()
             st.time_next_run = randomize_next_scrub()
@@ -253,13 +273,33 @@ class ScrubWorker(Worker):
             self._checkpoint(force=True)
             logger.info("scrub complete, %d corruptions found", st.corruptions)
             return WorkerState.BUSY
+        batch, reads, pos_after = item
+        # prefetch the NEXT prefix while this one verifies: disk reads
+        # overlap the codec dispatch (read→batch→device, SURVEY.md §3.4)
+        self._ra_task = asyncio.ensure_future(self._read_ahead())
         status.progress = f"{self.iterator.progress() * 100:.2f}%"
         if batch:
-            await self.scrub_batch(batch)
+            await self.scrub_batch(batch, reads)
+        self._verified_pos = pos_after
         self._checkpoint()
         return await self.tranquilizer.tranquilize_worker(st.tranquility)
 
-    async def scrub_batch(self, batch: List[Tuple[Hash, str, bool]]) -> None:
+    async def _read_ahead(self):
+        """Next prefix's batch + file contents, read off-thread.  Returns
+        (batch, reads, iterator_position_after) or None at end-of-store."""
+        it = self.iterator
+        if it is None:
+            return None
+        batch = await asyncio.to_thread(it.next_prefix)
+        if batch is None:
+            return None
+        reads = await asyncio.gather(
+            *[asyncio.to_thread(_try_read, path) for _h, path, _c in batch]
+        )
+        return batch, list(reads), it.position
+
+    async def scrub_batch(self, batch: List[Tuple[Hash, str, bool]],
+                          reads: Optional[List[Optional[bytes]]] = None) -> None:
         """Verify one batch through the codec; quarantine corrupt blocks.
 
         Plain blocks go through codec.batch_verify (the device dispatch);
@@ -267,9 +307,10 @@ class ScrubWorker(Worker):
         the reference (block.rs:66-78)."""
         mgr = self.manager
         plain_idx, plain_blocks, plain_hashes = [], [], []
-        reads = await asyncio.gather(
-            *[asyncio.to_thread(_try_read, path) for _h, path, _c in batch]
-        )
+        if reads is None:
+            reads = await asyncio.gather(
+                *[asyncio.to_thread(_try_read, path) for _h, path, _c in batch]
+            )
         for i, ((h, path, compressed), raw) in enumerate(zip(batch, reads)):
             if raw is None:
                 continue

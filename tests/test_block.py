@@ -236,8 +236,12 @@ async def test_scrub_checkpoint_and_resume(tmp_path):
     w.send_command("start")
     await w.work()   # applies start (checkpoints), scrubs the first prefix
     w._checkpoint(force=True)
-    pos = w.iterator.position
+    # persisted position = VERIFIED position; the iterator runs one
+    # prefix ahead (read-ahead), so resume re-verifies the in-flight one
+    pos = w.state.position
     assert w.state.running and pos > 0
+    assert w.iterator.position >= pos
+    w._drop_read_ahead()
 
     # "kill -9": drop w without any shutdown; restart from disk
     w2 = ScrubWorker(m, persister=pers)

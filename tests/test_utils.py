@@ -207,3 +207,69 @@ rs_parity = 2
             config_from_dict({"codec": {"backend": "gpu"}})
         with pytest.raises(ConfigError):
             config_from_dict({"codec": {"rs_data": 4, "rs_parity": 0}})
+
+
+def test_async_hasher_matches_hashlib():
+    import asyncio
+    import hashlib
+
+    from garage_tpu.utils.async_hash import AsyncHasher, async_block_hash
+    from garage_tpu.utils.data import block_hash
+
+    async def run():
+        import numpy as np
+
+        rng = np.random.default_rng(5)
+        chunks = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+                  for n in (1, 1000, 65536, 0, 31)]
+        h = AsyncHasher(hashlib.md5())
+        ref = hashlib.md5()
+        for c in chunks:
+            await h.update(c)
+            ref.update(c)
+        assert await h.hexdigest() == ref.hexdigest()
+        # finalize is idempotent; update-after-finalize rejected
+        assert await h.digest() == ref.digest()
+        try:
+            await h.update(b"late")
+            raise AssertionError("update after finalize must fail")
+        except RuntimeError:
+            pass
+        blk = chunks[2]
+        assert bytes(await async_block_hash(blk, "blake2s")) == \
+            bytes(block_hash(blk, "blake2s"))
+
+    asyncio.run(run())
+
+
+def test_async_hasher_lazy_thread_and_close():
+    import asyncio
+    import hashlib
+
+    from garage_tpu.utils.async_hash import AsyncHasher
+
+    async def run():
+        # small updates never spawn a thread (inline path)
+        h = AsyncHasher(hashlib.md5())
+        await h.update(b"tiny")
+        assert h._thread is None
+        assert await h.hexdigest() == hashlib.md5(b"tiny").hexdigest()
+
+        # large update spawns the worker; aclose on an ERROR path joins it
+        big = b"\xab" * (AsyncHasher.INLINE_THRESHOLD + 1)
+        h2 = AsyncHasher(hashlib.sha256())
+        await h2.update(big)
+        t = h2._thread
+        assert t is not None and t.is_alive()
+        await h2.aclose()
+        assert not t.is_alive(), "worker thread leaked after aclose"
+        # digest still correct after close; double-close is a no-op
+        await h2.aclose()
+        assert await h2.digest() == hashlib.sha256(big).digest()
+        # mixed small-then-large: inline prefix carried into the thread
+        h3 = AsyncHasher(hashlib.md5())
+        await h3.update(b"prefix-")
+        await h3.update(big)
+        assert await h3.hexdigest() == hashlib.md5(b"prefix-" + big).hexdigest()
+
+    asyncio.run(run())
