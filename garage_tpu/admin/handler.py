@@ -21,11 +21,15 @@ logger = logging.getLogger("garage_tpu.admin")
 
 
 class AdminRpcHandler:
-    def __init__(self, garage):
+    def __init__(self, garage, register_endpoint: bool = True):
+        """register_endpoint=False: embed the command set without claiming
+        the netapp endpoint (the HTTP admin API reuses these handlers; the
+        daemon's CLI-facing instance owns the endpoint)."""
         self.garage = garage
         self.helper = garage.helper()
-        self.endpoint = garage.system.netapp.endpoint("garage/admin")
-        self.endpoint.set_handler(self._handle)
+        if register_endpoint:
+            self.endpoint = garage.system.netapp.endpoint("garage/admin")
+            self.endpoint.set_handler(self._handle)
 
     async def _handle(self, remote, msg, body):
         cmd = msg.get("cmd")

@@ -23,20 +23,49 @@ class AdminApiServer:
         self.garage = garage
         self.helper = garage.helper()
         self._runner: Optional[web.AppRunner] = None
+        # v1 endpoints share their implementations with the CLI's admin
+        # RPC handler (one semantics for both operator surfaces)
+        from ..admin.handler import AdminRpcHandler
+
+        self._rpc = AdminRpcHandler(garage, register_endpoint=False)
 
     async def start(self, bind_addr: str) -> None:
-        app = web.Application()
+        @web.middleware
+        async def bad_request_guard(request, handler):
+            """Malformed admin requests (missing required query params,
+            invalid JSON bodies) render as 400 JSON, not bare 500s."""
+            try:
+                return await handler(request)
+            except web.HTTPException:
+                raise
+            except (KeyError, ValueError) as e:  # incl. JSONDecodeError
+                return web.json_response(
+                    {"error": f"bad request: {e!r}"}, status=400
+                )
+
+        app = web.Application(middlewares=[bad_request_guard])
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/v1/status", self.handle_status)
         app.router.add_get("/v1/health", self.handle_health_detailed)
+        app.router.add_post("/v1/connect", self.handle_connect)
         app.router.add_post("/v1/layout", self.handle_layout_update)
         app.router.add_get("/v1/layout", self.handle_layout_get)
         app.router.add_post("/v1/layout/apply", self.handle_layout_apply)
-        app.router.add_get("/v1/bucket", self.handle_bucket_list)
+        app.router.add_post("/v1/layout/revert", self.handle_layout_revert)
+        app.router.add_get("/v1/bucket", self.handle_bucket_get)
         app.router.add_post("/v1/bucket", self.handle_bucket_create)
-        app.router.add_get("/v1/key", self.handle_key_list)
-        app.router.add_post("/v1/key", self.handle_key_create)
+        app.router.add_delete("/v1/bucket", self.handle_bucket_delete)
+        app.router.add_put("/v1/bucket", self.handle_bucket_update)
+        app.router.add_post("/v1/bucket/allow", self.handle_bucket_allow)
+        app.router.add_post("/v1/bucket/deny", self.handle_bucket_deny)
+        app.router.add_put("/v1/bucket/alias/global", self.handle_alias_global)
+        app.router.add_delete(
+            "/v1/bucket/alias/global", self.handle_unalias_global)
+        app.router.add_get("/v1/key", self.handle_key_get)
+        app.router.add_post("/v1/key", self.handle_key_post)
+        app.router.add_post("/v1/key/import", self.handle_key_import)
+        app.router.add_delete("/v1/key", self.handle_key_delete)
         app.router.add_get("/check", self.handle_check_domain)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -180,14 +209,7 @@ class AdminApiServer:
 
     async def handle_bucket_list(self, request) -> web.Response:
         self._admin(request)
-        out = []
-        for b in await self.helper.list_buckets():
-            p = b.params()
-            out.append({
-                "id": bytes(b.id).hex(),
-                "globalAliases": [n for n, l in p.aliases.items.items() if l.value],
-            })
-        return web.json_response(out)
+        return await self._rpc_json(self._rpc._cmd_bucket_list, {})
 
     async def handle_bucket_create(self, request) -> web.Response:
         self._admin(request)
@@ -197,10 +219,7 @@ class AdminApiServer:
 
     async def handle_key_list(self, request) -> web.Response:
         self._admin(request)
-        return web.json_response([
-            {"id": k.key_id, "name": k.params().name.value}
-            for k in await self.helper.list_keys()
-        ])
+        return await self._rpc_json(self._rpc._cmd_key_list, {})
 
     async def handle_key_create(self, request) -> web.Response:
         self._admin(request)
@@ -209,6 +228,156 @@ class AdminApiServer:
         return web.json_response({
             "accessKeyId": k.key_id,
             "secretAccessKey": k.params().secret_key,
+        })
+
+    # --- v1 endpoints delegating to the shared admin command set
+    #     (ref api/admin/router_v1.rs:95-131) ---
+
+    async def _rpc_json(self, fn, msg) -> web.Response:
+        """Run one AdminRpcHandler command, render errors as 400 JSON."""
+        try:
+            return web.json_response(await fn(msg))
+        except Exception as e:  # noqa: BLE001 — admin surface: report, 400
+            logger.debug("admin v1 op failed: %s", e)
+            return web.json_response({"error": str(e)}, status=400)
+
+    async def handle_connect(self, request) -> web.Response:
+        self._admin(request)
+        body = json.loads(await request.read())
+        # body = ["<id>@<addr>", ...] (ref ConnectClusterNodes)
+        out = []
+        for spec in body:
+            nid, _, addr = spec.partition("@")
+            try:
+                await self._rpc._cmd_connect({"addr": addr, "node_id": nid})
+                out.append({"success": True, "error": None})
+            except Exception as e:  # noqa: BLE001
+                out.append({"success": False, "error": str(e)})
+        return web.json_response(out)
+
+    async def handle_layout_revert(self, request) -> web.Response:
+        self._admin(request)
+        body = json.loads(await request.read() or b"{}")
+        return await self._rpc_json(
+            self._rpc._cmd_layout_revert, {"version": body.get("version")}
+        )
+
+    async def handle_bucket_get(self, request) -> web.Response:
+        self._admin(request)
+        bid = request.query.get("id")
+        alias = request.query.get("globalAlias")
+        if bid is None and alias is None:
+            return await self.handle_bucket_list(request)
+        return await self._rpc_json(
+            self._rpc._cmd_bucket_info, {"bucket": bid or alias}
+        )
+
+    async def handle_bucket_delete(self, request) -> web.Response:
+        self._admin(request)
+        return await self._rpc_json(
+            self._rpc._cmd_bucket_delete, {"bucket": request.query["id"]}
+        )
+
+    async def handle_bucket_update(self, request) -> web.Response:
+        """UpdateBucket: websiteAccess and/or quotas (ref router_v1 PUT
+        /v1/bucket?id=)."""
+        self._admin(request)
+        bid = request.query["id"]
+        body = json.loads(await request.read() or b"{}")
+        if "websiteAccess" in body:
+            wa = body["websiteAccess"] or {}
+            r = await self._rpc_json(self._rpc._cmd_bucket_website, {
+                "bucket": bid,
+                "allow": bool(wa.get("enabled")),
+                "index_document": wa.get("indexDocument", "index.html"),
+                "error_document": wa.get("errorDocument"),
+            })
+            if r.status != 200:
+                return r
+        if "quotas" in body:
+            q = body["quotas"] or {}
+            r = await self._rpc_json(self._rpc._cmd_bucket_set_quotas, {
+                "bucket": bid,
+                "max_size": q.get("maxSize"),
+                "max_objects": q.get("maxObjects"),
+            })
+            if r.status != 200:
+                return r
+        return await self._rpc_json(self._rpc._cmd_bucket_info,
+                                    {"bucket": bid})
+
+    async def _bucket_perm(self, request, op: str) -> web.Response:
+        self._admin(request)
+        body = json.loads(await request.read())
+        perms = body.get("permissions", {})
+        return await self._rpc_json(
+            getattr(self._rpc, f"_cmd_bucket_{op}"), {
+                "bucket": body["bucketId"],
+                "key": body["accessKeyId"],
+                "read": perms.get("read"),
+                "write": perms.get("write"),
+                "owner": perms.get("owner"),
+            }
+        )
+
+    async def handle_bucket_allow(self, request) -> web.Response:
+        return await self._bucket_perm(request, "allow")
+
+    async def handle_bucket_deny(self, request) -> web.Response:
+        return await self._bucket_perm(request, "deny")
+
+    async def handle_alias_global(self, request) -> web.Response:
+        self._admin(request)
+        return await self._rpc_json(self._rpc._cmd_bucket_alias, {
+            "bucket": request.query["id"], "alias": request.query["alias"],
+        })
+
+    async def handle_unalias_global(self, request) -> web.Response:
+        self._admin(request)
+        return await self._rpc_json(self._rpc._cmd_bucket_unalias, {
+            "alias": request.query["alias"],
+        })
+
+    async def handle_key_get(self, request) -> web.Response:
+        self._admin(request)
+        kid = request.query.get("id")
+        search = request.query.get("search")
+        if kid is None and search is None:
+            return await self.handle_key_list(request)
+        show_secret = request.query.get("showSecretKey") == "true"
+        return await self._rpc_json(self._rpc._cmd_key_info, {
+            "key": kid or search, "show_secret": show_secret,
+        })
+
+    async def handle_key_post(self, request) -> web.Response:
+        """POST /v1/key?id= = UpdateKey; POST /v1/key = CreateKey."""
+        kid = request.query.get("id")
+        if kid is None:
+            return await self.handle_key_create(request)
+        self._admin(request)
+        body = json.loads(await request.read() or b"{}")
+        msg = {"key": kid, "name": body.get("name")}
+        # allow/deny translate to the single tri-state handler field; an
+        # absent directive must leave the flag untouched
+        if (body.get("allow") or {}).get("createBucket"):
+            msg["allow_create_bucket"] = True
+        elif (body.get("deny") or {}).get("createBucket"):
+            msg["allow_create_bucket"] = False
+        return await self._rpc_json(self._rpc._cmd_key_set, msg)
+
+    async def handle_key_import(self, request) -> web.Response:
+        self._admin(request)
+        body = json.loads(await request.read())
+        return await self._rpc_json(self._rpc._cmd_key_import, {
+            "id": body["accessKeyId"],
+            "secret": body["secretAccessKey"],
+            "name": body.get("name", "imported"),
+        })
+
+    async def handle_key_delete(self, request) -> web.Response:
+        self._admin(request)
+        return await self._rpc_json(self._rpc._cmd_key_delete, {
+            "key": request.query["id"],
         })
 
     async def handle_check_domain(self, request) -> web.Response:

@@ -1,0 +1,202 @@
+"""Admin HTTP API v1 surface (ref api/admin/router_v1.rs:95-131).
+
+One in-process node + AdminApiServer; drives every v1 endpoint through
+real HTTP with bearer-token auth.
+"""
+
+import json
+
+import aiohttp
+import pytest
+
+from garage_tpu.api.admin_server import AdminApiServer
+from garage_tpu.model import Garage
+from garage_tpu.rpc.layout import ClusterLayout, NodeRole
+from garage_tpu.utils.config import config_from_dict
+
+pytestmark = pytest.mark.asyncio
+
+TOKEN = "adm1n-t0k3n"
+
+
+async def make_admin(tmp_path):
+    g = Garage(config_from_dict({
+        "metadata_dir": str(tmp_path / "meta"),
+        "data_dir": str(tmp_path / "data"),
+        "replication_mode": "none",
+        "rpc_bind_addr": "127.0.0.1:0",
+        "rpc_secret": "adm",
+        "db_engine": "memory",
+        "bootstrap_peers": [],
+        "admin": {"admin_token": TOKEN},
+    }))
+    await g.system.netapp.listen("127.0.0.1:0")
+    lay = g.system.layout
+    lay.stage_role(bytes(g.system.id), NodeRole("dc1", 1000))
+    lay.apply_staged_changes()
+    g.system.layout = ClusterLayout.decode(lay.encode())
+    g.system._rebuild_ring()
+    srv = AdminApiServer(g)
+    await srv.start("127.0.0.1:0")
+    return g, srv
+
+
+class AdminClient:
+    def __init__(self, port, token=TOKEN):
+        self.base = f"http://127.0.0.1:{port}"
+        self.hdrs = {"Authorization": f"Bearer {token}"} if token else {}
+
+    async def req(self, method, path, body=None, query=None):
+        async with aiohttp.ClientSession() as s:
+            async with s.request(
+                method, self.base + path, params=query or {},
+                data=json.dumps(body) if body is not None else None,
+                headers=self.hdrs,
+            ) as r:
+                txt = await r.text()
+                try:
+                    return r.status, json.loads(txt)
+                except json.JSONDecodeError:
+                    return r.status, txt
+
+
+async def test_admin_v1_full_surface(tmp_path):
+    g, srv = await make_admin(tmp_path)
+    c = AdminClient(srv.port)
+
+    # auth: wrong/missing token is rejected on guarded endpoints
+    bad = AdminClient(srv.port, token="wrong")
+    st, _ = await bad.req("GET", "/v1/status")
+    assert st == 403
+    st, _ = await AdminClient(srv.port, token=None).req("GET", "/v1/bucket")
+    assert st == 403
+
+    # status / health / layout
+    st, status = await c.req("GET", "/v1/status")
+    assert st == 200 and status["layoutVersion"] == 1
+    st, health = await c.req("GET", "/v1/health")
+    assert st == 200 and health["status"] == "healthy"
+    st, layout = await c.req("GET", "/v1/layout")
+    assert st == 200 and len(layout["roles"]) == 1
+
+    # stage a role change, then revert it
+    nid = bytes(g.system.id).hex()
+    st, _ = await c.req("POST", "/v1/layout",
+                        body={"roles": {nid: {"zone": "dc2",
+                                              "capacity": 2000}}})
+    assert st == 200
+    st, layout = await c.req("GET", "/v1/layout")
+    assert layout["stagedRoleChanges"]
+    st, _ = await c.req("POST", "/v1/layout/revert", body={})
+    assert st == 200
+    st, layout = await c.req("GET", "/v1/layout")
+    assert not layout["stagedRoleChanges"]
+
+    # key CRUD + import + update
+    st, key = await c.req("POST", "/v1/key", body={"name": "k1"})
+    assert st == 200 and key["accessKeyId"].startswith("GK")
+    kid = key["accessKeyId"]
+    st, info = await c.req("GET", "/v1/key", query={"id": kid})
+    assert st == 200 and info["name"] == "k1"
+    assert info["secret"] is None  # hidden unless showSecretKey
+    st, info = await c.req("GET", "/v1/key",
+                           query={"id": kid, "showSecretKey": "true"})
+    assert info["secret"] == key["secretAccessKey"]
+    st, _ = await c.req("POST", "/v1/key", body={
+        "name": "k1-renamed", "allow": {"createBucket": True}},
+        query={"id": kid})
+    assert st == 200
+    st, info = await c.req("GET", "/v1/key", query={"id": kid})
+    assert info["name"] == "k1-renamed"
+    assert info["allow_create_bucket"] is True
+    st, imp = await c.req("POST", "/v1/key/import", body={
+        "accessKeyId": "GKimported0123456789abcdef",
+        "secretAccessKey": "s" * 64, "name": "imp"})
+    assert st == 200, imp
+
+    # bucket CRUD + info + update + permissions + aliases
+    st, b = await c.req("POST", "/v1/bucket", body={"globalAlias": "adminbkt"})
+    assert st == 200
+    bid = b["id"]
+    st, lst = await c.req("GET", "/v1/bucket")
+    assert any(x["id"] == bid for x in lst)
+    st, info = await c.req("GET", "/v1/bucket", query={"id": bid})
+    assert st == 200 and info["aliases"] == ["adminbkt"]
+    st, info = await c.req("GET", "/v1/bucket",
+                           query={"globalAlias": "adminbkt"})
+    assert info["id"] == bid
+
+    st, _ = await c.req("POST", "/v1/bucket/allow", body={
+        "bucketId": bid, "accessKeyId": kid,
+        "permissions": {"read": True, "write": True}})
+    assert st == 200
+    st, info = await c.req("GET", "/v1/bucket", query={"id": bid})
+    assert info["keys"][kid] == [True, True, False]
+    st, _ = await c.req("POST", "/v1/bucket/deny", body={
+        "bucketId": bid, "accessKeyId": kid,
+        "permissions": {"write": True}})
+    assert st == 200
+    st, info = await c.req("GET", "/v1/bucket", query={"id": bid})
+    assert info["keys"][kid] == [True, False, False]
+
+    st, upd = await c.req("PUT", "/v1/bucket", body={
+        "websiteAccess": {"enabled": True, "indexDocument": "home.html"},
+        "quotas": {"maxSize": 10_000_000, "maxObjects": 55},
+    }, query={"id": bid})
+    assert st == 200
+    assert upd["website"]["index_document"] == "home.html"
+    assert upd["quotas"]["max_objects"] == 55
+
+    st, _ = await c.req("PUT", "/v1/bucket/alias/global",
+                        query={"id": bid, "alias": "second-name"})
+    assert st == 200
+    st, info = await c.req("GET", "/v1/bucket", query={"id": bid})
+    assert sorted(info["aliases"]) == ["adminbkt", "second-name"]
+    st, _ = await c.req("DELETE", "/v1/bucket/alias/global",
+                        query={"alias": "second-name"})
+    assert st == 200
+
+    # malformed requests → 400 JSON (middleware), not 500
+    st, err = await c.req("DELETE", "/v1/bucket")   # missing ?id=
+    assert st == 400 and "error" in err
+    st, err = await c.req("POST", "/v1/bucket/allow", body={"permissions": {}})
+    assert st == 400 and "error" in err
+
+    # deleting a non-empty-looking bucket id that doesn't exist errors 400
+    st, err = await c.req("DELETE", "/v1/bucket", query={"id": "ff" * 16})
+    assert st == 400 and "error" in err
+
+    # key delete
+    st, _ = await c.req("DELETE", "/v1/key", query={"id": kid})
+    assert st == 200
+    st, err = await c.req("GET", "/v1/key", query={"id": kid})
+    assert st == 400
+
+    # bucket delete (must be empty — it is)
+    st, _ = await c.req("DELETE", "/v1/bucket", query={"id": bid})
+    assert st == 200
+    st, err = await c.req("GET", "/v1/bucket", query={"id": bid})
+    assert st == 400
+
+    await srv.stop()
+    await g.shutdown()
+
+
+async def test_admin_connect_endpoint(tmp_path):
+    g1, srv1 = await make_admin(tmp_path / "a")
+    g2, srv2 = await make_admin(tmp_path / "b")
+    c = AdminClient(srv1.port)
+    port2 = g2.system.netapp._server.sockets[0].getsockname()[1]
+    nid2 = bytes(g2.system.id).hex()
+    st, res = await c.req("POST", "/v1/connect",
+                          body=[f"{nid2}@127.0.0.1:{port2}"])
+    assert st == 200 and res[0]["success"], res
+    assert g2.system.id in g1.system.netapp.conns
+    # failure is reported per-entry, not as a 500
+    st, res = await c.req("POST", "/v1/connect",
+                          body=["00" * 32 + "@127.0.0.1:1"])
+    assert st == 200 and not res[0]["success"]
+    await srv1.stop()
+    await srv2.stop()
+    await g1.shutdown()
+    await g2.shutdown()
