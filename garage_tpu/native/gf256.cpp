@@ -14,6 +14,10 @@
 #include <cstdint>
 #include <cstring>
 
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
 static uint8_t GF_EXP[512];
 static int16_t GF_LOG[256];
 
@@ -37,13 +41,105 @@ static inline uint8_t gf_mul(uint8_t a, uint8_t b) {
   return GF_EXP[GF_LOG[a] + GF_LOG[b]];
 }
 
+#if defined(__x86_64__)
+// --- AVX2 split-nibble path (the ISA-L technique) -------------------------
+//
+// gfmul(c, x) = T_lo[x & 0xF] ^ T_hi[x >> 4] where T_lo[v] = gfmul(c, v)
+// and T_hi[v] = gfmul(c, v<<4) — each table is 16 bytes, exactly one
+// VPSHUFB operand.  32 input bytes per two shuffles + ors/xors; the
+// column-chunked loop keeps the k source rows of the active chunk in L1
+// while every output row consumes them.
+
+__attribute__((target("avx2"))) static void gf_matmul_avx2(
+    const uint8_t* mat, const uint8_t* nib_tables, const uint8_t* shards,
+    uint8_t* out, int64_t batch, int64_t r, int64_t k, int64_t s) {
+  const __m256i lo_mask = _mm256_set1_epi8(0x0F);
+  int64_t svec = s & ~int64_t(31);
+#pragma omp parallel for schedule(static)
+  for (int64_t b = 0; b < batch; b++) {
+    const uint8_t* in_b = shards + b * k * s;
+    uint8_t* out_b = out + b * r * s;
+    // column-vector outer loop: each output row accumulates in ONE ymm
+    // register across all k inputs, stored once — dst carries no
+    // read-modify-write traffic (`out` is zero-initialized by contract,
+    // so the accumulator starts empty)
+    for (int64_t v = 0; v < svec; v += 32) {
+      for (int64_t i = 0; i < r; i++) {
+        __m256i acc = _mm256_setzero_si256();
+        for (int64_t j = 0; j < k; j++) {
+          uint8_t coef = mat[i * k + j];
+          if (coef == 0) continue;
+          __m256i x =
+              _mm256_loadu_si256((const __m256i*)(in_b + j * s + v));
+          if (coef == 1) {
+            acc = _mm256_xor_si256(acc, x);
+            continue;
+          }
+          const uint8_t* nt = nib_tables + (i * k + j) * 32;
+          __m256i tlo = _mm256_broadcastsi128_si256(
+              _mm_loadu_si128((const __m128i*)nt));
+          __m256i thi = _mm256_broadcastsi128_si256(
+              _mm_loadu_si128((const __m128i*)(nt + 16)));
+          __m256i xl = _mm256_and_si256(x, lo_mask);
+          __m256i xh = _mm256_and_si256(_mm256_srli_epi16(x, 4), lo_mask);
+          acc = _mm256_xor_si256(
+              acc, _mm256_xor_si256(_mm256_shuffle_epi8(tlo, xl),
+                                    _mm256_shuffle_epi8(thi, xh)));
+        }
+        _mm256_storeu_si256((__m256i*)(out_b + i * s + v), acc);
+      }
+    }
+    // scalar tail for the last s % 32 columns
+    for (int64_t v = svec; v < s; v++) {
+      for (int64_t i = 0; i < r; i++) {
+        uint8_t acc = 0;
+        for (int64_t j = 0; j < k; j++) {
+          if (mat[i * k + j] == 0) continue;
+          const uint8_t* nt = nib_tables + (i * k + j) * 32;
+          uint8_t x = in_b[j * s + v];
+          acc ^= (uint8_t)(nt[x & 0x0F] ^ nt[16 + (x >> 4)]);
+        }
+        out_b[i * s + v] = acc;
+      }
+    }
+  }
+}
+
+static bool have_avx2() {
+  return __builtin_cpu_supports("avx2");
+}
+#endif  // __x86_64__
+
 extern "C" {
 
-// out (B, r, S) ^= mat (r, k) * shards (B, k, S) over GF(2^8).
-// `out` must be zero-initialized by the caller.
+// out (B, r, S) = mat (r, k) * shards (B, k, S) over GF(2^8).
+// `out` MUST be zero-initialized by the caller — under that contract the
+// scalar path (which XOR-accumulates into out) and the AVX2 path (which
+// overwrites it) are equivalent; passing a pre-populated buffer is NOT
+// supported and would give machine-dependent results.
 void gf_matmul_blocks(const uint8_t* mat, const uint8_t* shards, uint8_t* out,
                       int64_t batch, int64_t r, int64_t k, int64_t s) {
   init_tables();
+#if defined(__x86_64__)
+  if (have_avx2()) {
+    // per-(i,j) nibble tables: 16 low-nibble products + 16 high-nibble
+    // products (the two VPSHUFB operands)
+    uint8_t* nib = new uint8_t[r * k * 32];
+    for (int64_t i = 0; i < r; i++) {
+      for (int64_t j = 0; j < k; j++) {
+        uint8_t c = mat[i * k + j];
+        uint8_t* t = nib + (i * k + j) * 32;
+        for (int v = 0; v < 16; v++) {
+          t[v] = gf_mul(c, (uint8_t)v);
+          t[16 + v] = gf_mul(c, (uint8_t)(v << 4));
+        }
+      }
+    }
+    gf_matmul_avx2(mat, nib, shards, out, batch, r, k, s);
+    delete[] nib;
+    return;
+  }
+#endif
   // Precompute per-(i,j) multiplication tables: r*k*256 bytes.
   uint8_t* tables = new uint8_t[r * k * 256];
   for (int64_t i = 0; i < r; i++) {

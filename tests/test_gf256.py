@@ -103,9 +103,20 @@ class TestNative:
         if native_gf_matmul_blocks is None:
             pytest.skip("native kernel not built")
         rng = np.random.default_rng(6)
-        k, m, s = 8, 4, 1024
+        k, m = 8, 4
         pm = gf256.rs_parity_matrix(k, m)
-        data = rng.integers(0, 256, (5, k, s)).astype(np.uint8)
+        # shard sizes straddling the SIMD width: full vectors, scalar tail
+        # (s % 32), sub-vector-only, and single byte
+        for s in (1024, 1023, 1056, 37, 31, 1):
+            data = rng.integers(0, 256, (5, k, s)).astype(np.uint8)
+            assert np.array_equal(
+                native_gf_matmul_blocks(pm, data),
+                gf256.gf_matmul_blocks(pm, data),
+            ), s
+        # decode matrices exercise different coefficient patterns (incl. 1s)
+        dec = gf256.rs_decode_matrix(k, m, [0, 2, 3, 5, 6, 8, 9, 11])
+        data = rng.integers(0, 256, (3, k, 777)).astype(np.uint8)
         assert np.array_equal(
-            native_gf_matmul_blocks(pm, data), gf256.gf_matmul_blocks(pm, data)
+            native_gf_matmul_blocks(dec, data),
+            gf256.gf_matmul_blocks(dec, data),
         )
