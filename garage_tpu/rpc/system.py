@@ -124,6 +124,28 @@ class System:
         self.rpc = RpcHelper(self.netapp, self.peering, metrics=self.metrics,
                              tracer=self.tracer)
 
+        # node disk gauges, observed at scrape time (ref
+        # rpc/system_metrics.rs:77 statvfs-fed data/meta avail gauges);
+        # one statvfs sweep serves all four gauges per scrape, and an
+        # UNKNOWN value raises so the sample is omitted (Gauge.render
+        # swallows observer errors) rather than read as a full disk
+        self._disk_cache: tuple = (0.0, {})
+
+        def _disk(key):
+            def observe():
+                return float(self._disk_stats()[key])  # KeyError → omitted
+            return observe
+
+        self.metrics.gauge("cluster_local_data_avail_bytes",
+                           "Free bytes on the data disk", fn=_disk("data_avail"))
+        self.metrics.gauge("cluster_local_data_total_bytes",
+                           "Size of the data disk", fn=_disk("data_total"))
+        self.metrics.gauge("cluster_local_meta_avail_bytes",
+                           "Free bytes on the metadata disk",
+                           fn=_disk("meta_avail"))
+        self.metrics.gauge("cluster_local_meta_total_bytes",
+                           "Size of the metadata disk", fn=_disk("meta_total"))
+
         self._layout_persister: Persister = Persister(
             config.metadata_dir, "cluster_layout", ClusterLayout
         )
@@ -207,6 +229,28 @@ class System:
         except OSError:
             pass
         return st
+
+    def _disk_stats(self) -> dict:
+        """statvfs snapshot for the disk gauges, cached briefly so one
+        scrape's four gauges share a single sweep.  Missing keys mean
+        'unknown' — callers let the KeyError propagate."""
+        now = time.monotonic()
+        ts, vals = self._disk_cache
+        if vals and now - ts < 1.0:
+            return vals
+        vals = {}
+        try:
+            sv = os.statvfs(self.config.metadata_dir)
+            vals["meta_avail"] = sv.f_bavail * sv.f_frsize
+            vals["meta_total"] = sv.f_blocks * sv.f_frsize
+            if self.config.data_dir:
+                sv = os.statvfs(self.config.data_dir[0]["path"])
+                vals["data_avail"] = sv.f_bavail * sv.f_frsize
+                vals["data_total"] = sv.f_blocks * sv.f_frsize
+        except OSError:
+            pass
+        self._disk_cache = (now, vals)
+        return vals
 
     async def _status_exchange_loop(self):
         while not self._stopped.is_set():

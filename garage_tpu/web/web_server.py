@@ -16,6 +16,7 @@ from aiohttp import web
 
 from ..api.common import host_to_bucket
 from ..api.s3.bucket_config import apply_cors_headers, find_matching_cors_rule
+from ..utils.metrics import maybe_time
 
 logger = logging.getLogger("garage_tpu.web")
 
@@ -28,6 +29,18 @@ class WebServer:
         self._runner: Optional[web.AppRunner] = None
         self.request_counter = 0
         self.error_counter = 0
+        # own metric family slice (ref web/web_server.rs:43-67): rides the
+        # shared api_* families with api="web" labels
+        m = getattr(garage.system, "metrics", None)
+        if m is not None:
+            self._m_requests = m.counter(
+                "api_request_counter", "API requests received")
+            self._m_errors = m.counter(
+                "api_error_counter", "API requests answered with an error")
+            self._m_duration = m.histogram(
+                "api_request_duration_seconds", "API request latency")
+        else:
+            self._m_requests = self._m_errors = self._m_duration = None
 
     async def start(self, bind_addr: str) -> None:
         app = web.Application()
@@ -49,19 +62,29 @@ class WebServer:
 
     async def handle_request(self, request: web.Request) -> web.StreamResponse:
         self.request_counter += 1
+        if self._m_requests is not None:
+            self._m_requests.inc(api="web")
         host = request.headers.get("Host", "")
         bucket_name = host_to_bucket(host, self.root_domain) or host.split(":")[0]
-        try:
-            return await self._serve(request, bucket_name)
-        except web.HTTPException:
-            raise
-        except ConnectionError as e:  # incl. ConnectionResetError
-            logger.debug("client disconnected mid-request: %s", e)
-            raise
-        except Exception:
-            self.error_counter += 1
-            logger.exception("web request failed")
-            return web.Response(status=500, text="internal error")
+        with maybe_time(self._m_duration, api="web"):
+            try:
+                resp = await self._serve(request, bucket_name)
+            except web.HTTPException:
+                raise
+            except ConnectionError as e:  # incl. ConnectionResetError
+                logger.debug("client disconnected mid-request: %s", e)
+                raise
+            except Exception:
+                logger.exception("web request failed")
+                resp = web.Response(status=500, text="internal error")
+            # EVERY error response counts, with its status (ref
+            # web/web_server.rs:43-67 error_counter by status_code) — a
+            # 100%-404 outage must be visible on the dashboard
+            if resp.status >= 400:
+                self.error_counter += 1
+                if self._m_errors is not None:
+                    self._m_errors.inc(api="web", status=str(resp.status))
+            return resp
 
     async def _serve(self, request, bucket_name: str) -> web.StreamResponse:
         bid = await self.helper.resolve_global_bucket_name(bucket_name)
