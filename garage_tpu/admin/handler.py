@@ -14,7 +14,8 @@ from typing import Any, Dict, List, Optional
 
 from ..model.permission import BucketKeyPerm
 from ..rpc.layout import NodeRole
-from ..utils.data import Uuid
+from ..utils.crdt import now_msec
+from ..utils.data import Hash, Uuid
 from ..utils.error import GarageError
 
 logger = logging.getLogger("garage_tpu.admin")
@@ -329,6 +330,156 @@ class AdminRpcHandler:
     async def _cmd_worker_set_var(self, msg) -> str:
         self.garage.bg_vars.set(msg["var"], msg["value"])
         return "set"
+
+    # --- block operations (ref garage/admin/block.rs) -----------------------
+
+    def _parse_block_hash(self, hx: str) -> Hash:
+        try:
+            b = bytes.fromhex(hx)
+        except ValueError:
+            raise GarageError(f"invalid block hash {hx!r}")
+        if len(b) != 32:
+            raise GarageError(f"invalid block hash {hx!r}")
+        return Hash(b)
+
+    async def _cmd_block_list_errors(self, msg) -> List[Dict]:
+        """Blocks currently in resync error backoff (ref block.rs:14-25)."""
+        from ..block.resync import ErrorCounter
+
+        resync = self.garage.block_manager.resync
+        out = []
+        k = b""
+        while True:
+            nxt = resync.errors.tree.get_gt(k)
+            if nxt is None:
+                break
+            k, v = nxt
+            ec = ErrorCounter.parse(v)
+            out.append({
+                "hash": k.hex(),
+                "errors": ec.errors,
+                "last_try_secs_ago": max(
+                    0, (now_msec() - ec.last_try) // 1000),
+                "next_try_in_secs": max(
+                    0, (ec.next_try() - now_msec()) // 1000),
+            })
+        return out
+
+    async def _cmd_block_info(self, msg) -> Dict:
+        """Refcount + referencing versions/objects/uploads of one block
+        (ref block.rs:27-61)."""
+        g = self.garage
+        h = self._parse_block_hash(msg["hash"])
+        rc = g.block_manager.rc.get(h)
+        found = g.block_manager.find_block(h)
+        refs = await g.block_ref_table.get_range(h, limit=10000)
+        versions = []
+        for br in refs:
+            v = await g.version_table.get(br.version, "")
+            if v is None:
+                versions.append({"version": bytes(br.version).hex(),
+                                 "deleted": br.deleted.value})
+                continue
+            ent = {
+                "version": bytes(br.version).hex(),
+                "deleted": v.deleted.value,
+                "bucket_id": bytes(v.bucket_id).hex() if v.bucket_id else None,
+                "key": v.key,
+            }
+            if v.mpu_upload_id:
+                ent["upload_id"] = bytes(v.mpu_upload_id).hex()
+            versions.append(ent)
+        return {
+            "hash": bytes(h).hex(),
+            "refcount": rc.count,
+            "deletable": rc.is_deletable(),
+            "present": found is not None,
+            "path": found[0] if found else None,
+            "versions": versions,
+        }
+
+    async def _cmd_block_retry_now(self, msg) -> str:
+        """Clear backoff + requeue errored blocks (ref block.rs:63-93)."""
+        resync = self.garage.block_manager.resync
+        if msg.get("all"):
+            if msg.get("blocks"):
+                raise GarageError("--all cannot be combined with hashes")
+            hashes = [e["hash"] for e in await self._cmd_block_list_errors({})]
+        else:
+            hashes = msg.get("blocks") or []
+        for hx in hashes:
+            h = self._parse_block_hash(hx)
+            resync.clear_backoff(h)
+            resync.put_to_resync(h, 0.0)
+        return f"{len(hashes)} blocks returned in queue for a retry now"
+
+    async def _cmd_block_purge(self, msg) -> str:
+        """Drop every version/object/upload referencing the given blocks —
+        LOSES DATA; the last resort for an unrecoverable block
+        (ref block.rs:95-193)."""
+        if not msg.get("yes"):
+            raise GarageError("pass --yes to confirm the purge operation")
+        from ..model.s3.object_table import (
+            Object,
+            ObjectVersion,
+            ObjectVersionData,
+        )
+        from ..model.s3.version_table import Version
+        from ..utils.data import gen_uuid
+
+        g = self.garage
+        obj_dels = ver_dels = mpu_dels = 0
+        for hx in msg.get("blocks") or []:
+            h = self._parse_block_hash(hx)
+            refs = await g.block_ref_table.get_range(h, limit=10000)
+            for br in refs:
+                v = await g.version_table.get(br.version, "")
+                if v is None:
+                    continue
+                bucket_id, key, ov_id = v.bucket_id, v.key, v.uuid
+                if v.mpu_upload_id:
+                    mpu = await g.mpu_table.get(v.mpu_upload_id, "")
+                    if mpu is not None:
+                        if not mpu.deleted.value:
+                            mpu.deleted.set()
+                            mpu.parts = {}
+                            await g.mpu_table.insert(mpu)
+                            mpu_dels += 1
+                        bucket_id, key, ov_id = (
+                            mpu.bucket_id, mpu.key, mpu.upload_id)
+                    else:
+                        # MPU row lost (the inconsistency purge exists to
+                        # clean up): no object to delete-mark, but the
+                        # version tombstone below MUST still happen or the
+                        # block_ref survives (ref block.rs:115-135)
+                        bucket_id = None
+                obj = (await g.object_table.get(bucket_id, key)
+                       if bucket_id is not None else None)
+                if obj is not None:
+                    complete = [ov for ov in obj.versions()
+                                if ov.is_complete()]
+                    if complete and complete[-1].uuid == ov_id:
+                        # newest complete version holds the bad block:
+                        # supersede it with a delete marker
+                        dv = ObjectVersion(
+                            gen_uuid(), complete[-1].timestamp + 1,
+                            ["complete", ObjectVersionData.delete_marker()],
+                        )
+                        await g.object_table.insert(
+                            Object(bucket_id, key, [dv]))
+                        obj_dels += 1
+                if not v.deleted.value:
+                    await g.version_table.insert(
+                        Version.new(v.uuid, v.bucket_id or b"", v.key,
+                                    deleted=True)
+                        if not v.mpu_upload_id else
+                        Version(v.uuid, v.bucket_id, v.key, deleted=True,
+                                mpu_upload_id=v.mpu_upload_id)
+                    )
+                    ver_dels += 1
+        return (f"purged {len(msg.get('blocks') or [])} blocks: "
+                f"{ver_dels} versions, {obj_dels} objects, "
+                f"{mpu_dels} uploads deleted")
 
     async def _cmd_launch_repair(self, msg) -> str:
         what = msg.get("what", "tables")

@@ -137,6 +137,23 @@ def _build_parser() -> argparse.ArgumentParser:
     wst = ws.add_parser("set")
     wst.add_argument("var")
     wst.add_argument("value")
+
+    pb = sub.add_parser(
+        "block", help="block-level operations (ref garage/admin/block.rs)")
+    bls = pb.add_subparsers(dest="block_cmd", required=True)
+    bls.add_parser("list-errors", help="blocks in resync error backoff")
+    bi = bls.add_parser("info", help="refcount + referencing versions")
+    bi.add_argument("hash")
+    brt = bls.add_parser("retry-now", help="clear backoff and requeue")
+    brt.add_argument("hashes", nargs="*")
+    brt.add_argument("--all", action="store_true",
+                     help="retry every errored block")
+    bp = bls.add_parser(
+        "purge",
+        help="DELETE every object/version referencing these blocks "
+             "(unrecoverable-block last resort)")
+    bp.add_argument("hashes", nargs="+")
+    bp.add_argument("--yes", action="store_true")
     return p
 
 
@@ -419,6 +436,33 @@ async def _amain(args) -> None:
                 pass
             print(await client.call({
                 "cmd": "worker_set_var", "var": args.var, "value": v,
+            }))
+        return
+
+    if args.command == "block":
+        bc = args.block_cmd
+        if bc == "list-errors":
+            rows = ["HASH\tERRORS\tLAST TRY\tNEXT TRY"]
+            for e in await client.call({"cmd": "block_list_errors"}):
+                # full hash: this listing feeds retry-now/purge arguments
+                rows.append(
+                    f"{e['hash']}\t{e['errors']}"
+                    f"\t{e['last_try_secs_ago']}s ago"
+                    f"\tin {e['next_try_in_secs']}s"
+                )
+            print(format_table(rows))
+        elif bc == "info":
+            print(json.dumps(await client.call(
+                {"cmd": "block_info", "hash": args.hash}), indent=2))
+        elif bc == "retry-now":
+            print(await client.call({
+                "cmd": "block_retry_now", "all": args.all,
+                "blocks": args.hashes,
+            }))
+        elif bc == "purge":
+            print(await client.call({
+                "cmd": "block_purge", "yes": args.yes,
+                "blocks": args.hashes,
             }))
         return
 

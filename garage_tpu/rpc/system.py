@@ -218,22 +218,21 @@ class System:
             layout_version=self.layout.version,
             layout_staging_hash=bytes(self.layout.staging_hash()),
         )
-        try:
-            sv = os.statvfs(self.config.metadata_dir)
-            st.meta_avail = sv.f_bavail * sv.f_frsize
-            st.meta_total = sv.f_blocks * sv.f_frsize
-            if self.config.data_dir:
-                sv = os.statvfs(self.config.data_dir[0]["path"])
-                st.data_avail = sv.f_bavail * sv.f_frsize
-                st.data_total = sv.f_blocks * sv.f_frsize
-        except OSError:
-            pass
+        disk = self._disk_stats()
+        st.meta_avail = disk.get("meta_avail")
+        st.meta_total = disk.get("meta_total")
+        st.data_avail = disk.get("data_avail")
+        st.data_total = disk.get("data_total")
         return st
 
     def _disk_stats(self) -> dict:
-        """statvfs snapshot for the disk gauges, cached briefly so one
-        scrape's four gauges share a single sweep.  Missing keys mean
-        'unknown' — callers let the KeyError propagate."""
+        """statvfs snapshot shared by the Prometheus gauges AND the
+        gossiped NodeStatus (one implementation — they must not diverge),
+        cached briefly so one scrape's four gauges do a single sweep.
+        Multi-data-dir nodes sum avail/total across DISTINCT filesystems
+        (ref rpc/system.rs update_disk_usage dedups by fsid).  Missing
+        keys mean 'unknown' — gauge observers let the KeyError propagate
+        so the sample is omitted."""
         now = time.monotonic()
         ts, vals = self._disk_cache
         if vals and now - ts < 1.0:
@@ -243,12 +242,26 @@ class System:
             sv = os.statvfs(self.config.metadata_dir)
             vals["meta_avail"] = sv.f_bavail * sv.f_frsize
             vals["meta_total"] = sv.f_blocks * sv.f_frsize
-            if self.config.data_dir:
-                sv = os.statvfs(self.config.data_dir[0]["path"])
-                vals["data_avail"] = sv.f_bavail * sv.f_frsize
-                vals["data_total"] = sv.f_blocks * sv.f_frsize
         except OSError:
             pass
+        if self.config.data_dir:
+            avail = total = 0
+            seen_fs = set()
+            ok = False
+            for d in self.config.data_dir:
+                try:
+                    sv = os.statvfs(d["path"])
+                except OSError:
+                    continue
+                ok = True
+                if sv.f_fsid in seen_fs:
+                    continue  # same filesystem mounted twice: count once
+                seen_fs.add(sv.f_fsid)
+                avail += sv.f_bavail * sv.f_frsize
+                total += sv.f_blocks * sv.f_frsize
+            if ok:
+                vals["data_avail"] = avail
+                vals["data_total"] = total
         self._disk_cache = (now, vals)
         return vals
 

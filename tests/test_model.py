@@ -390,3 +390,63 @@ async def test_offline_counter_recount_fixes_drift(tmp_path):
     t = await wait_totals(4)
     assert t.get(OBJECTS) == 4 and t.get(BYTES) == 100
     await shutdown(garages)
+
+
+async def test_admin_block_ops(tmp_path):
+    """Block-level admin ops (ref garage/admin/block.rs): list-errors,
+    info (refcount + referencing versions), retry-now, purge."""
+    from garage_tpu.admin.handler import AdminRpcHandler
+
+    garages = await make_garage_cluster(tmp_path, n=1, mode="1")
+    g = garages[0]
+    g.spawn_workers()
+    adm = AdminRpcHandler(g, register_endpoint=False)
+
+    bucket_id = gen_uuid()
+    data = b"admin block ops payload"
+    bh = blake2s_sum(data)
+    from garage_tpu.block.block import DataBlock
+
+    await g.block_manager.write_block(Hash(bh), DataBlock.plain(data))
+    vu = gen_uuid()
+    ver = Version.new(vu, bytes(bucket_id), "purgeme")
+    ver.add_block(0, 0, bytes(bh), len(data))
+    await g.version_table.insert(ver)
+    obj = Object(bucket_id, "purgeme", [complete_version(vu, 100, b"x")])
+    await g.object_table.insert(obj)
+    # wait for the block_ref hook
+    for _ in range(80):
+        if g.block_manager.rc.get(Hash(bh)).is_needed():
+            break
+        await asyncio.sleep(0.05)
+
+    # info: refcount + the referencing version with its backlink
+    info = await adm._cmd_block_info({"hash": bytes(bh).hex()})
+    assert info["refcount"] == 1 and info["present"]
+    assert info["versions"][0]["key"] == "purgeme"
+
+    # error queue: inject one, list it, retry it
+    g.block_manager.resync.put_to_resync(Hash(bh), 0.0)
+    from garage_tpu.block.resync import ErrorCounter
+
+    g.block_manager.resync.errors.insert(
+        bytes(bh), ErrorCounter(3, 1).serialize())
+    errs = await adm._cmd_block_list_errors({})
+    assert len(errs) == 1 and errs[0]["errors"] == 3
+    out = await adm._cmd_block_retry_now({"all": True})
+    assert out.startswith("1 blocks")
+    assert await adm._cmd_block_list_errors({}) == []
+
+    # purge requires --yes, then tombstones version + writes delete marker
+    from garage_tpu.utils.error import GarageError
+
+    with pytest.raises(GarageError, match="--yes"):
+        await adm._cmd_block_purge({"blocks": [bytes(bh).hex()]})
+    out = await adm._cmd_block_purge(
+        {"yes": True, "blocks": [bytes(bh).hex()]})
+    assert "1 versions" in out and "1 objects" in out, out
+    v2 = await g.version_table.get(vu, "")
+    assert v2.deleted.value
+    o2 = await g.object_table.get(bucket_id, "purgeme")
+    assert o2.last_data_version() is None  # delete marker on top
+    await shutdown(garages)
