@@ -34,7 +34,6 @@ from ..common import (
     s3_xml_root,
     xml_to_bytes,
 )
-from ...utils.async_hash import AsyncHasher
 from .put import Chunker, check_quotas, headers_from_request, read_and_put_blocks
 
 
@@ -118,23 +117,18 @@ async def handle_upload_part(ctx) -> web.Response:
     )
     await garage.version_table.insert(version)
 
-    md5 = AsyncHasher(hashlib.md5())
-    sha256 = AsyncHasher(hashlib.sha256())
+    md5 = hashlib.md5()
+    sha256 = hashlib.sha256()
     chunker = Chunker(ctx.body_stream(), garage.config.block_size)
     first = await chunker.next() or b""
-    try:
-        total_size, _fh = await read_and_put_blocks(
-            ctx, version, part_number, first, chunker, md5, sha256
-        )
-    finally:
-        # leave the part unfinished on error (abort/lifecycle will reap
-        # it) but always release the hasher threads
-        await md5.aclose()
-        await sha256.aclose()
-    etag = await md5.hexdigest()
+    # on error the part is left unfinished; abort/lifecycle reaps it
+    total_size, _fh = await read_and_put_blocks(
+        ctx, version, part_number, first, chunker, md5, sha256
+    )
+    etag = md5.hexdigest()
     content_sha256 = ctx.verified.content_sha256
     if content_sha256 not in (None, "STREAMING") and \
-            content_sha256 != await sha256.hexdigest():
+            content_sha256 != sha256.hexdigest():
         raise ApiError("x-amz-content-sha256 mismatch", status=400, code="BadDigest")
 
     mpu.parts[(part_number, ts)] = MpuPart.new(bytes(part_version_uuid), etag, total_size)

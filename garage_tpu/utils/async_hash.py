@@ -13,6 +13,14 @@ stalls behind it.  Off-thread, the loop keeps multiplexing while the
 hash runs.  The bounded feed queue (capacity 1, like the reference's
 mpsc::channel(1)) backpressures the producer so a slow hasher can't
 buffer the whole body in RAM.
+
+Usage note: the S3 put path intentionally does NOT use AsyncHasher —
+measured on this host, the dedicated thread pair costs ~2 ms per
+request in spawns, so `read_and_put_blocks` advances md5+sha256+content
+hash in one combined `asyncio.to_thread` hop per block (first block
+inline: single-block objects are the p50 latency case).  AsyncHasher
+remains for callers that need true streaming overlap of several
+digests over one pass.
 """
 
 from __future__ import annotations
