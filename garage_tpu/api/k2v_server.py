@@ -304,10 +304,16 @@ class K2VApiServer:
         pk = sq.get("partitionKey")
         if pk is None:
             raise BadRequestError("search missing partitionKey")
-        limit = min(int(sq.get("limit") or 1000), 1000)
+        limit = max(1, min(int(sq.get("limit") or 1000), 1000))
         start = sq.get("start")
         end = sq.get("end")
         prefix = sq.get("prefix")
+        if start is None and prefix is not None:
+            # seed the scan at the prefix (ref batch.rs start.unwrap_or
+            # (prefix)): scanning from the partition head and post-
+            # filtering would return an empty not-truncated page when the
+            # first window holds no matching keys
+            start = prefix
         single = sq.get("singleItem", False)
         conflicts_only = sq.get("conflictsOnly", False)
         tombstones = sq.get("tombstones", False)
@@ -316,16 +322,42 @@ class K2VApiServer:
             item = await self._get_item(bucket_id, pk, start or "")
             items = [item] if item is not None else []
         else:
-            filt = "conflicts_only" if conflicts_only else ("any" if tombstones else None)
-            items = await self.garage.k2v_item_table.get_range(
-                (bytes(bucket_id), pk), start, filter=filt, limit=limit + 1,
+            # ALWAYS range-read with filter="any" and filter AFTER the
+            # quorum merge: a liveness filter pushed to the replicas makes
+            # a node that already holds a tombstone return nothing while a
+            # lagging node returns the stale live value — the merge then
+            # RESURRECTS deleted items (the reference's ItemFilter is
+            # applied post-merge for the same reason, k2v/batch.rs:171).
+            # Pagination stays raw-entry-based (nextStart may be a
+            # tombstone), so pages can carry fewer visible items; clients
+            # follow `more`/nextStart as usual.
+            raw = await self.garage.k2v_item_table.get_range(
+                (bytes(bucket_id), pk), start, filter="any", limit=limit + 1,
             )
             if prefix:
-                items = [i for i in items if i.sort_key_str.startswith(prefix)]
+                raw = [i for i in raw if i.sort_key_str.startswith(prefix)]
             if end is not None:
-                items = [i for i in items if i.sort_key_str < end]
-        truncated = len(items) > limit
-        items = items[:limit]
+                raw = [i for i in raw if i.sort_key_str < end]
+            # pagination over RAW entries (a tombstone-heavy page must
+            # still report more/nextStart or clients stop early)
+            truncated = len(raw) > limit
+            raw = raw[:limit]
+            if conflicts_only:
+                items = [i for i in raw if len(i.values()) > 1]
+            elif tombstones:
+                items = raw
+            else:
+                items = [i for i in raw if i.live_values()]
+            return self._search_result(pk, prefix, start, end, limit,
+                                       single, items, truncated,
+                                       raw[-1].sort_key_str if truncated
+                                       else None)
+        return self._search_result(pk, prefix, start, end, limit, single,
+                                   items, False, None)
+
+    @staticmethod
+    def _search_result(pk, prefix, start, end, limit, single, items, more,
+                       next_start) -> dict:
         return {
             "partitionKey": pk,
             "prefix": prefix,
@@ -344,8 +376,8 @@ class K2VApiServer:
                 }
                 for i in items
             ],
-            "more": truncated,
-            "nextStart": items[-1].sort_key_str if truncated else None,
+            "more": more,
+            "nextStart": next_start,
         }
 
     async def delete_batch(self, bucket_id, request) -> web.Response:
@@ -370,23 +402,43 @@ class K2VApiServer:
                     n = 1
                 out.append({"partitionKey": pk, "singleItem": True, "deletedItems": n})
             else:
-                items = await self.garage.k2v_item_table.get_range(
-                    (bytes(bucket_id), pk), dq.get("start"), filter=None,
-                    limit=1000,
-                )
+                # Walk the WHOLE range (the reference reads it unbounded,
+                # batch.rs:209-220) in raw pages: filter="any" + post-merge
+                # liveness so a lagging replica can't resurrect deleted
+                # items (see _search), and only LIVE items are tombstoned —
+                # re-killing tombstones would make deletedItems never
+                # converge to zero.  Each page's kills go out as ONE
+                # batched insert (a sequential per-item quorum insert makes
+                # a 1000-item range delete take minutes).
                 end = dq.get("end")
                 prefix = dq.get("prefix")
+                start = dq.get("start")
                 n = 0
-                for i in items:
-                    if prefix and not i.sort_key_str.startswith(prefix):
-                        continue
-                    if end is not None and i.sort_key_str >= end:
-                        continue
-                    await self.garage.k2v_rpc.insert(
-                        bucket_id, pk, i.sort_key_str, i.causal_context(), None
+                while True:
+                    items = await self.garage.k2v_item_table.get_range(
+                        (bytes(bucket_id), pk), start, filter="any",
+                        limit=1000,
                     )
-                    n += 1
-                out.append({"partitionKey": pk, "singleItem": False, "deletedItems": n})
+                    doomed = [
+                        (pk, i.sort_key_str, i.causal_context(), None)
+                        for i in items
+                        if i.live_values()
+                        and not (prefix
+                                 and not i.sort_key_str.startswith(prefix))
+                        and not (end is not None and i.sort_key_str >= end)
+                    ]
+                    if doomed:
+                        await self.garage.k2v_rpc.insert_many(
+                            bucket_id, doomed)
+                        n += len(doomed)
+                    if len(items) < 1000:
+                        break
+                    last = items[-1].sort_key_str
+                    if end is not None and last >= end:
+                        break
+                    start = last + "\x00"
+                out.append({"partitionKey": pk, "singleItem": False,
+                            "deletedItems": n})
         return web.json_response(out)
 
     # --- poll range (ref api/k2v/range.rs + k2v/seen.rs) ---

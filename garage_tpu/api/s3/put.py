@@ -209,8 +209,12 @@ async def read_and_put_blocks(
             # util/async_hash.rs semantics at a third of the hops; a
             # dedicated AsyncHasher thread pair costs ~2 ms/request in
             # spawns, measured)
-            if offset == 0 and chunker.eof and not chunker.buf:
-                # truly single-block body — nothing follows to overlap with
+            if (offset == 0 and chunker.eof and not chunker.buf
+                    and len(block) <= (1 << 20)):
+                # truly single-block body — nothing follows to overlap
+                # with, and ≤1 MiB bounds the inline loop stall to the
+                # few ms that measurably beat the executor hop; larger
+                # single blocks (big block_size configs) stay off-loop
                 h = _hash_block(md5, sha256, block, algo)
             else:
                 h = await asyncio.to_thread(

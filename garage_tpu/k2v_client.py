@@ -160,8 +160,8 @@ class K2VClient:
     async def read_index(self, start: Optional[str] = None,
                          end: Optional[str] = None,
                          prefix: Optional[str] = None,
-                         limit: int = 1000) -> Dict[str, Any]:
-        q = [("limit", str(limit))]
+                         limit: Optional[int] = 1000) -> Dict[str, Any]:
+        q = [("limit", str(limit))] if limit is not None else []
         for name, v in (("start", start), ("end", end), ("prefix", prefix)):
             if v is not None:
                 q.append((name, v))
@@ -225,3 +225,173 @@ class K2VClient:
         if st != 200:
             raise K2VError(st, body.decode(errors="replace"))
         return json.loads(body)
+
+    # --- range convenience wrappers (ref k2v-cli ReadRange/DeleteRange:
+    #     single-query ReadBatch/DeleteBatch) ---
+
+    async def read_range(self, pk: str, start: Optional[str] = None,
+                         end: Optional[str] = None,
+                         prefix: Optional[str] = None,
+                         limit: Optional[int] = None) -> Dict[str, Any]:
+        q: Dict[str, Any] = {"partitionKey": pk}
+        if start:
+            q["start"] = start
+        if end:
+            q["end"] = end
+        if prefix:
+            q["prefix"] = prefix
+        if limit:
+            q["limit"] = limit
+        return (await self.read_batch([q]))[0]
+
+    async def delete_range(self, pk: str, start: Optional[str] = None,
+                           end: Optional[str] = None,
+                           prefix: Optional[str] = None) -> Dict[str, Any]:
+        q: Dict[str, Any] = {"partitionKey": pk}
+        if start:
+            q["start"] = start
+        if end:
+            q["end"] = end
+        if prefix:
+            q["prefix"] = prefix
+        # one call: the server walks the whole range internally (the
+        # reference's DeleteRange contract)
+        return (await self.delete_batch([q]))[0]
+
+
+# --- CLI (equivalent of the reference's k2v-cli binary,
+#     src/k2v-client/bin/k2v-cli.rs: Insert/Read/Delete/PollItem/
+#     PollRange/ReadIndex/ReadRange/DeleteRange) ---
+
+
+def _cli_parser():
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m garage_tpu.k2v_client",
+        description="K2V command-line client (ref k2v-cli)",
+    )
+    p.add_argument("--endpoint", required=True, help="http://host:port")
+    p.add_argument("--bucket", required=True)
+    p.add_argument("--key-id", required=True)
+    p.add_argument("--secret", required=True)
+    p.add_argument("--region", default="garage")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ins = sub.add_parser("insert")
+    ins.add_argument("partition_key")
+    ins.add_argument("sort_key")
+    ins.add_argument("value", help="literal value, or @file, or - for stdin")
+    ins.add_argument("--token", default=None, help="causality token")
+
+    rd = sub.add_parser("read")
+    rd.add_argument("partition_key")
+    rd.add_argument("sort_key")
+
+    de = sub.add_parser("delete")
+    de.add_argument("partition_key")
+    de.add_argument("sort_key")
+    de.add_argument("--token", default=None)
+
+    pi = sub.add_parser("poll-item")
+    pi.add_argument("partition_key")
+    pi.add_argument("sort_key")
+    pi.add_argument("token")
+    pi.add_argument("--timeout", type=float, default=300.0)
+
+    pr = sub.add_parser("poll-range")
+    pr.add_argument("partition_key")
+    pr.add_argument("--seen-marker", default=None)
+    pr.add_argument("--prefix", default=None)
+    pr.add_argument("--timeout", type=float, default=300.0)
+
+    ri = sub.add_parser("read-index")
+    ri.add_argument("--start", default=None)
+    ri.add_argument("--end", default=None)
+    ri.add_argument("--limit", type=int, default=None)
+
+    rr = sub.add_parser("read-range")
+    rr.add_argument("partition_key")
+    rr.add_argument("--start", default=None)
+    rr.add_argument("--end", default=None)
+    rr.add_argument("--prefix", default=None)
+    rr.add_argument("--limit", type=int, default=None)
+
+    dr = sub.add_parser("delete-range")
+    dr.add_argument("partition_key")
+    dr.add_argument("--start", default=None)
+    dr.add_argument("--end", default=None)
+    dr.add_argument("--prefix", default=None)
+    return p
+
+
+async def _cli_main(args) -> None:
+    import sys
+
+    c = K2VClient(args.endpoint, args.bucket, args.key_id, args.secret,
+                  region=args.region)
+    if args.cmd == "insert":
+        v = args.value
+        if v == "-":
+            data = sys.stdin.buffer.read()
+        elif v.startswith("@"):
+            with open(v[1:], "rb") as f:
+                data = f.read()
+        else:
+            data = v.encode()
+        await c.insert_item(args.partition_key, args.sort_key, data,
+                            token=args.token)
+        print("ok")
+    elif args.cmd == "read":
+        item = await c.read_item(args.partition_key, args.sort_key)
+        if item is None:
+            print("(not found)")
+            return
+        print(f"causality token: {item.token}")
+        for v in item.values:
+            print("(tombstone)" if v is None else v.decode(errors="replace"))
+    elif args.cmd == "delete":
+        tok = args.token
+        if tok is None:
+            item = await c.read_item(args.partition_key, args.sort_key)
+            if item is None:
+                print("(not found)")
+                return
+            tok = item.token
+        await c.delete_item(args.partition_key, args.sort_key, token=tok)
+        print("deleted")
+    elif args.cmd == "poll-item":
+        item = await c.poll_item(args.partition_key, args.sort_key,
+                                 args.token, timeout=args.timeout)
+        if item is None:
+            print("(timeout, no new value)")
+        else:
+            print(f"causality token: {item.token}")
+            for v in item.values:
+                print("(tombstone)" if v is None else
+                      v.decode(errors="replace"))
+    elif args.cmd == "poll-range":
+        res = await c.poll_range(args.partition_key,
+                                 seen_marker=args.seen_marker,
+                                 prefix=args.prefix, timeout=args.timeout)
+        print(json.dumps(res if res is not None
+                         else {"timeout": True}, indent=2))
+    elif args.cmd == "read-index":
+        res = await c.read_index(start=args.start, end=args.end,
+                                 limit=args.limit)
+        print(json.dumps(res, indent=2))
+    elif args.cmd == "read-range":
+        res = await c.read_range(args.partition_key, start=args.start,
+                                 end=args.end, prefix=args.prefix,
+                                 limit=args.limit)
+        print(json.dumps(res, indent=2))
+    elif args.cmd == "delete-range":
+        res = await c.delete_range(args.partition_key, start=args.start,
+                                   end=args.end, prefix=args.prefix)
+        print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised via subprocess
+    import asyncio as _asyncio
+
+    _asyncio.run(_cli_main(_cli_parser().parse_args()))

@@ -143,9 +143,25 @@ class K2VRpcHandler:
         results = await asyncio.gather(
             *[send(n, b) for n, b in per_node.items()], return_exceptions=True
         )
-        errs = [r for r in results if isinstance(r, Exception)]
-        if errs:
-            raise GarageError(f"k2v insert_many partial failure: {errs}")
+        # a node's whole batch failing (routed node down) falls back to
+        # per-item inserts, which walk the remaining replicas — one dead
+        # primary must not fail the batch
+        retry = []
+        for (node, batch), res in zip(per_node.items(), results):
+            if isinstance(res, Exception):
+                retry.extend(batch)
+        if retry:
+            errs = []
+            for pk, sk, ct_ser, v in retry:
+                try:
+                    await self.insert(
+                        bucket_id, pk, sk,
+                        CausalContext.parse(ct_ser) if ct_ser else None, v,
+                    )
+                except Exception as e:  # noqa: BLE001 — collected below
+                    errs.append(str(e))
+            if errs:
+                raise GarageError(f"k2v insert_many partial failure: {errs}")
 
     async def poll_item(
         self,

@@ -10,6 +10,7 @@ then workers with their 8s deadline, then the RPC system, then the DB).
 from __future__ import annotations
 
 import asyncio
+import faulthandler
 import logging
 import signal
 from typing import Optional
@@ -70,6 +71,8 @@ class Server:
 
 
 async def run_server(config_path: str) -> None:
+    # SIGUSR1 → dump all thread stacks to stderr (live-debug a stuck node)
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     config = read_config(config_path)
     server = Server(config)
     await server.start()

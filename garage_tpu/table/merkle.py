@@ -15,6 +15,7 @@ PARTITION_BITS=8 partitions, ring.py; the reference packs a u16).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Any, List, Optional, Tuple
 
@@ -223,6 +224,16 @@ class MerkleWorker(Worker):
 
     async def work(self) -> WorkerState:
         st = self.status()
+        # The whole batch runs OFF the event loop (ref merkle.rs:303-340
+        # uses spawn_blocking for the same reason): after a bulk insert the
+        # todo backlog is thousands of items and the runner re-calls work()
+        # continuously while BUSY — hashing them on the loop thread starves
+        # every foreground request on a small host for the duration.
+        processed = await asyncio.to_thread(self._work_batch)
+        st.queue_length = self.data.merkle_todo_len()
+        return WorkerState.BUSY if processed else WorkerState.IDLE
+
+    def _work_batch(self) -> int:
         processed = 0
         cursor = b""
         while processed < self.BATCH:
@@ -237,8 +248,7 @@ class MerkleWorker(Worker):
             self.updater.update_item(key)
             cursor = key
             processed += 1
-        st.queue_length = self.data.merkle_todo_len()
-        return WorkerState.BUSY if processed else WorkerState.IDLE
+        return processed
 
     async def wait_for_work(self) -> None:
         self.data.merkle_todo_notify.clear()

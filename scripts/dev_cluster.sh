@@ -33,6 +33,9 @@ admin_token = "dev-admin-token"
 [s3_web]
 bind_addr = "127.0.0.1:39${i}2"
 root_domain = ".web.garage.localhost"
+
+[k2v_api]
+api_bind_addr = "127.0.0.1:39${i}4"
 EOF
   python -m garage_tpu -c "$d/garage.toml" server &
   echo "node$i pid $!"
