@@ -209,18 +209,28 @@ async def test_api_request_emits_parented_spans(tmp_path):
     for s in spans:
         by_name.setdefault(s["name"], []).append(s)
     assert "S3 PUT" in by_name and "S3 GET" in by_name
-    # the GET's table/RPC/block children share the request's trace id
-    get_root = [s for s in by_name["S3 GET"]
-                if any(a["key"] == "path" and
-                       a["value"]["stringValue"] == "/tracebkt/obj"
-                       for a in s["attributes"])][0]
-    tid = get_root["traceId"]
-    same_trace = [s for s in spans
-                  if s["traceId"] == tid and s["name"] != "S3 GET"]
-    names = {s["name"] for s in same_trace}
-    assert "Table object get" in names, names
-    assert any(n.startswith("RPC garage/table/object") for n in names), names
-    assert all("parentSpanId" in s for s in same_trace)
+    # the GET's table/RPC/block children share the request's trace id;
+    # under load a client retry can produce an extra root with no
+    # children, so ANY matching root carrying the full child set passes
+    get_roots = [s for s in by_name["S3 GET"]
+                 if any(a["key"] == "path" and
+                        a["value"]["stringValue"] == "/tracebkt/obj"
+                        for a in s["attributes"])]
+    assert get_roots
+    ok = False
+    for root in get_roots:
+        same_trace = [s for s in spans
+                      if s["traceId"] == root["traceId"]
+                      and s["name"] != "S3 GET"]
+        names = {s["name"] for s in same_trace}
+        if ("Table object get" in names
+                and any(n.startswith("RPC garage/table/object")
+                        for n in names)
+                and all("parentSpanId" in s for s in same_trace)):
+            ok = True
+            break
+    assert ok, [ {s["name"] for s in spans
+                  if s["traceId"] == r["traceId"]} for r in get_roots ]
 
     await server.stop()
     await g.shutdown()
