@@ -332,3 +332,32 @@ async def test_streaming_get_midstream_failover(tmp_path):
             got.extend(chunk)
         assert bytes(got) == payload
         await shutdown(systems)
+
+
+async def test_scrub_with_hybrid_codec(tmp_path):
+    """The production scrub worker runs with codec backend='hybrid'
+    (config-selected): corruption detection works identically while the
+    work-stealing engine splits batches between CPU and the device
+    backend (JAX CPU platform here)."""
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    from garage_tpu.ops import make_codec
+
+    m.codec = make_codec("hybrid", rs_data=4, rs_parity=2,
+                         hybrid_group_blocks=8)
+    datas = [os.urandom(20_000) for _ in range(24)]
+    hashes = [blake2s_sum(d) for d in datas]
+    for h, d in zip(hashes, datas):
+        await m.write_block(h, DataBlock.plain(d))
+    for h in hashes[:2]:
+        path, _ = m.find_block(h)
+        with open(path, "r+b") as f:
+            f.seek(5)
+            f.write(b"\xba\xad")
+    scrub = ScrubWorker(m)
+    scrub.send_command("start")
+    while (await scrub.work()).name in ("BUSY", "THROTTLED"):
+        pass
+    assert scrub.state.corruptions == 2
+    assert sum(1 for h in hashes if m.is_block_present(h)) == 22
+    await shutdown(systems)
