@@ -511,6 +511,9 @@ class AdminRpcHandler:
         if what == "block_refs":
             n = await self._repair_block_refs()
             return f"block_ref repair: {n} orphans reaped"
+        if what == "mpu":
+            n = await self._repair_mpu()
+            return f"mpu repair: {n} orphans reaped"
         raise GarageError(f"unknown repair {what!r}")
 
     async def _repair_versions(self) -> int:
@@ -561,6 +564,34 @@ class AdminRpcHandler:
                 await g.block_ref_table.insert(
                     BlockRef(br.block, br.version, deleted=True)
                 )
+                n += 1
+        return n
+
+    async def _repair_mpu(self) -> int:
+        """Tombstone multipart uploads whose object row no longer carries
+        the matching Uploading{multipart} version — repropagates object
+        deletions to the MPU table (ref repair/online.rs RepairMpu)."""
+        from ..model.s3.mpu_table import MultipartUpload
+        from ..utils.data import Uuid
+
+        g = self.garage
+        n = 0
+        data = g.mpu_table.data
+        for _k, raw in list(data.store.items(b"", None)):
+            mpu = data.decode_entry(raw)
+            if mpu.deleted.value:
+                continue
+            obj = await g.object_table.get(Uuid(mpu.bucket_id), mpu.key)
+            ok = obj is not None and any(
+                bytes(ov.uuid) == bytes(mpu.upload_id)
+                and ov.is_uploading(check_multipart=True)
+                for ov in obj.versions()
+            )
+            if not ok:
+                await g.mpu_table.insert(MultipartUpload(
+                    mpu.upload_id, mpu.timestamp, mpu.bucket_id, mpu.key,
+                    deleted=True,
+                ))
                 n += 1
         return n
 

@@ -134,6 +134,44 @@ def int_param(value, name: str, default: Optional[int] = None) -> Optional[int]:
         )
 
 
+def client_addr(request) -> str:
+    """Advertised client address for logs/spans (ref
+    util/forwarded_headers.rs handle_forwarded_for_headers +
+    generic_server.rs:172-177): when X-Forwarded-For holds exactly one
+    valid IP literal it is used, like the reference does; anything else
+    (absent, hostname, list) falls back to the TCP peer address.  The
+    header is client-controlled, so spans record the TCP peer TOO
+    (request_trace below) — a spoofed header can't erase the real peer
+    from the audit trail."""
+    import ipaddress
+
+    xff = request.headers.get("X-Forwarded-For")
+    if xff is not None:
+        try:
+            return str(ipaddress.ip_address(xff.strip()))
+        except ValueError:
+            pass
+    return request.remote or ""
+
+
+def request_trace(tracer, title: str, api: str, request):
+    """Per-request trace root shared by the S3/K2V/Web servers (ref
+    api/generic_server.rs:187-200 creates one span per request with a
+    fresh trace id).  Records method/path, the TCP peer, and the
+    forwarded client address when it differs.  No-op when tracing is
+    off."""
+    attrs = {
+        "api": api,
+        "method": request.method,
+        "path": request.path,
+        "peer": request.remote or "",
+    }
+    fwd = client_addr(request)
+    if fwd != attrs["peer"]:
+        attrs["forwarded_for"] = fwd
+    return tracer.new_trace(f"{title} {request.method}", **attrs)
+
+
 def host_to_bucket(host: str, root_domain: Optional[str]) -> Optional[str]:
     """vhost-style bucket extraction (ref helpers.rs host_to_bucket):
     `bucket.root_domain` → bucket; bare root_domain or unrelated host →

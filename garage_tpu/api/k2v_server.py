@@ -33,6 +33,7 @@ from .common import (
     NoSuchKeyError,
     error_xml,
     int_param,
+    request_trace,
 )
 from .signature import check_signature, raw_query_pairs
 
@@ -67,6 +68,14 @@ class K2VApiServer:
             await self._runner.cleanup()
 
     async def handle_request(self, request: web.Request) -> web.StreamResponse:
+        trace = request_trace(
+            self.garage.system.tracer, "K2V", "k2v", request)
+        with trace:
+            resp = await self._handle_with_errors(request)
+            trace.set_attr("status", resp.status)
+            return resp
+
+    async def _handle_with_errors(self, request) -> web.StreamResponse:
         try:
             return await self._handle(request)
         except (ApiError, NoSuchBucket, NoSuchKey) as e:

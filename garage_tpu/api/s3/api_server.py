@@ -34,6 +34,7 @@ from ..common import (
     error_xml,
     host_to_bucket,
     parse_bucket_key,
+    request_trace,
 )
 from ..signature import (
     AuthError,
@@ -102,10 +103,7 @@ class S3ApiServer:
         # spans (table ops, quorum RPCs, block IO) parent under it via the
         # context variable.  new_trace is a shared no-op when tracing is
         # off (set_attr included).
-        trace = self.garage.system.tracer.new_trace(
-            f"S3 {request.method}", api="s3", method=request.method,
-            path=request.path,
-        )
+        trace = request_trace(self.garage.system.tracer, "S3", "s3", request)
         with trace, maybe_time(self._m and self._m["duration"], api="s3"):
             resp = await self._handle_with_errors(request)
             trace.set_attr("status", resp.status)

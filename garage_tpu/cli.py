@@ -124,7 +124,8 @@ def _build_parser() -> argparse.ArgumentParser:
 
     pr = sub.add_parser("repair", help="launch repair operations")
     pr.add_argument("what", choices=[
-        "tables", "blocks", "versions", "block_refs", "rebalance", "scrub",
+        "tables", "blocks", "versions", "block_refs", "mpu", "rebalance",
+        "scrub",
     ])
     pr.add_argument("--cmd", default="start",
                     choices=["start", "pause", "resume", "cancel"])

@@ -14,7 +14,7 @@ from typing import Optional
 
 from aiohttp import web
 
-from ..api.common import host_to_bucket
+from ..api.common import host_to_bucket, request_trace
 from ..api.s3.bucket_config import apply_cors_headers, find_matching_cors_rule
 from ..utils.metrics import maybe_time
 
@@ -66,7 +66,9 @@ class WebServer:
             self._m_requests.inc(api="web")
         host = request.headers.get("Host", "")
         bucket_name = host_to_bucket(host, self.root_domain) or host.split(":")[0]
-        with maybe_time(self._m_duration, api="web"):
+        trace = request_trace(
+            self.garage.system.tracer, "Web", "web", request)
+        with trace, maybe_time(self._m_duration, api="web"):
             try:
                 resp = await self._serve(request, bucket_name)
             except web.HTTPException:
@@ -84,6 +86,7 @@ class WebServer:
                 self.error_counter += 1
                 if self._m_errors is not None:
                     self._m_errors.inc(api="web", status=str(resp.status))
+            trace.set_attr("status", resp.status)
             return resp
 
     async def _serve(self, request, bucket_name: str) -> web.StreamResponse:

@@ -239,3 +239,84 @@ async def test_admin_http_api(cluster):
             assert "table_size{" in body
             assert "block_resync_queue_length" in body
             assert "api_request_counter" in body
+
+
+async def test_gateway_get_survives_storage_node_kill(tmp_path):
+    """Daemon-level mid-download failover (VERDICT r1 item 7 done-criteria):
+    a gateway node streams a multi-block GetObject from storage replicas;
+    the storage node actually serving is SIGKILLed mid-transfer and the
+    client still receives the full, correct body (stream failover in
+    rpc_get_block_streaming + ref manager.rs:231-345)."""
+    import json as _json
+
+    from test_s3_api import S3Client  # noqa: F811 (sys.path'd above)
+    from garage_tpu.api.signature import sign_request
+
+    c = Cluster(tmp_path, n=4)
+    c.start()
+    try:
+        await c.wait_up()
+        for _ in range(60):
+            if "4/4 connected" in c.cli("status"):
+                break
+            await asyncio.sleep(0.5)
+        # nodes 0-2 store data; node 3 is a pure API gateway (no capacity)
+        ids = []
+        for cfg in c.configs:
+            ids.append(c.cli("node-id", config=cfg).strip().split("@")[0])
+        for nid in ids[:3]:
+            c.cli("layout", "assign", nid, "-z", "dc1", "-c", "100M")
+        c.cli("layout", "assign", ids[3], "-z", "dc1")  # gateway
+        c.cli("layout", "apply", "--version", "1")
+
+        out = c.cli("key", "create", "gw-key")
+        key_id = [l for l in out.splitlines() if "Key ID" in l][0].split()[-1]
+        secret = [l for l in out.splitlines() if "Secret" in l][0].split()[-1]
+        c.cli("bucket", "create", "gw-bucket")
+        c.cli("bucket", "allow", "gw-bucket", "--key", key_id,
+              "--read", "--write", "--owner")
+
+        data = os.urandom(8 * 1024 * 1024)  # 8 × 1 MiB blocks
+        put_client = S3Client(c.s3_ports[0], key_id, secret)
+        status, _, _ = await put_client.req("PUT", "/gw-bucket/big.bin",
+                                            body=data)
+        assert status == 200
+
+        def node_bytes_read(i):
+            stats = _json.loads(c.cli("stats", config=c.configs[i]))
+            return stats["block"]["bytes_read"]
+
+        before = [node_bytes_read(i) for i in range(3)]
+
+        # streaming GET through the GATEWAY (stores nothing itself)
+        gw_port = c.s3_ports[3]
+        path = "/gw-bucket/big.bin"
+        headers = {"host": f"127.0.0.1:{gw_port}"}
+        headers.update(sign_request(
+            key_id, secret, "garage", "GET", path, [], headers, b"",
+            path_is_raw=True,
+        ))
+        got = bytearray()
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{gw_port}{path}",
+                             headers=headers) as r:
+                assert r.status == 200
+                # consume the first ~1.5 MiB, then find + kill the serving
+                # storage node (its read counter moved by ≥ a block)
+                while len(got) < 1536 * 1024:
+                    chunk = await r.content.read(64 * 1024)
+                    assert chunk, "stream ended early"
+                    got.extend(chunk)
+                deltas = [node_bytes_read(i) - before[i] for i in range(3)]
+                victim = deltas.index(max(deltas))
+                assert deltas[victim] >= 1024 * 1024, deltas
+                c.procs[victim].send_signal(signal.SIGKILL)
+                c.procs[victim].wait()
+                while True:
+                    chunk = await r.content.read(256 * 1024)
+                    if not chunk:
+                        break
+                    got.extend(chunk)
+        assert len(got) == len(data) and bytes(got) == data
+    finally:
+        c.stop()

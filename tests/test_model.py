@@ -450,3 +450,49 @@ async def test_admin_block_ops(tmp_path):
     o2 = await g.object_table.get(bucket_id, "purgeme")
     assert o2.last_data_version() is None  # delete marker on top
     await shutdown(garages)
+
+
+async def test_table_repair_launchers_reap_orphans(tmp_path):
+    """repair versions / block_refs / mpu tombstone rows whose parent no
+    longer references them (ref repair/online.rs RepairVersions,
+    RepairBlockRefs, RepairMpu)."""
+    from garage_tpu.admin.handler import AdminRpcHandler
+    from garage_tpu.model.s3.block_ref_table import BlockRef
+    from garage_tpu.model.s3.mpu_table import MultipartUpload
+
+    garages = await make_garage_cluster(tmp_path, n=1, mode="1")
+    g = garages[0]
+    g.spawn_workers()
+    adm = AdminRpcHandler(g, register_endpoint=False)
+
+    bucket_id = gen_uuid()
+    # orphan version: no object row carries its uuid
+    vu = gen_uuid()
+    await g.version_table.insert(Version.new(vu, bytes(bucket_id), "ghost"))
+    # orphan block_ref: its version uuid does not exist
+    bh = blake2s_sum(b"orphan block payload")
+    bru = gen_uuid()
+    await g.block_ref_table.insert(BlockRef(Hash(bh), bru))
+    # orphan mpu: object row has no matching Uploading{multipart} version
+    mu = gen_uuid()
+    await g.mpu_table.insert(
+        MultipartUpload(mu, 1, bytes(bucket_id), "mkey"))
+    # live mpu: object row DOES carry the uploading version — must survive
+    mu_live = gen_uuid()
+    await g.mpu_table.insert(
+        MultipartUpload(mu_live, 2, bytes(bucket_id), "live"))
+    await g.object_table.insert(Object(bucket_id, "live", [
+        ObjectVersion.uploading(mu_live, 2, True, {})
+    ]))
+
+    assert await adm._repair_versions() == 1
+    assert (await g.version_table.get(vu, "")).deleted.value
+    assert await adm._repair_block_refs() == 1
+    assert (await g.block_ref_table.get(Hash(bh), bru)).deleted.value
+    assert await adm._repair_mpu() == 1
+    assert (await g.mpu_table.get(mu, "")).deleted.value
+    assert not (await g.mpu_table.get(mu_live, "")).deleted.value
+    # idempotent: a second pass finds nothing
+    assert await adm._repair_versions() == 0
+    assert await adm._repair_mpu() == 0
+    await shutdown(garages)

@@ -273,3 +273,22 @@ def test_async_hasher_lazy_thread_and_close():
         assert await h3.hexdigest() == hashlib.md5(b"prefix-" + big).hexdigest()
 
     asyncio.run(run())
+
+
+def test_client_addr_forwarded_for():
+    """X-Forwarded-For trusted only when it holds one valid IP literal
+    (ref util/forwarded_headers.rs tests)."""
+    from garage_tpu.api.common import client_addr
+
+    class Req:
+        def __init__(self, xff):
+            self.headers = {} if xff is None else {"X-Forwarded-For": xff}
+            self.remote = "10.0.0.1"
+
+    assert client_addr(Req("192.0.2.100")) == "192.0.2.100"
+    assert client_addr(Req("2001:db8::f00d:cafe")) == "2001:db8::f00d:cafe"
+    assert client_addr(Req(" 192.0.2.7 ")) == "192.0.2.7"
+    # hostname, list form, garbage, absent → TCP peer
+    assert client_addr(Req("www.example.com")) == "10.0.0.1"
+    assert client_addr(Req("192.0.2.1, 10.1.1.1")) == "10.0.0.1"
+    assert client_addr(Req(None)) == "10.0.0.1"
