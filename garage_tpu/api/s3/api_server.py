@@ -164,6 +164,12 @@ class S3ApiServer:
 
             return await handle_post_object(self, request, bucket_name)
 
+        # CORS preflight is unauthenticated too (ref api_server.rs:119-121)
+        if endpoint.name == "Options":
+            from .bucket_config import handle_options_s3api
+
+            return await handle_options_s3api(self, request, bucket_name)
+
         # authentication (ref api_server.rs:105-130 + signature/)
         async def get_key(key_id: str):
             k = await self.garage.key_table.get(key_id, "")
@@ -216,8 +222,38 @@ class S3ApiServer:
 
         h = handlers.get(endpoint.name)
         if h is None:
-            raise BadRequestError(f"endpoint {endpoint.name} not implemented")
-        return await h(ctx)
+            # recognized S3 endpoint with no implementation → 501, the
+            # reference's catch-all (api_server.rs Err(NotImplemented))
+            from ..common import NotImplementedError_
+
+            raise NotImplementedError_(
+                f"endpoint {endpoint.name} is not implemented")
+        # cross-origin browser callers need the bucket's CORS rule echoed
+        # on the actual response too, not just the preflight (ref
+        # api_server.rs:170,379-381).  Matched BEFORE the handler runs:
+        # streaming handlers (GetObject) send headers on prepare(), after
+        # which they are immutable — they merge ctx.cors_headers early.
+        origin = ctx.request.headers.get("Origin")
+        if origin is not None:
+            from .bucket_config import (
+                add_cors_headers,
+                cors_request_headers,
+                find_matching_cors_rule,
+            )
+
+            req_headers = cors_request_headers(ctx.request)
+            rule = find_matching_cors_rule(
+                ctx.bucket.params().cors_config.value,
+                ctx.request.method, origin, req_headers,
+            )
+            if rule is not None:
+                add_cors_headers(ctx.cors_headers, rule)
+
+        resp = await h(ctx)
+        if ctx.cors_headers and not resp.prepared:
+            for k, v in ctx.cors_headers.items():
+                resp.headers[k] = v
+        return resp
 
 
 _HANDLERS = None
@@ -270,7 +306,7 @@ class RequestContext:
 
     __slots__ = (
         "server", "request", "verified", "endpoint",
-        "bucket_name", "key_name", "bucket_id", "bucket",
+        "bucket_name", "key_name", "bucket_id", "bucket", "cors_headers",
     )
 
     def __init__(self, server, request, verified, endpoint, bucket_name, key_name):
@@ -282,6 +318,9 @@ class RequestContext:
         self.key_name = key_name
         self.bucket_id = None
         self.bucket = None
+        # CORS headers matched for this request (merged into the response
+        # by _dispatch, or by streaming handlers before prepare())
+        self.cors_headers = {}
 
     @property
     def garage(self):

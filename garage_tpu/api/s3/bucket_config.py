@@ -121,6 +121,18 @@ async def handle_delete_cors(ctx) -> web.Response:
     return web.Response(status=204)
 
 
+def cors_request_headers(request) -> List[str]:
+    """Parse Access-Control-Request-Headers into a list (ref cors.rs
+    split(',')+trim) — shared by the S3 dispatch, preflight, and web
+    server so the parsing can't diverge."""
+    return [
+        h.strip()
+        for h in request.headers.get(
+            "Access-Control-Request-Headers", "").split(",")
+        if h.strip()
+    ]
+
+
 def find_matching_cors_rule(
     rules: Optional[List[Dict]], method: str, origin: Optional[str],
     request_headers: List[str],
@@ -157,6 +169,59 @@ def apply_cors_headers(resp_headers: Dict[str, str], rule: Dict, origin: str) ->
     )
     if rule.get("expose_headers"):
         resp_headers["Access-Control-Expose-Headers"] = ", ".join(rule["expose_headers"])
+
+
+def add_cors_headers(resp_headers: Dict[str, str], rule: Dict) -> None:
+    """Full CORS header set on a matched rule (ref cors.rs
+    add_cors_headers): origins/methods/headers as configured, verbatim."""
+    resp_headers["Access-Control-Allow-Origin"] = ", ".join(
+        rule.get("allow_origins", []))
+    resp_headers["Access-Control-Allow-Methods"] = ", ".join(
+        rule.get("allow_methods", []))
+    resp_headers["Access-Control-Allow-Headers"] = ", ".join(
+        rule.get("allow_headers", []))
+    resp_headers["Access-Control-Expose-Headers"] = ", ".join(
+        rule.get("expose_headers", []))
+
+
+async def handle_options_s3api(server, request, bucket_name) -> web.Response:
+    """Unauthenticated CORS preflight (ref cors.rs:90-136
+    handle_options_s3api): a global bucket's CORS rules apply; an
+    unresolvable name gets the permissive response (could be a local
+    alias — preflights can't authenticate); no bucket = ListBuckets,
+    open to GET from anywhere."""
+    if bucket_name is not None:
+        bid = await server.helper.resolve_global_bucket_name(bucket_name)
+        if bid is not None:
+            bucket = await server.helper.get_existing_bucket(bid)
+            return handle_options_for_bucket(request, bucket)
+        return web.Response(status=200, headers={
+            "Access-Control-Allow-Origin": "*",
+            "Access-Control-Allow-Methods": "*",
+        })
+    return web.Response(status=200, headers={
+        "Access-Control-Allow-Origin": "*",
+        "Access-Control-Allow-Methods": "GET",
+    })
+
+
+def handle_options_for_bucket(request, bucket) -> web.Response:
+    """ref cors.rs:138-170 handle_options_for_bucket."""
+    origin = request.headers.get("Origin")
+    if origin is None:
+        raise BadRequestError("Missing Origin header")
+    req_method = request.headers.get("Access-Control-Request-Method")
+    if req_method is None:
+        raise BadRequestError("Missing Access-Control-Request-Method header")
+    req_headers = cors_request_headers(request)
+    rules = bucket.params().cors_config.value
+    rule = find_matching_cors_rule(rules, req_method, origin, req_headers)
+    if rule is not None:
+        headers: Dict[str, str] = {}
+        add_cors_headers(headers, rule)
+        return web.Response(status=200, headers=headers)
+    raise ApiError("This CORS request is not allowed.", status=403,
+                   code="AccessDenied")
 
 
 # --- lifecycle -------------------------------------------------------------

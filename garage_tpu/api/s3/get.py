@@ -249,6 +249,7 @@ async def _stream_blocks_range(
     blocks' streams already being pumped."""
     garage = ctx.garage
     hdrs["Content-Length"] = str(end - begin)
+    hdrs.update(ctx.cors_headers)  # immutable after prepare()
     resp = web.StreamResponse(status=status, headers=hdrs)
     await resp.prepare(ctx.request)
 

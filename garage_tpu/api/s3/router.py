@@ -37,6 +37,39 @@ _BUCKET_SUBRESOURCES = {
     "policy": ("GetBucketPolicy", None, None, OWNER),
 }
 
+# recognized-but-unimplemented subresources (ref router.rs parses all of
+# these into named endpoints; the dispatch answers 501 NotImplemented).
+# Without this table `GET /bucket?tagging` would silently route to
+# ListObjects — a misroute, not a NotImplemented.
+_BUCKET_SUBRESOURCES_UNIMPL = {
+    "accelerate": "BucketAccelerateConfiguration",
+    "analytics": "BucketAnalyticsConfiguration",
+    "encryption": "BucketEncryption",
+    "intelligent-tiering": "BucketIntelligentTieringConfiguration",
+    "inventory": "BucketInventoryConfiguration",
+    "logging": "BucketLogging",
+    "metrics": "BucketMetricsConfiguration",
+    "notification": "BucketNotificationConfiguration",
+    "object-lock": "ObjectLockConfiguration",
+    "ownershipControls": "BucketOwnershipControls",
+    "policyStatus": "BucketPolicyStatus",
+    "publicAccessBlock": "PublicAccessBlock",
+    "replication": "BucketReplication",
+    "requestPayment": "BucketRequestPayment",
+    "tagging": "BucketTagging",
+    "versions": "ListObjectVersions",
+}
+
+_OBJECT_SUBRESOURCES_UNIMPL = {
+    "acl": "ObjectAcl",
+    "legal-hold": "ObjectLegalHold",
+    "retention": "ObjectRetention",
+    "tagging": "ObjectTagging",
+    "torrent": "ObjectTorrent",
+    "restore": "RestoreObject",
+    "select": "SelectObjectContent",
+}
+
 
 def parse_endpoint(
     method: str,
@@ -48,6 +81,11 @@ def parse_endpoint(
     """ref router.rs Endpoint::from_request."""
     q = {k: v for k, v in query}
     m = method.upper()
+
+    # CORS preflight: unauthenticated, handled before signature checks
+    # (ref api_server.rs Endpoint::Options + cors.rs handle_options_s3api)
+    if m == "OPTIONS":
+        return Endpoint("Options", NONE, bucket, key, q)
 
     if bucket is None:
         if m == "GET":
@@ -69,6 +107,13 @@ def _bucket_endpoint(m: str, bucket: str, q: Dict[str, str], headers) -> Endpoin
             if m == "DELETE" and del_ep:
                 return Endpoint(del_ep, OWNER, bucket, query=q)
             raise NotImplementedError_(f"{m} ?{sub} not supported")
+    for sub, name in _BUCKET_SUBRESOURCES_UNIMPL.items():
+        if sub in q:
+            verb = {"GET": "Get", "PUT": "Put", "DELETE": "Delete"}.get(m, m)
+            auth = READ if m == "GET" else OWNER
+            if name.startswith("List"):  # ?versions
+                verb, auth = "", READ
+            return Endpoint(verb + name, auth, bucket, query=q)
     if m == "GET":
         if "uploads" in q:
             return Endpoint("ListMultipartUploads", READ, bucket, query=q)
@@ -90,6 +135,14 @@ def _bucket_endpoint(m: str, bucket: str, q: Dict[str, str], headers) -> Endpoin
 
 def _object_endpoint(m: str, bucket: str, key: str, q: Dict[str, str], headers) -> Endpoint:
     copy_source = headers.get("x-amz-copy-source")
+    for sub, name in _OBJECT_SUBRESOURCES_UNIMPL.items():
+        if sub in q:
+            if name in ("RestoreObject", "SelectObjectContent"):
+                return Endpoint(name, WRITE if name == "RestoreObject" else READ,
+                                bucket, key, q)
+            verb = {"GET": "Get", "PUT": "Put", "DELETE": "Delete"}.get(m, m)
+            return Endpoint(verb + name, READ if m == "GET" else WRITE,
+                            bucket, key, q)
     if m == "GET":
         if "uploadId" in q:
             return Endpoint("ListParts", READ, bucket, key, q)

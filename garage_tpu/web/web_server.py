@@ -15,7 +15,11 @@ from typing import Optional
 from aiohttp import web
 
 from ..api.common import host_to_bucket, request_trace
-from ..api.s3.bucket_config import apply_cors_headers, find_matching_cors_rule
+from ..api.s3.bucket_config import (
+    apply_cors_headers,
+    cors_request_headers,
+    find_matching_cors_rule,
+)
 from ..utils.metrics import maybe_time
 
 logger = logging.getLogger("garage_tpu.web")
@@ -98,10 +102,20 @@ class WebServer:
         if wc is None:
             return web.Response(status=404, text="website not enabled on this bucket")
 
+        index = wc.get("index_document", "index.html")
         key = request.path.lstrip("/")
-        # directory-style keys resolve to the index document
+        # directory-style keys resolve to the index document; a path
+        # WITHOUT the trailing slash serves the object if present, else
+        # 302-redirects to path/ when path/index exists — AWS website
+        # semantics (ref web_server.rs:389-416 path_to_keys +
+        # ImplicitRedirect)
+        implicit_redirect = None
         if key == "" or key.endswith("/"):
-            key = key + wc.get("index_document", "index.html")
+            key = key + index
+        else:
+            implicit_redirect = (
+                f"{key}/{index}", request.rel_url.raw_path + "/"
+            )
 
         cors_rules = bucket.params().cors_config.value
         origin = request.headers.get("Origin")
@@ -110,14 +124,8 @@ class WebServer:
             req_method = request.headers.get(
                 "Access-Control-Request-Method", "GET"
             )
-            req_headers = [
-                h.strip()
-                for h in request.headers.get(
-                    "Access-Control-Request-Headers", ""
-                ).split(",")
-                if h.strip()
-            ]
-            rule = find_matching_cors_rule(cors_rules, req_method, origin, req_headers)
+            rule = find_matching_cors_rule(
+                cors_rules, req_method, origin, cors_request_headers(request))
             if rule is None:
                 return web.Response(status=403, text="CORS forbidden")
             hdrs = {
@@ -133,6 +141,11 @@ class WebServer:
             return web.Response(status=405, text="method not allowed")
 
         resp = await self._get_object(request, bid, key)
+        if resp.status == 404 and implicit_redirect is not None:
+            redir_key, redir_url = implicit_redirect
+            if await self._key_exists(bid, redir_key):
+                return web.Response(
+                    status=302, headers={"Location": redir_url})
         if resp.status == 404:
             # error document, still with 404 status (web_server.rs)
             err_key = wc.get("error_document")
@@ -150,6 +163,11 @@ class WebServer:
                     resp.headers[k] = v
         return resp
 
+    async def _key_exists(self, bucket_id, key: str) -> bool:
+        """ref web_server.rs:212-221 check_key_exists."""
+        obj = await self.garage.object_table.get(bucket_id, key)
+        return obj is not None and obj.last_data_version() is not None
+
     async def _get_object(self, request, bucket_id, key: str) -> web.StreamResponse:
         """Serve one object via the S3 read internals (no auth — websites
         are public reads, ref web_server.rs serve_file)."""
@@ -159,6 +177,7 @@ class WebServer:
         class _Ctx:
             garage = self.garage
             key_name = key
+            cors_headers: dict = {}  # CORS is applied by the web layer
 
             def __init__(self):
                 self.request = request

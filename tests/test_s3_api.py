@@ -758,3 +758,97 @@ async def test_list_multipart_uploads_upload_id_marker(tmp_path):
     assert sorted(got) == sorted(ids), (got, ids)
     assert len(got) == 3  # every upload exactly once — no dups, no gaps
     await stop_all(garages, server)
+
+
+async def test_cors_preflight_and_response_headers(tmp_path):
+    """OPTIONS preflight + CORS headers on actual responses (ref
+    cors.rs:90-170 handle_options_s3api, api_server.rs:170,379-381)."""
+    import aiohttp
+
+    garages, server, client, key = await make_api_cluster(tmp_path)
+    await client.req("PUT", "/corsb")
+    cx = (
+        "<CORSConfiguration><CORSRule>"
+        "<AllowedOrigin>https://app.example</AllowedOrigin>"
+        "<AllowedMethod>GET</AllowedMethod>"
+        "<AllowedHeader>x-custom</AllowedHeader>"
+        "<ExposeHeader>etag</ExposeHeader>"
+        "</CORSRule></CORSConfiguration>"
+    ).encode()
+    st, _, _ = await client.req("PUT", "/corsb", query=[("cors", "")], body=cx)
+    assert st == 200
+    st, _, _ = await client.req("PUT", "/corsb/o.txt", body=b"hello cors")
+    assert st == 200
+
+    base = f"http://127.0.0.1:{server.port}"
+    async with aiohttp.ClientSession() as s:
+        # matching preflight: unauthenticated, full header set echoed
+        async with s.options(f"{base}/corsb/o.txt", headers={
+            "Origin": "https://app.example",
+            "Access-Control-Request-Method": "GET",
+            "Access-Control-Request-Headers": "x-custom",
+        }) as r:
+            assert r.status == 200
+            assert r.headers["Access-Control-Allow-Origin"] == "https://app.example"
+            assert "GET" in r.headers["Access-Control-Allow-Methods"]
+            assert r.headers["Access-Control-Allow-Headers"] == "x-custom"
+            assert r.headers["Access-Control-Expose-Headers"] == "etag"
+        # non-matching origin → 403
+        async with s.options(f"{base}/corsb/o.txt", headers={
+            "Origin": "https://evil.example",
+            "Access-Control-Request-Method": "GET",
+        }) as r:
+            assert r.status == 403
+        # unresolvable bucket name → permissive (could be a local alias)
+        async with s.options(f"{base}/nosuchbkt/x", headers={
+            "Origin": "https://anywhere",
+            "Access-Control-Request-Method": "PUT",
+        }) as r:
+            assert r.status == 200
+            assert r.headers["Access-Control-Allow-Origin"] == "*"
+        # no bucket → ListBuckets preflight, GET only
+        async with s.options(f"{base}/", headers={
+            "Origin": "https://anywhere",
+            "Access-Control-Request-Method": "GET",
+        }) as r:
+            assert r.status == 200
+            assert r.headers["Access-Control-Allow-Methods"] == "GET"
+
+    # authenticated GET with matching Origin carries the rule's headers,
+    # including on the streaming body path
+    st, hdrs, body = await client.req(
+        "GET", "/corsb/o.txt", headers={"Origin": "https://app.example"})
+    assert st == 200 and body == b"hello cors"
+    assert hdrs["Access-Control-Allow-Origin"] == "https://app.example"
+    # non-matching origin: no CORS headers, request still served
+    st, hdrs, body = await client.req(
+        "GET", "/corsb/o.txt", headers={"Origin": "https://evil.example"})
+    assert st == 200 and "Access-Control-Allow-Origin" not in hdrs
+    await stop_all(garages, server)
+
+
+async def test_unimplemented_subresources_answer_501(tmp_path):
+    """Recognized S3 subresources without an implementation must answer
+    501 NotImplemented, not misroute to a list/get handler (ref
+    api_server.rs catch-all Err(Error::NotImplemented))."""
+    garages, server, client, key = await make_api_cluster(tmp_path)
+    await client.req("PUT", "/nib")
+    await client.req("PUT", "/nib/k", body=b"x")
+
+    for q in ("tagging", "versions", "replication", "logging",
+              "notification", "encryption", "requestPayment"):
+        st, _, body = await client.req("GET", "/nib", query=[(q, "")])
+        assert st == 501, (q, st, body)
+        assert b"NotImplemented" in body, (q, body)
+    for q in ("tagging", "acl", "torrent", "retention", "legal-hold"):
+        st, _, body = await client.req("GET", "/nib/k", query=[(q, "")])
+        assert st == 501, (q, st, body)
+    st, _, _ = await client.req("PUT", "/nib", query=[("tagging", "")],
+                                body=b"<Tagging/>")
+    assert st == 501
+    # implemented neighbours still work
+    st, _, _ = await client.req("GET", "/nib", query=[("location", "")])
+    assert st == 200
+    st, _, body = await client.req("GET", "/nib")
+    assert st == 200 and b"<Key>k</Key>" in body
+    await stop_all(garages, server)

@@ -1,0 +1,125 @@
+"""Static website server (ref src/web/web_server.rs, SURVEY.md §2.8):
+Host→bucket resolution, index/error documents, implicit directory
+redirects, CORS, and streaming of multi-block files."""
+
+import os
+import sys
+
+import aiohttp
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_s3_api import S3Client, make_api_cluster, stop_all  # noqa: E402
+
+pytestmark = pytest.mark.asyncio
+
+
+async def make_web(tmp_path):
+    from garage_tpu.web.web_server import WebServer
+
+    garages, server, client, key = await make_api_cluster(tmp_path)
+    g = garages[0]
+    g.config.web_root_domain = ".web.localhost"
+    web_srv = WebServer(g)
+    await web_srv.start("127.0.0.1:0")
+
+    await client.req("PUT", "/site")
+    wx = (
+        "<WebsiteConfiguration>"
+        "<IndexDocument><Suffix>index.html</Suffix></IndexDocument>"
+        "<ErrorDocument><Key>err.html</Key></ErrorDocument>"
+        "</WebsiteConfiguration>"
+    ).encode()
+    st, _, _ = await client.req("PUT", "/site", query=[("website", "")], body=wx)
+    assert st == 200
+    return garages, server, client, web_srv
+
+
+async def wget(port, path, host="site.web.localhost", method="GET",
+               headers=None, allow_redirects=False):
+    hdrs = {"Host": host}
+    hdrs.update(headers or {})
+    async with aiohttp.ClientSession() as s:
+        async with s.request(
+            method, f"http://127.0.0.1:{port}{path}", headers=hdrs,
+            allow_redirects=allow_redirects,
+        ) as r:
+            return r.status, r.headers.copy(), await r.read()
+
+
+async def test_website_serving_and_implicit_redirect(tmp_path):
+    garages, server, client, web_srv = await make_web(tmp_path)
+    for key, body in [
+        ("index.html", b"<h1>root</h1>"),
+        ("err.html", b"custom 404 page"),
+        ("page.html", b"a page"),
+        ("photos/index.html", b"photo album"),
+    ]:
+        st, _, _ = await client.req("PUT", f"/site/{key}", body=body)
+        assert st == 200
+    port = web_srv.port
+
+    # root and trailing-slash paths serve the index document
+    st, _, body = await wget(port, "/")
+    assert st == 200 and body == b"<h1>root</h1>"
+    st, _, body = await wget(port, "/photos/")
+    assert st == 200 and body == b"photo album"
+    # plain file
+    st, _, body = await wget(port, "/page.html")
+    assert st == 200 and body == b"a page"
+    # implicit redirect: /photos (no slash, no such object) but
+    # photos/index.html exists → 302 Found to /photos/ (ref
+    # web_server.rs path_to_keys + ImplicitRedirect)
+    st, hdrs, _ = await wget(port, "/photos")
+    assert st == 302 and hdrs["Location"] == "/photos/"
+    # missing key without a redirect target → error document with 404
+    st, _, body = await wget(port, "/nope.html")
+    assert st == 404 and body == b"custom 404 page"
+    # unknown website host
+    st, _, _ = await wget(port, "/", host="other.web.localhost")
+    assert st == 404
+    # HEAD works and carries no body
+    st, _, body = await wget(port, "/page.html", method="HEAD")
+    assert st == 200 and body == b""
+    await web_srv.stop()
+    await stop_all(garages, server)
+
+
+async def test_website_multiblock_streaming_and_cors(tmp_path):
+    """A file larger than block_size streams through the web server; CORS
+    rules apply to website responses (ref web_server.rs serve_file +
+    cors)."""
+    garages, server, client, web_srv = await make_web(tmp_path)
+    g = garages[0]
+    big = os.urandom(g.config.block_size + 300_000)  # 2 blocks
+    st, _, _ = await client.req("PUT", "/site/big.bin", body=big)
+    assert st == 200
+    cx = (
+        "<CORSConfiguration><CORSRule>"
+        "<AllowedOrigin>https://app.example</AllowedOrigin>"
+        "<AllowedMethod>GET</AllowedMethod>"
+        "</CORSRule></CORSConfiguration>"
+    ).encode()
+    st, _, _ = await client.req("PUT", "/site", query=[("cors", "")], body=cx)
+    assert st == 200
+
+    port = web_srv.port
+    st, hdrs, body = await wget(
+        port, "/big.bin", headers={"Origin": "https://app.example"})
+    assert st == 200 and body == big
+    st, hdrs, _ = await wget(
+        port, "/", headers={"Origin": "https://app.example"})
+    assert hdrs.get("Access-Control-Allow-Origin") == "https://app.example"
+    # preflight against the website
+    st, hdrs, _ = await wget(
+        port, "/big.bin", method="OPTIONS",
+        headers={"Origin": "https://app.example",
+                 "Access-Control-Request-Method": "GET"})
+    assert st == 200 and "GET" in hdrs["Access-Control-Allow-Methods"]
+    st, _, _ = await wget(
+        port, "/big.bin", method="OPTIONS",
+        headers={"Origin": "https://evil.example",
+                 "Access-Control-Request-Method": "GET"})
+    assert st == 403
+    await web_srv.stop()
+    await stop_all(garages, server)
