@@ -597,6 +597,34 @@ class AdminRpcHandler:
 
     async def _cmd_stats(self, msg) -> Dict:
         g = self.garage
+        if msg.get("all"):
+            # gather from every layout node, server-side fan-out (ref
+            # garage/admin/mod.rs handle_stats with all_nodes=true)
+            from ..net.frame import PRIO_NORMAL
+
+            endpoint = getattr(self, "endpoint", None)
+            if endpoint is None:
+                raise GarageError("stats --all needs the RPC endpoint")
+            import asyncio
+
+            async def one(nid):
+                if bytes(nid) == bytes(g.system.id):
+                    return nid.hex(), await self._cmd_stats({})
+                try:
+                    resp = await endpoint.call(
+                        nid, {"cmd": "stats"}, prio=PRIO_NORMAL, timeout=10.0
+                    )
+                    return nid.hex(), (
+                        resp["ok"] if "ok" in resp
+                        else {"err": resp.get("err")}
+                    )
+                except Exception as e:  # noqa: BLE001 — per-node report
+                    return nid.hex(), {"err": f"{type(e).__name__}: {e}"}
+
+            pairs = await asyncio.gather(
+                *[one(nid) for nid in g.system.layout.node_roles().keys()]
+            )
+            return {"nodes": dict(pairs)}
         table_stats = {}
         for t in g.tables:
             table_stats[t.schema.TABLE_NAME] = {

@@ -15,6 +15,8 @@ from typing import Optional
 
 from aiohttp import web
 
+from .common import start_site
+
 logger = logging.getLogger("garage_tpu.api.admin")
 
 
@@ -69,9 +71,7 @@ class AdminApiServer:
         app.router.add_get("/check", self.handle_check_domain)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        host, port = bind_addr.rsplit(":", 1)
-        self._site = web.TCPSite(self._runner, host, int(port))
-        await self._site.start()
+        self._site = await start_site(self._runner, bind_addr)
         logger.info("Admin API listening on %s", bind_addr)
 
     @property

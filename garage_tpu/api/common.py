@@ -134,6 +134,34 @@ def int_param(value, name: str, default: Optional[int] = None) -> Optional[int]:
         )
 
 
+async def start_site(runner, bind_addr: str):
+    """Bind an aiohttp runner to `bind_addr` — "host:port" for TCP, an
+    absolute path or "unix:/path" for a unix domain socket (ref
+    util/socket_address.rs UnixOrTCPSocketAddress; every API server in
+    the reference accepts both).  Returns the started site."""
+    from aiohttp import web
+
+    if bind_addr.startswith("unix:"):
+        bind_addr = bind_addr[len("unix:"):]
+    if bind_addr.startswith("/"):
+        # a previous run's socket file survives shutdown and would make
+        # bind fail EADDRINUSE; only ever unlink an actual socket
+        import os
+        import stat
+
+        try:
+            if stat.S_ISSOCK(os.stat(bind_addr).st_mode):
+                os.unlink(bind_addr)
+        except FileNotFoundError:
+            pass
+        site = web.UnixSite(runner, bind_addr)
+    else:
+        host, port = bind_addr.rsplit(":", 1)
+        site = web.TCPSite(runner, host, int(port))
+    await site.start()
+    return site
+
+
 def client_addr(request) -> str:
     """Advertised client address for logs/spans (ref
     util/forwarded_headers.rs handle_forwarded_for_headers +

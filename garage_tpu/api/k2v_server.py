@@ -34,6 +34,7 @@ from .common import (
     error_xml,
     int_param,
     request_trace,
+    start_site,
 )
 from .signature import check_signature, raw_query_pairs
 
@@ -54,9 +55,7 @@ class K2VApiServer:
         app.router.add_route("*", "/{tail:.*}", self.handle_request)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        host, port = bind_addr.rsplit(":", 1)
-        self._site = web.TCPSite(self._runner, host, int(port))
-        await self._site.start()
+        self._site = await start_site(self._runner, bind_addr)
         logger.info("K2V API listening on %s", bind_addr)
 
     @property

@@ -35,6 +35,7 @@ from ..common import (
     host_to_bucket,
     parse_bucket_key,
     request_trace,
+    start_site,
 )
 from ..signature import (
     AuthError,
@@ -80,9 +81,7 @@ class S3ApiServer:
         app.router.add_route("*", "/{tail:.*}", self.handle_request)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        host, port = bind_addr.rsplit(":", 1)
-        self._site = web.TCPSite(self._runner, host, int(port))
-        await self._site.start()
+        self._site = await start_site(self._runner, bind_addr)
         logger.info("S3 API listening on %s", bind_addr)
 
     @property

@@ -50,7 +50,9 @@ def _build_parser() -> argparse.ArgumentParser:
              "stopped (ref repair/offline.rs:11-47 + index_counter.rs:252+)",
     )
     sub.add_parser("status", help="cluster status")
-    sub.add_parser("stats", help="node statistics")
+    pst = sub.add_parser("stats", help="node statistics")
+    pst.add_argument("-a", "--all-nodes", action="store_true",
+                     help="gather statistics from all cluster nodes")
 
     pc = sub.add_parser("connect", help="connect to a peer (id@host:port)")
     pc.add_argument("peer")
@@ -297,7 +299,10 @@ async def _amain(args) -> None:
         return
 
     if args.command == "stats":
-        print(json.dumps(await client.call({"cmd": "stats"}), indent=2))
+        msg = {"cmd": "stats"}
+        if getattr(args, "all_nodes", False):
+            msg["all"] = True
+        print(json.dumps(await client.call(msg), indent=2))
         return
 
     if args.command == "connect":

@@ -14,7 +14,7 @@ from typing import Optional
 
 from aiohttp import web
 
-from ..api.common import host_to_bucket, request_trace
+from ..api.common import host_to_bucket, request_trace, start_site
 from ..api.s3.bucket_config import (
     apply_cors_headers,
     cors_request_headers,
@@ -51,9 +51,7 @@ class WebServer:
         app.router.add_route("*", "/{tail:.*}", self.handle_request)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        host, port = bind_addr.rsplit(":", 1)
-        self._site = web.TCPSite(self._runner, host, int(port))
-        await self._site.start()
+        self._site = await start_site(self._runner, bind_addr)
         logger.info("web server listening on %s", bind_addr)
 
     @property
@@ -113,8 +111,13 @@ class WebServer:
         if key == "" or key.endswith("/"):
             key = key + index
         else:
+            # Location keeps the query string (the reference drops it —
+            # web_server.rs:410 formats the path only — but clients lose
+            # their parameters on the re-request; AWS preserves them)
+            qs = request.rel_url.raw_query_string
             implicit_redirect = (
-                f"{key}/{index}", request.rel_url.raw_path + "/"
+                f"{key}/{index}",
+                request.rel_url.raw_path + "/" + (f"?{qs}" if qs else ""),
             )
 
         cors_rules = bucket.params().cors_config.value
@@ -140,7 +143,17 @@ class WebServer:
         if request.method not in ("GET", "HEAD"):
             return web.Response(status=405, text="method not allowed")
 
-        resp = await self._get_object(request, bid, key)
+        # matched CORS headers must reach STREAMED responses before
+        # prepare() seals them — computed here, merged by _get_object
+        cors_headers: dict = {}
+        if origin is not None and cors_rules:
+            rule = find_matching_cors_rule(
+                cors_rules, request.method, origin,
+                cors_request_headers(request))
+            if rule is not None:
+                apply_cors_headers(cors_headers, rule, origin)
+
+        resp = await self._get_object(request, bid, key, cors_headers)
         if resp.status == 404 and implicit_redirect is not None:
             redir_key, redir_url = implicit_redirect
             if await self._key_exists(bid, redir_key):
@@ -150,17 +163,14 @@ class WebServer:
             # error document, still with 404 status (web_server.rs)
             err_key = wc.get("error_document")
             if err_key:
-                err_resp = await self._get_object(request, bid, err_key)
+                err_resp = await self._get_object(
+                    request, bid, err_key, cors_headers)
                 if err_resp.status == 200:
                     err_resp.set_status(404)
                     return err_resp
-        if origin is not None and cors_rules:
-            rule = find_matching_cors_rule(cors_rules, request.method, origin, [])
-            if rule is not None and isinstance(resp, web.Response):
-                hdrs = dict(resp.headers)
-                apply_cors_headers(hdrs, rule, origin)
-                for k, v in hdrs.items():
-                    resp.headers[k] = v
+        if cors_headers and not resp.prepared:
+            for k, v in cors_headers.items():
+                resp.headers[k] = v
         return resp
 
     async def _key_exists(self, bucket_id, key: str) -> bool:
@@ -168,7 +178,9 @@ class WebServer:
         obj = await self.garage.object_table.get(bucket_id, key)
         return obj is not None and obj.last_data_version() is not None
 
-    async def _get_object(self, request, bucket_id, key: str) -> web.StreamResponse:
+    async def _get_object(self, request, bucket_id, key: str,
+                          cors_headers: Optional[dict] = None
+                          ) -> web.StreamResponse:
         """Serve one object via the S3 read internals (no auth — websites
         are public reads, ref web_server.rs serve_file)."""
         from ..api.common import ApiError
@@ -177,11 +189,12 @@ class WebServer:
         class _Ctx:
             garage = self.garage
             key_name = key
-            cors_headers: dict = {}  # CORS is applied by the web layer
 
             def __init__(self):
                 self.request = request
                 self.bucket_id = bucket_id
+                # merged into streamed responses before prepare()
+                self.cors_headers = cors_headers or {}
 
         ctx = _Ctx()
         try:

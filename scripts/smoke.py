@@ -1,0 +1,140 @@
+"""S3 smoke flows against the running dev cluster (equivalent of
+reference script/test-smoke.sh, which drives aws-cli/s3cmd/mc through
+upload/download/diff, multipart with out-of-order + skipped part
+numbers, and website checks).  Run via scripts/test_smoke.sh.
+
+Exercises different nodes for writes and reads so every flow crosses
+the quorum/replication path, not just local state.
+"""
+
+import asyncio
+import hashlib
+import os
+import subprocess
+import sys
+import xml.etree.ElementTree as ET
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+BASE = os.environ.get("GARAGE_TPU_DEV_DIR", "/tmp/garage_tpu_dev")
+CFG = f"{BASE}/node0/garage.toml"
+S3_PORTS = (3900, 3910, 3920)
+WEB_PORT = 3902
+
+
+def cli(*args):
+    r = subprocess.run(
+        [sys.executable, "-m", "garage_tpu", "-c", CFG, *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if r.returncode != 0:
+        raise RuntimeError(f"cli {args}: {r.stdout}\n{r.stderr}")
+    return r.stdout
+
+
+async def main() -> None:
+    import aiohttp
+
+    from test_s3_api import S3Client
+
+    out = cli("key", "create", "smoke-key")
+    kid = [l for l in out.splitlines() if "Key ID" in l][0].split()[-1]
+    sec = [l for l in out.splitlines() if "Secret" in l][0].split()[-1]
+    cli("bucket", "create", "smoke")
+    cli("bucket", "allow", "smoke", "--key", kid,
+        "--read", "--write", "--owner")
+    cli("bucket", "website", "smoke", "--allow")
+    nodes = [S3Client(p, kid, sec) for p in S3_PORTS]
+
+    # 1. put/get/diff across nodes, several sizes (incl. inline + multi-block)
+    for i, size in enumerate([1, 1024, 3071, 3072, 1 << 20, (5 << 20) + 17]):
+        data = os.urandom(size)
+        put_node, get_node = nodes[i % 3], nodes[(i + 1) % 3]
+        st, _, _ = await put_node.req("PUT", f"/smoke/size-{size}", body=data)
+        assert st == 200, (size, st)
+        st, _, got = await get_node.req("GET", f"/smoke/size-{size}")
+        assert st == 200 and got == data, f"diff mismatch at size {size}"
+    print("put/get/diff ok (6 sizes × cross-node)")
+
+    # 2. multipart: out-of-order upload + skipped part numbers (the
+    # reference smoke's signature case)
+    c = nodes[0]
+    st, _, body = await c.req("POST", "/smoke/mpu.bin",
+                              query=[("uploads", "")])
+    assert st == 200, st
+    upload_id = body.decode().split("<UploadId>")[1].split("</UploadId>")[0]
+    parts = {1: os.urandom(5 << 20), 4: os.urandom(5 << 20),
+             7: os.urandom(123)}   # skipped + out-of-order part numbers
+    etags = {}
+    for pn in (4, 1, 7):  # upload out of order
+        st, hdrs, _ = await nodes[pn % 3].req(
+            "PUT", "/smoke/mpu.bin",
+            query=[("partNumber", str(pn)), ("uploadId", upload_id)],
+            body=parts[pn])
+        assert st == 200, (pn, st)
+        etags[pn] = hdrs["ETag"]
+    complete = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{pn}</PartNumber><ETag>{etags[pn]}</ETag></Part>"
+        for pn in sorted(parts)) + "</CompleteMultipartUpload>"
+    st, _, _ = await c.req("POST", "/smoke/mpu.bin",
+                           query=[("uploadId", upload_id)],
+                           body=complete.encode())
+    assert st == 200, st
+    want = parts[1] + parts[4] + parts[7]
+    st, _, got = await nodes[2].req("GET", "/smoke/mpu.bin")
+    assert st == 200 and got == want, "multipart content mismatch"
+    # ranged read across a part boundary
+    st, _, got = await c.req(
+        "GET", "/smoke/mpu.bin",
+        headers={"range": f"bytes={(5 << 20) - 100}-{(5 << 20) + 99}"})
+    assert st == 206 and got == want[(5 << 20) - 100:(5 << 20) + 100]
+    print("multipart out-of-order + skipped parts + ranged read ok")
+
+    # 3. list with prefix/delimiter pagination
+    for i in range(12):
+        st, _, _ = await c.req("PUT", f"/smoke/dir{i % 3}/f{i}", body=b"x")
+        assert st == 200
+    st, _, body = await c.req("GET", "/smoke", query=[
+        ("delimiter", "/"), ("max-keys", "2")])
+    root = ET.fromstring(body)
+    ns = root.tag[:root.tag.index("}") + 1]
+    assert root.findtext(f"{ns}IsTruncated") == "true"
+    print("list pagination ok")
+
+    # 4. website through the web port
+    st, _, _ = await c.req("PUT", "/smoke/index.html", body=b"<h1>smoke</h1>")
+    assert st == 200
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://127.0.0.1:{WEB_PORT}/",
+                         headers={"Host": "smoke.web.garage.localhost"}) as r:
+            assert r.status == 200
+            assert await r.read() == b"<h1>smoke</h1>"
+    print("website ok")
+
+    # 5. delete + verify 404, then DeleteObjects batch
+    st, _, _ = await c.req("DELETE", "/smoke/size-1")
+    assert st == 204, st
+    st, _, _ = await nodes[1].req("GET", "/smoke/size-1")
+    assert st == 404
+    dx = ("<Delete>" + "".join(
+        f"<Object><Key>dir{i % 3}/f{i}</Key></Object>" for i in range(12))
+        + "</Delete>")
+    body_b = dx.encode()
+    md5 = hashlib.md5(body_b).digest()
+    import base64
+
+    st, _, _ = await c.req("POST", "/smoke", query=[("delete", "")],
+                           body=body_b,
+                           headers={"content-md5":
+                                    base64.b64encode(md5).decode()})
+    assert st == 200, st
+    print("delete + batch delete ok")
+
+    print("SMOKE OK")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Drive real S3 flows through the running dev cluster (equivalent of
+# reference script/test-smoke.sh): put/get/diff at several sizes across
+# different nodes, multipart with out-of-order + skipped part numbers,
+# ranged reads, list pagination, website serving, and batch deletes.
+#
+# Usage: scripts/dev_cluster.sh &   (wait for boot)
+#        scripts/dev_configure.sh
+#        scripts/test_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" exec python scripts/smoke.py "$@"

@@ -4,6 +4,7 @@ and drive the S3/admin APIs — the reference's test philosophy (execve a
 compiled binary, no mocked IO; SURVEY.md §4, tests/common/garage.rs)."""
 
 import asyncio
+import json
 import os
 import pathlib
 import signal
@@ -202,9 +203,15 @@ async def test_daemon_cluster_end_to_end(cluster):
     out = cluster.cli("worker", "list")
     assert "Merkle" in out or "merkle" in out
 
-    # stats
+    # stats: local, then cluster-wide fan-out (one node is down -> its
+    # entry reports the error instead of stats)
     out = cluster.cli("stats")
     assert "resync_queue" in out
+    allstats = json.loads(cluster.cli("stats", "-a"))
+    assert len(allstats["nodes"]) == 3
+    ok_nodes = [v for v in allstats["nodes"].values() if "block" in v]
+    err_nodes = [v for v in allstats["nodes"].values() if "err" in v]
+    assert len(ok_nodes) == 2 and len(err_nodes) == 1, allstats
 
 
 async def test_admin_http_api(cluster):

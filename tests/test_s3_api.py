@@ -852,3 +852,44 @@ async def test_unimplemented_subresources_answer_501(tmp_path):
     st, _, body = await client.req("GET", "/nib")
     assert st == 200 and b"<Key>k</Key>" in body
     await stop_all(garages, server)
+
+
+async def test_s3_server_on_unix_socket(tmp_path):
+    """API servers bind unix domain sockets too (ref
+    util/socket_address.rs UnixOrTCPSocketAddress)."""
+    import aiohttp
+
+    garages = await make_garage_cluster(tmp_path)
+    for g in garages:
+        g.spawn_workers()
+    g = garages[0]
+    helper = g.helper()
+    key = await helper.create_key("unixtest")
+    key.params().allow_create_bucket.update(True)
+    await g.key_table.insert(key)
+    server = S3ApiServer(g)
+    sock = str(tmp_path / "s3.sock")
+    await server.start(sock)
+    kid, secret = key.key_id, key.params().secret_key
+
+    async def ureq(method, path, body=b""):
+        headers = {"host": "localhost"}
+        headers.update(sign_request(kid, secret, "garage", method, path, [],
+                                    headers, body, path_is_raw=True))
+        conn = aiohttp.UnixConnector(path=sock)
+        async with aiohttp.ClientSession(connector=conn) as s:
+            async with s.request(method, f"http://localhost{path}",
+                                 data=body, headers=headers) as r:
+                return r.status, await r.read()
+
+    st, _ = await ureq("PUT", "/ubkt")
+    assert st == 200
+    st, _ = await ureq("PUT", "/ubkt/o1", b"over unix")
+    assert st == 200
+    st, body = await ureq("GET", "/ubkt/o1")
+    assert st == 200 and body == b"over unix"
+    # "unix:" prefix form works too
+    server2 = S3ApiServer(g)
+    await server2.start(f"unix:{tmp_path}/s3b.sock")
+    await server2.stop()
+    await stop_all(garages, server)

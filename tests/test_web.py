@@ -72,6 +72,10 @@ async def test_website_serving_and_implicit_redirect(tmp_path):
     # web_server.rs path_to_keys + ImplicitRedirect)
     st, hdrs, _ = await wget(port, "/photos")
     assert st == 302 and hdrs["Location"] == "/photos/"
+    # the redirect preserves the query string (yarl normalizes %2F to
+    # the equivalent literal slash in query values)
+    st, hdrs, _ = await wget(port, "/photos?lang=fr&x=%2F")
+    assert st == 302 and hdrs["Location"] == "/photos/?lang=fr&x=/"
     # missing key without a redirect target → error document with 404
     st, _, body = await wget(port, "/nope.html")
     assert st == 404 and body == b"custom 404 page"
@@ -107,6 +111,9 @@ async def test_website_multiblock_streaming_and_cors(tmp_path):
     st, hdrs, body = await wget(
         port, "/big.bin", headers={"Origin": "https://app.example"})
     assert st == 200 and body == big
+    # CORS headers must reach the STREAMED (multi-block) response too —
+    # they are sealed at prepare(), so they must be merged before it
+    assert hdrs.get("Access-Control-Allow-Origin") == "https://app.example"
     st, hdrs, _ = await wget(
         port, "/", headers={"Origin": "https://app.example"})
     assert hdrs.get("Access-Control-Allow-Origin") == "https://app.example"
