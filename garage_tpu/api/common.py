@@ -141,9 +141,10 @@ async def start_site(runner, bind_addr: str):
     the reference accepts both).  Returns the started site."""
     from aiohttp import web
 
-    if bind_addr.startswith("unix:"):
+    is_unix = bind_addr.startswith("unix:")
+    if is_unix:
         bind_addr = bind_addr[len("unix:"):]
-    if bind_addr.startswith("/"):
+    if is_unix or bind_addr.startswith("/"):
         # a previous run's socket file survives shutdown and would make
         # bind fail EADDRINUSE; only ever unlink an actual socket
         import os

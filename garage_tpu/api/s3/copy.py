@@ -37,6 +37,60 @@ from .get import parse_range
 from .multipart import decode_upload_id, get_upload
 
 
+def _parse_http_date(value: str, header: str) -> float:
+    """HTTP-date → epoch seconds; malformed → 400 (ref copy.rs parse)."""
+    from email.utils import parsedate_to_datetime
+
+    try:
+        return parsedate_to_datetime(value).timestamp()
+    except (TypeError, ValueError):
+        raise BadRequestError(f"Invalid date in {header}")
+
+
+def _etag_list(value: str):
+    return [m.strip().strip('"') for m in value.split(",")]
+
+
+def check_copy_preconditions(ctx, src_version) -> None:
+    """x-amz-copy-source-if-{match,none-match,modified-since,
+    unmodified-since} (ref copy.rs:496-585 CopyPreconditionHeaders).
+    Combination rules follow the reference: if-match overrides
+    if-unmodified-since; if-none-match AND if-modified-since must both
+    hold; other mixes are rejected as 400."""
+    h = ctx.request.headers
+    im = h.get("x-amz-copy-source-if-match")
+    inm = h.get("x-amz-copy-source-if-none-match")
+    ims = h.get("x-amz-copy-source-if-modified-since")
+    ius = h.get("x-amz-copy-source-if-unmodified-since")
+    if im is None and inm is None and ims is None and ius is None:
+        return
+    etag = src_version.etag()
+    v_date = src_version.timestamp / 1000.0
+    ims_t = (_parse_http_date(ims, "x-amz-copy-source-if-modified-since")
+             if ims is not None else None)
+    ius_t = (_parse_http_date(ius, "x-amz-copy-source-if-unmodified-since")
+             if ius is not None else None)
+
+    if im is not None and inm is None and ims is None:
+        ok = any(x == etag or x == "*" for x in _etag_list(im))
+    elif ius is not None and im is None and inm is None and ims is None:
+        ok = v_date <= ius_t
+    elif inm is not None and im is None and ius is None:
+        ok = not any(x == etag or x == "*" for x in _etag_list(inm))
+        if ims is not None:
+            ok = ok and v_date > ims_t
+    elif ims is not None and im is None and inm is None and ius is None:
+        ok = v_date > ims_t
+    else:
+        raise BadRequestError(
+            "Invalid combination of x-amz-copy-source-if-xxxxx headers"
+        )
+    if not ok:
+        from ..common import PreconditionFailedError
+
+        raise PreconditionFailedError("copy source precondition failed")
+
+
 async def _resolve_copy_source(ctx):
     """x-amz-copy-source → (bucket_id, key, object, data version)."""
     src = ctx.request.headers.get("x-amz-copy-source", "")
@@ -56,6 +110,7 @@ async def _resolve_copy_source(ctx):
     version = obj.last_data_version()
     if version is None:
         raise NoSuchKeyError(f"no such key: {src_key}")
+    check_copy_preconditions(ctx, version)
     return src_bucket_id, src_key, obj, version
 
 

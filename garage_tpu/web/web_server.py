@@ -153,12 +153,18 @@ class WebServer:
             if rule is not None:
                 apply_cors_headers(cors_headers, rule, origin)
 
+        def with_cors(r):
+            if cors_headers and not r.prepared:
+                for k, v in cors_headers.items():
+                    r.headers[k] = v
+            return r
+
         resp = await self._get_object(request, bid, key, cors_headers)
         if resp.status == 404 and implicit_redirect is not None:
             redir_key, redir_url = implicit_redirect
             if await self._key_exists(bid, redir_key):
-                return web.Response(
-                    status=302, headers={"Location": redir_url})
+                return with_cors(web.Response(
+                    status=302, headers={"Location": redir_url}))
         if resp.status == 404:
             # error document, still with 404 status (web_server.rs)
             err_key = wc.get("error_document")
@@ -167,11 +173,8 @@ class WebServer:
                     request, bid, err_key, cors_headers)
                 if err_resp.status == 200:
                     err_resp.set_status(404)
-                    return err_resp
-        if cors_headers and not resp.prepared:
-            for k, v in cors_headers.items():
-                resp.headers[k] = v
-        return resp
+                    return with_cors(err_resp)
+        return with_cors(resp)
 
     async def _key_exists(self, bucket_id, key: str) -> bool:
         """ref web_server.rs:212-221 check_key_exists."""

@@ -893,3 +893,62 @@ async def test_s3_server_on_unix_socket(tmp_path):
     await server2.start(f"unix:{tmp_path}/s3b.sock")
     await server2.stop()
     await stop_all(garages, server)
+
+
+async def test_copy_source_preconditions(tmp_path):
+    """x-amz-copy-source-if-* preconditions on CopyObject (ref
+    copy.rs:496-585 CopyPreconditionHeaders)."""
+    from email.utils import formatdate
+
+    garages, server, client, key = await make_api_cluster(tmp_path)
+    await client.req("PUT", "/cpb")
+    st, _, _ = await client.req("PUT", "/cpb/src", body=b"copy me")
+    assert st == 200
+    st, hdrs, _ = await client.req("HEAD", "/cpb/src")
+    etag = hdrs["ETag"].strip('"')
+
+    async def copy(extra):
+        h = {"x-amz-copy-source": "/cpb/src"}
+        h.update(extra)
+        return await client.req("PUT", "/cpb/dst", headers=h)
+
+    # if-match: correct etag ok, wrong etag 412, * ok
+    st, _, _ = await copy({"x-amz-copy-source-if-match": f'"{etag}"'})
+    assert st == 200
+    st, _, _ = await copy({"x-amz-copy-source-if-match": '"deadbeef"'})
+    assert st == 412
+    st, _, _ = await copy({"x-amz-copy-source-if-match": "*"})
+    assert st == 200
+    # if-none-match mirrors
+    st, _, _ = await copy({"x-amz-copy-source-if-none-match": f'"{etag}"'})
+    assert st == 412
+    st, _, _ = await copy({"x-amz-copy-source-if-none-match": '"other"'})
+    assert st == 200
+    # date conditions
+    past = formatdate(0, usegmt=True)
+    future = formatdate(4102444800, usegmt=True)
+    st, _, _ = await copy({"x-amz-copy-source-if-modified-since": past})
+    assert st == 200
+    st, _, _ = await copy({"x-amz-copy-source-if-modified-since": future})
+    assert st == 412
+    st, _, _ = await copy({"x-amz-copy-source-if-unmodified-since": future})
+    assert st == 200
+    st, _, _ = await copy({"x-amz-copy-source-if-unmodified-since": past})
+    assert st == 412
+    # if-match + if-unmodified-since(false): if-match wins (ref comment)
+    st, _, _ = await copy({
+        "x-amz-copy-source-if-match": "*",
+        "x-amz-copy-source-if-unmodified-since": past,
+    })
+    assert st == 200
+    # invalid combination → 400
+    st, _, _ = await copy({
+        "x-amz-copy-source-if-match": "*",
+        "x-amz-copy-source-if-none-match": "*",
+    })
+    assert st == 400
+    # malformed date → 400
+    st, _, _ = await copy(
+        {"x-amz-copy-source-if-modified-since": "not a date"})
+    assert st == 400
+    await stop_all(garages, server)

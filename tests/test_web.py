@@ -117,6 +117,11 @@ async def test_website_multiblock_streaming_and_cors(tmp_path):
     st, hdrs, _ = await wget(
         port, "/", headers={"Origin": "https://app.example"})
     assert hdrs.get("Access-Control-Allow-Origin") == "https://app.example"
+    # ...and on the error-document 404 path too
+    st, hdrs, _ = await wget(
+        port, "/missing.html", headers={"Origin": "https://app.example"})
+    assert st == 404
+    assert hdrs.get("Access-Control-Allow-Origin") == "https://app.example"
     # preflight against the website
     st, hdrs, _ = await wget(
         port, "/big.bin", method="OPTIONS",
