@@ -38,11 +38,16 @@ from .multipart import decode_upload_id, get_upload
 
 
 def _parse_http_date(value: str, header: str) -> float:
-    """HTTP-date → epoch seconds; malformed → 400 (ref copy.rs parse)."""
+    """HTTP-date → epoch seconds; malformed → 400 (ref copy.rs parse).
+    Timezone-less forms (asctime, -0000) are UTC per RFC 9110."""
+    import datetime
     from email.utils import parsedate_to_datetime
 
     try:
-        return parsedate_to_datetime(value).timestamp()
+        dt = parsedate_to_datetime(value)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        return dt.timestamp()
     except (TypeError, ValueError):
         raise BadRequestError(f"Invalid date in {header}")
 
@@ -65,7 +70,9 @@ def check_copy_preconditions(ctx, src_version) -> None:
     if im is None and inm is None and ims is None and ius is None:
         return
     etag = src_version.etag()
-    v_date = src_version.timestamp / 1000.0
+    # second granularity: clients echo Last-Modified (whole seconds) back
+    # into these headers; sub-second remainder must not flip the outcome
+    v_date = src_version.timestamp // 1000
     ims_t = (_parse_http_date(ims, "x-amz-copy-source-if-modified-since")
              if ims is not None else None)
     ius_t = (_parse_http_date(ius, "x-amz-copy-source-if-unmodified-since")

@@ -35,8 +35,6 @@ from ..table import (
     TableShardedReplication,
     TableSyncer,
 )
-from ..table.gc import GcWorker
-from ..table.sync import SyncWorker
 from ..utils.background import BackgroundRunner, BgVars
 from ..utils.config import Config
 from ..utils.persister import Persister
@@ -200,8 +198,13 @@ class Garage:
             t.syncer = TableSyncer(self.system, t.data, t.merkle)
             t.gc = TableGc(self.system, t.data)
             self.bg.spawn(MerkleWorker(t.merkle))
-            self.bg.spawn(SyncWorker(t.syncer))
-            self.bg.spawn(GcWorker(t.gc))
+            # make_worker (NOT a bare SyncWorker): it attaches the worker
+            # to the syncer (admin `repair tables` drives it) and hooks
+            # on_ring_change so a layout change triggers immediate
+            # re-sync + partition offload (ref sync.rs:589-601) instead
+            # of waiting for the anti-entropy timer
+            self.bg.spawn(t.syncer.make_worker())
+            self.bg.spawn(t.gc.make_worker())
             self.bg.spawn(InsertQueueWorker(t))
         # Spawn the max worker count; the active number is the runtime-
         # tunable persisted `n_workers` — idle extras cost one sleeping

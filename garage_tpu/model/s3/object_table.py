@@ -249,11 +249,18 @@ class ObjectTableSchema(TableSchema):
                 old.counts() if old is not None else [],
                 new.counts() if new is not None else [],
             )
-        if old is None:
+        # Deletion propagation requires BOTH old and new rows (ref
+        # object_table.rs:398 `if let (Some(old_v), Some(new_v))`):
+        # new=None means a raw LOCAL deletion — partition offload after a
+        # layout change (sync.rs offload_partition → delete_if_equal) or
+        # GC — where the data still exists on the real replicas.  Treating
+        # it as "all versions deleted" would enqueue version tombstones
+        # that REPLICATE to the version table's replica set and cascade
+        # (version → block_ref → rc → block GC) into cluster-wide data
+        # loss on every layout change.
+        if old is None or new is None:
             return
-        new_by_uuid = (
-            {bytes(v.uuid): v for v in new.versions()} if new is not None else {}
-        )
+        new_by_uuid = {bytes(v.uuid): v for v in new.versions()}
         for ov in old.versions():
             nv = new_by_uuid.get(bytes(ov.uuid))
             # a version that was active and is now gone or aborted must be

@@ -496,3 +496,111 @@ async def test_table_repair_launchers_reap_orphans(tmp_path):
     assert await adm._repair_versions() == 0
     assert await adm._repair_mpu() == 0
     await shutdown(garages)
+
+
+async def test_layout_change_migrates_data(tmp_path):
+    """Cluster elasticity end-to-end (ref staged layout changes +
+    TableSyncer offload + block_ref hook chain; the reference's
+    test-renumbering scenario): add a node -> anti-entropy populates its
+    tables and the ref-count hooks pull the block payloads it now owns;
+    remove a node -> its partitions offload and data stays readable."""
+    import os as _os
+
+    from garage_tpu.rpc.layout import ClusterLayout, NodeRole
+
+    garages = await make_garage_cluster(tmp_path, n=3, mode="3")
+    for g in garages:
+        g.spawn_workers()
+
+    # seed: 8 objects in 8 distinct buckets (distinct partitions), each
+    # with one 5 KiB block
+    buckets = {}
+    blocks = {}
+    for i in range(8):
+        bucket_id = gen_uuid()
+        data = _os.urandom(5000)
+        bh = blake2s_sum(data)
+        await garages[0].block_manager.rpc_put_block(Hash(bh), data)
+        vu = gen_uuid()
+        ver = Version.new(vu, bytes(bucket_id), f"obj{i}")
+        ver.add_block(0, 0, bytes(bh), len(data))
+        await garages[0].version_table.insert(ver)
+        await garages[0].object_table.insert(
+            Object(bucket_id, f"obj{i}", [complete_version(vu, 100 + i, b"x")]))
+        buckets[f"obj{i}"] = bucket_id
+        blocks[f"obj{i}"] = bh
+
+    # --- grow: node 3 joins ------------------------------------------------
+    g3 = Garage(mkconfig(tmp_path, 3))
+    await g3.system.netapp.listen("127.0.0.1:0")
+    port3 = g3.system.netapp._server.sockets[0].getsockname()[1]
+    for g in garages:
+        await g.system.netapp.connect(f"127.0.0.1:{port3}",
+                                      expected_id=g3.system.id)
+    g3.spawn_workers()
+    garages.append(g3)
+
+    lay = ClusterLayout.decode(garages[0].system.layout.encode())
+    lay.stage_role(bytes(g3.system.id), NodeRole("dc1", 1000))
+    lay.apply_staged_changes()
+    enc = lay.encode()
+    for g in garages:
+        g.system.layout = ClusterLayout.decode(enc)
+        g.system._rebuild_ring()  # fires on_ring_change -> full syncs
+
+    ring = g3.system.ring
+
+    from garage_tpu.table.schema import hash_partition_key
+
+    def g3_owns(h) -> bool:
+        return bytes(g3.system.id) in [
+            bytes(n) for n in ring.get_nodes(h, 3)
+        ]
+
+    want_rows = [k for k, b in buckets.items()
+                 if g3_owns(hash_partition_key(b))]
+    want_blocks = [k for k, bh in blocks.items() if g3_owns(Hash(bh))]
+    assert want_rows and want_blocks, "new node owns nothing?! (ring bug)"
+    # anti-entropy must copy the table rows; the block_ref updated() hook
+    # on g3 increfs and resync fetches the payloads it now owns
+    for _ in range(200):
+        have_rows = sum(
+            1 for k in want_rows
+            if any(g3.object_table.data.decode_entry(raw).key == k
+                   for _x, raw in g3.object_table.data.store.items(b"", None))
+        )
+        have_blocks = sum(
+            1 for k in want_blocks
+            if g3.block_manager.is_block_present(Hash(blocks[k]))
+        )
+        if have_rows == len(want_rows) and have_blocks == len(want_blocks):
+            break
+        await asyncio.sleep(0.25)
+    assert have_rows == len(want_rows), \
+        f"{have_rows}/{len(want_rows)} rows on new node"
+    assert have_blocks == len(want_blocks), \
+        f"{have_blocks}/{len(want_blocks)} blocks on new node"
+
+    # --- shrink: node 0 leaves --------------------------------------------
+    g0 = garages[0]
+    lay = ClusterLayout.decode(g0.system.layout.encode())
+    lay.stage_role(bytes(g0.system.id), None)
+    lay.apply_staged_changes()
+    enc = lay.encode()
+    for g in garages:
+        g.system.layout = ClusterLayout.decode(enc)
+        g.system._rebuild_ring()
+
+    # node0's syncer offloads partitions it no longer owns: its local
+    # object table empties while the data stays readable cluster-wide
+    for _ in range(200):
+        left = len(list(g0.object_table.data.store.items(b"", None)))
+        if left == 0:
+            break
+        await asyncio.sleep(0.25)
+    assert left == 0, f"{left} rows still on removed node"
+    for i in range(8):
+        obj = await garages[2].object_table.get(
+            buckets[f"obj{i}"], f"obj{i}")
+        assert obj is not None and obj.last_data_version() is not None
+    await shutdown(garages)
