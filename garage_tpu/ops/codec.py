@@ -36,7 +36,13 @@ class CodecParams:
     compression_level: Optional[int] = 1
     batch_blocks: int = 256
     shard_mesh: int = 1       # devices to shard codec batches over (tpu)
-    hybrid_group_blocks: int = 64   # work-stealing quantum (hybrid backend)
+    # Work-stealing quantum (hybrid backend): 16 blocks = 2 RS codewords.
+    # Measured on the 1-core host + metered TPU link: small groups keep the
+    # CPU side's working set cache-resident (hash + GF encode reuse the
+    # same bytes) — 64-block groups ran ~35% slower end-to-end — while
+    # staying coarse enough that per-group device transfer overhead stays
+    # negligible.
+    hybrid_group_blocks: int = 16
     hybrid_window: int = 1          # device in-flight groups (hybrid backend)
 
 

@@ -219,18 +219,19 @@ class HybridCodec(BlockCodec):
                         item = inflight.popleft()
                         self._tpu_collect(item, set_result, fetch_parity)
                         # Give up on a pathologically slow link: feeding it
-                        # costs host CPU (transfer staging/protocol) that
-                        # the CPU verifier could spend directly.  If one
-                        # group's turnaround exceeds what the CPU needs for
-                        # TWO groups at its observed rate, stop feeding —
-                        # the CPU absorbs the rest, bounding the worst case
-                        # near the pure-CPU floor while keeping the upside
-                        # of a healthy link.
+                        # costs host CPU (transfer staging ≈ one memcpy per
+                        # group, a few % of a CPU verify) that the verifier
+                        # could spend directly.  Staging costs ~3% of a
+                        # CPU group, so ANY device rate above ~5% of the
+                        # CPU's is net-positive — only below that does
+                        # ceding to the CPU win.  (A 2× threshold here once
+                        # dropped a link running at 18% of CPU rate, wasting
+                        # its entire contribution.)
                         collect_dt = time.monotonic() - t_c
                         cpu_dt = time.monotonic() - cpu_t0
                         cpu_rate = (cpu_bytes_this_call[0] / cpu_dt
                                     if cpu_dt > 0 else 0.0)
-                        if cpu_rate > 0 and collect_dt > 2 * item[4] / cpu_rate:
+                        if cpu_rate > 0 and collect_dt > 20 * item[4] / cpu_rate:
                             logger.info(
                                 "hybrid feeder: link too slow (%.0f KiB/s), "
                                 "ceding remaining groups to CPU",

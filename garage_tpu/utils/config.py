@@ -71,7 +71,9 @@ class CodecConfig:
     rs_parity: int = 4              # Reed-Solomon m
     batch_blocks: int = 256         # blocks per device batch (scrub/resync producers)
     shard_mesh: int = 1             # devices to shard codec batches over
-    hybrid_group_blocks: int = 64   # hybrid backend: work-stealing quantum
+    # hybrid backend work-stealing quantum; MUST track the CodecParams
+    # default (codec.py) — 16 keeps the CPU side cache-resident
+    hybrid_group_blocks: int = 16
     hybrid_window: int = 1          # hybrid backend: device in-flight groups
 
     def make(self, compression_level: Optional[int] = 1):

@@ -361,3 +361,44 @@ async def test_scrub_with_hybrid_codec(tmp_path):
     assert scrub.state.corruptions == 2
     assert sum(1 for h in hashes if m.is_block_present(h)) == 22
     await shutdown(systems)
+
+
+async def test_rebalance_moves_blocks_to_primary_dir(tmp_path):
+    """Multi-drive rebalance (ref repair.rs:531-626): after a drive-layout
+    change, RebalanceWorker moves each block file into its new primary
+    directory and the manager still finds/reads every block."""
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    hashes = []
+    for _ in range(24):
+        d = os.urandom(2000)
+        h = blake2s_sum(d)
+        hashes.append((h, d))
+        await m.write_block(h, DataBlock.plain(d))
+
+    # add a second, much larger drive: most partitions move primary
+    d1 = m.data_layout.data_dirs[0].path
+    d2 = str(tmp_path / "drive2")
+    new_dirs = [{"path": d1, "capacity": 100},
+                {"path": d2, "capacity": 900}]
+    m.data_layout = m.data_layout.update(new_dirs)
+    os.makedirs(d2, exist_ok=True)
+
+    moved_expected = [
+        h for h, _d in hashes
+        if m.data_layout.primary_dir(h) != d1
+    ]
+    assert moved_expected, "bigger drive must take over some partitions"
+
+    w = RebalanceWorker(m)
+    while (await w.work()).name != "DONE":
+        pass
+    assert w.moved >= len(moved_expected)
+
+    for h, d in hashes:
+        path, compressed = m.find_block(h)
+        assert path.startswith(m.data_layout.primary_dir(h))
+        assert not compressed
+        block = await m.read_block(h)
+        assert block.inner == d
+    await shutdown(systems)

@@ -465,6 +465,12 @@ async def test_table_repair_launchers_reap_orphans(tmp_path):
     g.spawn_workers()
     adm = AdminRpcHandler(g, register_endpoint=False)
 
+    # `repair tables` actually fills every syncer's todo (it was once a
+    # silent no-op when spawn_workers bypassed make_worker)
+    await adm._cmd_launch_repair({"what": "tables"})
+    assert all(t.syncer.worker is not None and t.syncer.worker.todo
+               for t in g.tables)
+
     bucket_id = gen_uuid()
     # orphan version: no object row carries its uuid
     vu = gen_uuid()
