@@ -21,6 +21,10 @@ import tomllib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ..ops.codec import CodecParams as _CodecParams
+
+_CODEC_DEFAULTS = _CodecParams()
+
 
 class ConfigError(Exception):
     pass
@@ -71,9 +75,9 @@ class CodecConfig:
     rs_parity: int = 4              # Reed-Solomon m
     batch_blocks: int = 256         # blocks per device batch (scrub/resync producers)
     shard_mesh: int = 1             # devices to shard codec batches over
-    # hybrid backend work-stealing quantum; MUST track the CodecParams
-    # default (codec.py) — 16 keeps the CPU side cache-resident
-    hybrid_group_blocks: int = 16
+    # hybrid backend work-stealing quantum; single source of truth is the
+    # CodecParams default (codec.py: cache-resident CPU-side groups)
+    hybrid_group_blocks: int = _CODEC_DEFAULTS.hybrid_group_blocks
     hybrid_window: int = 1          # hybrid backend: device in-flight groups
 
     def make(self, compression_level: Optional[int] = 1):
