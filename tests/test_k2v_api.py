@@ -175,3 +175,61 @@ async def test_read_only_key_permissions(tmp_path):
     assert ei.value.status == 403
     await srv.stop()
     await g.shutdown()
+
+
+async def test_poll_range_seen_marker_continuation(tmp_path):
+    """PollRange with seen markers (ref api/k2v/range.rs + k2v/seen.rs):
+    an unmarked poll returns current items + a marker; polling with that
+    marker blocks until something NEW appears in the range, and the new
+    marker suppresses everything already seen."""
+    g, srv, c, _k = await make_k2v(tmp_path)
+    await c.insert_item("pr", "a", b"va")
+    await c.insert_item("pr", "b", b"vb")
+
+    # first poll: everything is new
+    out = await c.poll_range("pr", timeout=5.0)
+    assert out is not None
+    keys = {i["sk"] for i in out["items"]}
+    assert keys == {"a", "b"}
+    marker = out["seenMarker"]
+
+    # nothing new → timeout (304 → None)
+    out2 = await c.poll_range("pr", seen_marker=marker, timeout=1.0)
+    assert out2 is None
+
+    # a concurrent write wakes the poll; only the NEW item is delivered
+    async def update_later():
+        await asyncio.sleep(0.3)
+        await c.insert_item("pr", "c", b"vc")
+
+    upd = asyncio.ensure_future(update_later())
+    out3 = await c.poll_range("pr", seen_marker=marker, timeout=10.0)
+    await upd
+    assert out3 is not None
+    assert {i["sk"] for i in out3["items"]} == {"c"}
+
+    # overwriting an already-seen key is ALSO new (causality advanced)
+    item_a = await c.read_item("pr", "a")
+    marker3 = out3["seenMarker"]
+
+    async def update_a():
+        await asyncio.sleep(0.3)
+        await c.insert_item("pr", "a", b"va2", token=str(item_a.token))
+
+    upd = asyncio.ensure_future(update_a())
+    out4 = await c.poll_range("pr", seen_marker=marker3, timeout=10.0)
+    await upd
+    assert out4 is not None and {i["sk"] for i in out4["items"]} == {"a"}
+
+    # prefix filter: a write outside the prefix does not wake the poll
+    async def update_outside():
+        await asyncio.sleep(0.3)
+        await c.insert_item("pr", "zzz", b"zz")
+
+    upd = asyncio.ensure_future(update_outside())
+    out5 = await c.poll_range("pr", seen_marker=out4["seenMarker"],
+                              prefix="a", timeout=1.2)
+    await upd
+    assert out5 is None
+    await srv.stop()
+    await g.shutdown()
