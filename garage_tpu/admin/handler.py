@@ -13,7 +13,7 @@ import logging
 from typing import Any, Dict, List, Optional
 
 from ..model.permission import BucketKeyPerm
-from ..rpc.layout import NodeRole
+from ..rpc.layout import LayoutParameters, NodeRole
 from ..utils.crdt import now_msec
 from ..utils.data import Hash, Uuid
 from ..utils.error import GarageError
@@ -63,6 +63,14 @@ class AdminRpcHandler:
                 nid.hex(): ([r.zone, r.capacity, r.tags] if r else None)
                 for nid, r in sys.layout.staged_roles().items()
             },
+            "parameters": {
+                "zone_redundancy": sys.layout.parameters.zone_redundancy,
+            },
+            "staged_parameters": {
+                "zone_redundancy": LayoutParameters.unpack(
+                    sys.layout.staging_parameters.value
+                ).zone_redundancy,
+            },
             "health": {
                 "status": h.status,
                 "known_nodes": h.known_nodes,
@@ -97,6 +105,31 @@ class AdminRpcHandler:
             sys.layout.stage_role(nid, role)
         sys.save_layout()
         return "staged"
+
+    async def _cmd_layout_config(self, msg) -> str:
+        """Stage layout parameters (ref cli/layout.rs LayoutConfig:
+        currently zone redundancy — 'maximum' or an integer ≥ 1)."""
+        zr = msg.get("zone_redundancy")
+        if zr is None:
+            raise GarageError("nothing to configure (need zone-redundancy)")
+        if zr != "maximum":
+            try:
+                zr = int(zr)
+            except (TypeError, ValueError):
+                raise GarageError(
+                    f"zone-redundancy must be 'maximum' or an integer, "
+                    f"got {zr!r}")
+            factor = self.garage.replication_mode.replication_factor
+            if not 1 <= zr <= factor:
+                # ref cli/layout.rs rejects out-of-range values at config
+                # time; accepting them would silently clamp at apply
+                raise GarageError(
+                    f"zone-redundancy must be in [1, {factor}] "
+                    f"(the replication factor), or 'maximum'")
+        sys = self.garage.system
+        sys.layout.stage_parameters(LayoutParameters(zone_redundancy=zr))
+        sys.save_layout()
+        return f"staged zone-redundancy = {zr}"
 
     async def _cmd_layout_apply(self, msg) -> List[str]:
         sys = self.garage.system

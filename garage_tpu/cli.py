@@ -68,6 +68,9 @@ def _build_parser() -> argparse.ArgumentParser:
     lr = ls.add_parser("remove")
     lr.add_argument("node")
     ls.add_parser("show")
+    lcf = ls.add_parser("config", help="stage layout parameters")
+    lcf.add_argument("-z", "--zone-redundancy", default=None,
+                     help="'maximum' or an integer >= 1")
     lap = ls.add_parser("apply")
     lap.add_argument("--version", type=int, default=None)
     lrv = ls.add_parser("revert")
@@ -328,10 +331,19 @@ async def _amain(args) -> None:
             print(await client.call({
                 "cmd": "layout_assign", "node": args.node, "remove": True,
             }))
+        elif lc == "config":
+            print(await client.call({
+                "cmd": "layout_config",
+                "zone_redundancy": args.zone_redundancy,
+            }))
         elif lc == "show":
             st = await client.call({"cmd": "status"})
-            print(json.dumps({"roles": st["roles"], "staged": st["staged"],
-                              "version": st["layout_version"]}, indent=2))
+            print(json.dumps({
+                "roles": st["roles"], "staged": st["staged"],
+                "version": st["layout_version"],
+                "parameters": st.get("parameters"),
+                "staged_parameters": st.get("staged_parameters"),
+            }, indent=2))
         elif lc == "apply":
             for m in await client.call({"cmd": "layout_apply", "version": args.version}):
                 print(m)

@@ -200,3 +200,41 @@ async def test_admin_connect_endpoint(tmp_path):
     await srv2.stop()
     await g1.shutdown()
     await g2.shutdown()
+
+
+async def test_layout_config_zone_redundancy(tmp_path):
+    """`layout config -z` stages the zone-redundancy parameter; apply
+    activates it (ref cli/layout.rs LayoutConfig)."""
+    from garage_tpu.admin.handler import AdminRpcHandler
+    from garage_tpu.utils.error import GarageError
+
+    g, srv = await make_admin(tmp_path)
+    adm = AdminRpcHandler(g, register_endpoint=False)
+
+    out = await adm._cmd_layout_config({"zone_redundancy": "1"})
+    assert "staged zone-redundancy = 1" in out
+    # staged value is visible in status before apply
+    st = await adm._cmd_status({})
+    assert st["staged_parameters"]["zone_redundancy"] == 1
+    assert st["parameters"]["zone_redundancy"] == "maximum"
+    await adm._cmd_layout_apply({"version": g.system.layout.version + 1})
+    assert g.system.layout.parameters.zone_redundancy == 1
+
+    out = await adm._cmd_layout_config({"zone_redundancy": "maximum"})
+    await adm._cmd_layout_apply({"version": g.system.layout.version + 1})
+    assert g.system.layout.parameters.zone_redundancy == "maximum"
+
+    import pytest as _pytest
+
+    with _pytest.raises(GarageError):
+        await adm._cmd_layout_config({"zone_redundancy": "0"})
+    with _pytest.raises(GarageError):
+        # above the replication factor (1 here): rejected at config time,
+        # not silently clamped at apply (ref cli/layout.rs)
+        await adm._cmd_layout_config({"zone_redundancy": "2"})
+    with _pytest.raises(GarageError):
+        await adm._cmd_layout_config({"zone_redundancy": "lots"})
+    with _pytest.raises(GarageError):
+        await adm._cmd_layout_config({})
+    await srv.stop()
+    await g.shutdown()
