@@ -20,6 +20,32 @@ from ..utils.error import GarageError
 
 logger = logging.getLogger("garage_tpu.admin")
 
+_DURATION_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 7 * 86400}
+
+
+def _parse_duration(v) -> float:
+    """'30s' / '15m' / '2h' / '1d' / '1w' or plain seconds (ref uses the
+    parse_duration crate for --older-than).  Must be finite and ≥ 0 — a
+    negative duration would put the cutoff in the future and abort
+    in-flight uploads."""
+    import math
+
+    s = str(v).strip()
+    out = None
+    try:
+        out = float(s)
+    except ValueError:
+        unit = _DURATION_UNITS.get(s[-1:])
+        if unit is not None:
+            try:
+                out = float(s[:-1]) * unit
+            except ValueError:
+                pass
+    if out is None or not math.isfinite(out) or out < 0:
+        raise GarageError(
+            f"invalid duration {v!r} (use e.g. 30s, 15m, 2h, 1d)")
+    return out
+
 
 class AdminRpcHandler:
     def __init__(self, garage, register_endpoint: bool = True):
@@ -66,11 +92,16 @@ class AdminRpcHandler:
             "parameters": {
                 "zone_redundancy": sys.layout.parameters.zone_redundancy,
             },
-            "staged_parameters": {
-                "zone_redundancy": LayoutParameters.unpack(
+            # only a GENUINE pending change (staging resets to the active
+            # parameters on apply/revert; echoing it back always would
+            # make every `layout show` look like a change is pending)
+            "staged_parameters": (
+                {"zone_redundancy": staged_zr}
+                if (staged_zr := LayoutParameters.unpack(
                     sys.layout.staging_parameters.value
-                ).zone_redundancy,
-            },
+                ).zone_redundancy) != sys.layout.parameters.zone_redundancy
+                else None
+            ),
             "health": {
                 "status": h.status,
                 "known_nodes": h.known_nodes,
@@ -599,6 +630,54 @@ class AdminRpcHandler:
                 )
                 n += 1
         return n
+
+    async def _cmd_bucket_cleanup_uploads(self, msg) -> str:
+        """Abort multipart uploads older than a duration in the given
+        buckets (ref cli structs.rs CleanupIncompleteUploadsOpt +
+        admin/bucket.rs handle_bucket_cleanup_incomplete_uploads).
+        Aborting the object's Uploading version cascades through the
+        hooks: MPU row tombstones, part versions delete, refs drop."""
+        from ..model.s3.object_table import Object, ObjectVersion
+
+        names = msg.get("buckets") or []
+        if not names:
+            raise GarageError("no buckets given")
+        older = _parse_duration(msg.get("older_than", "1d"))
+        cutoff = now_msec() - int(older * 1000)
+        g = self.garage
+        # resolve EVERY name before mutating anything (ref admin/bucket.rs
+        # does the same): a typo in a later name must not leave earlier
+        # buckets half-cleaned with no report
+        resolved = []
+        for name in names:
+            bid = await self.helper.resolve_global_bucket_name(name)
+            if bid is None:
+                raise GarageError(f"bucket not found: {name}")
+            resolved.append((name, bid))
+        lines = []
+        for name, bid in resolved:
+            count = 0
+            pos = ""
+            while True:
+                batch = await g.object_table.get_range(
+                    bid, pos, filter="any", limit=1000
+                )
+                for obj in batch:
+                    aborted = [
+                        ObjectVersion(v.uuid, v.timestamp, ["aborted"])
+                        for v in obj.versions()
+                        if v.is_uploading() and v.timestamp < cutoff
+                    ]
+                    if aborted:
+                        await g.object_table.insert(
+                            Object(obj.bucket_id, obj.key, aborted)
+                        )
+                        count += len(aborted)
+                if len(batch) < 1000:
+                    break
+                pos = batch[-1].key + "\x00"
+            lines.append(f"{name}: {count} incomplete uploads aborted")
+        return "\n".join(lines)
 
     async def _repair_mpu(self) -> int:
         """Tombstone multipart uploads whose object row no longer carries

@@ -106,6 +106,13 @@ def _build_parser() -> argparse.ArgumentParser:
     bq.add_argument("bucket")
     bq.add_argument("--max-size", default=None)
     bq.add_argument("--max-objects", type=int, default=None)
+    bcu = bs.add_parser(
+        "cleanup-incomplete-uploads",
+        help="abort multipart uploads older than --older-than",
+    )
+    bcu.add_argument("buckets", nargs="+")
+    bcu.add_argument("--older-than", default="1d",
+                     help="e.g. 30s, 15m, 2h, 1d, 1w (default 1d)")
 
     pk = sub.add_parser("key", help="API key operations")
     ks = pk.add_subparsers(dest="key_cmd", required=True)
@@ -390,6 +397,11 @@ async def _amain(args) -> None:
                 "cmd": "bucket_set_quotas", "bucket": args.bucket,
                 "max_size": parse_capacity(args.max_size) if args.max_size else None,
                 "max_objects": args.max_objects,
+            }))
+        elif bc == "cleanup-incomplete-uploads":
+            print(await client.call({
+                "cmd": "bucket_cleanup_uploads", "buckets": args.buckets,
+                "older_than": args.older_than,
             }))
         return
 

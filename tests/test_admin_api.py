@@ -213,12 +213,14 @@ async def test_layout_config_zone_redundancy(tmp_path):
 
     out = await adm._cmd_layout_config({"zone_redundancy": "1"})
     assert "staged zone-redundancy = 1" in out
-    # staged value is visible in status before apply
+    # staged value is visible in status before apply, and cleared after
     st = await adm._cmd_status({})
     assert st["staged_parameters"]["zone_redundancy"] == 1
     assert st["parameters"]["zone_redundancy"] == "maximum"
     await adm._cmd_layout_apply({"version": g.system.layout.version + 1})
     assert g.system.layout.parameters.zone_redundancy == 1
+    st = await adm._cmd_status({})
+    assert st["staged_parameters"] is None  # nothing pending anymore
 
     out = await adm._cmd_layout_config({"zone_redundancy": "maximum"})
     await adm._cmd_layout_apply({"version": g.system.layout.version + 1})
@@ -236,5 +238,73 @@ async def test_layout_config_zone_redundancy(tmp_path):
         await adm._cmd_layout_config({"zone_redundancy": "lots"})
     with _pytest.raises(GarageError):
         await adm._cmd_layout_config({})
+    await srv.stop()
+    await g.shutdown()
+
+
+async def test_bucket_cleanup_incomplete_uploads(tmp_path):
+    """`bucket cleanup-incomplete-uploads --older-than` aborts stale MPUs
+    and the hook cascade tombstones their rows (ref admin/bucket.rs
+    handle_bucket_cleanup_incomplete_uploads)."""
+    import asyncio
+
+    from garage_tpu.admin.handler import AdminRpcHandler, _parse_duration
+    from garage_tpu.model.s3.mpu_table import MultipartUpload
+    from garage_tpu.model.s3.object_table import Object, ObjectVersion
+    from garage_tpu.utils.crdt import now_msec
+    from garage_tpu.utils.data import gen_uuid
+    from garage_tpu.utils.error import GarageError
+
+    assert _parse_duration("30s") == 30
+    assert _parse_duration("2h") == 7200
+    assert _parse_duration("1d") == 86400
+    assert _parse_duration("90") == 90
+    import pytest as _pytest
+
+    with _pytest.raises(GarageError):
+        _parse_duration("eleventy")
+    with _pytest.raises(GarageError):
+        _parse_duration("-1h")  # future cutoff would abort live uploads
+    with _pytest.raises(GarageError):
+        _parse_duration("inf")
+
+    g, srv = await make_admin(tmp_path)
+    g.spawn_workers()
+    adm = AdminRpcHandler(g, register_endpoint=False)
+    helper = g.helper()
+    bucket = await helper.create_bucket("cub")
+
+    # one stale upload (2h old) and one fresh
+    stale_id, fresh_id = gen_uuid(), gen_uuid()
+    old_ts = now_msec() - 2 * 3600 * 1000
+    await g.object_table.insert(Object(bucket.id, "stale.bin", [
+        ObjectVersion.uploading(stale_id, old_ts, True, {})]))
+    await g.mpu_table.insert(
+        MultipartUpload(stale_id, old_ts, bytes(bucket.id), "stale.bin"))
+    await g.object_table.insert(Object(bucket.id, "fresh.bin", [
+        ObjectVersion.uploading(fresh_id, now_msec(), True, {})]))
+    await g.mpu_table.insert(
+        MultipartUpload(fresh_id, now_msec(), bytes(bucket.id), "fresh.bin"))
+
+    out = await adm._cmd_bucket_cleanup_uploads(
+        {"buckets": ["cub"], "older_than": "1h"})
+    assert "cub: 1 incomplete uploads aborted" in out
+
+    obj = await g.object_table.get(bucket.id, "stale.bin")
+    assert all(v.is_aborted() for v in obj.versions())
+    obj = await g.object_table.get(bucket.id, "fresh.bin")
+    assert any(v.is_uploading() for v in obj.versions())
+    # the hook cascade tombstones the stale MPU row
+    for _ in range(80):
+        mpu = await g.mpu_table.get(stale_id, "")
+        if mpu is not None and mpu.deleted.value:
+            break
+        await asyncio.sleep(0.05)
+    assert mpu.deleted.value
+    assert not (await g.mpu_table.get(fresh_id, "")).deleted.value
+
+    with _pytest.raises(GarageError, match="not found"):
+        await adm._cmd_bucket_cleanup_uploads(
+            {"buckets": ["nope"], "older_than": "1h"})
     await srv.stop()
     await g.shutdown()
