@@ -637,7 +637,7 @@ class AdminRpcHandler:
         admin/bucket.rs handle_bucket_cleanup_incomplete_uploads).
         Aborting the object's Uploading version cascades through the
         hooks: MPU row tombstones, part versions delete, refs drop."""
-        from ..model.s3.object_table import Object, ObjectVersion
+        from ..model.s3.object_table import abort_uploads
 
         names = msg.get("buckets") or []
         if not names:
@@ -659,20 +659,17 @@ class AdminRpcHandler:
             count = 0
             pos = ""
             while True:
+                # node-side "uploading" filter: only rows with an
+                # in-progress upload leave the replicas (a bucket of
+                # inline objects must not cross the wire to abort 3 MPUs)
                 batch = await g.object_table.get_range(
-                    bid, pos, filter="any", limit=1000
+                    bid, pos, filter="uploading", limit=1000
                 )
                 for obj in batch:
-                    aborted = [
-                        ObjectVersion(v.uuid, v.timestamp, ["aborted"])
-                        for v in obj.versions()
-                        if v.is_uploading() and v.timestamp < cutoff
-                    ]
-                    if aborted:
-                        await g.object_table.insert(
-                            Object(obj.bucket_id, obj.key, aborted)
-                        )
-                        count += len(aborted)
+                    count += await abort_uploads(
+                        g.object_table, obj,
+                        lambda v: v.timestamp < cutoff,
+                    )
                 if len(batch) < 1000:
                     break
                 pos = batch[-1].key + "\x00"
@@ -744,8 +741,12 @@ class AdminRpcHandler:
                 "gc_todo": t.data.gc_todo_len(),
                 "insert_queue": len(t.data.insert_queue),
             }
+        from .. import FEATURES, __version__
+
         return {
             "node_id": bytes(g.system.id).hex(),
+            "garage_version": __version__,
+            "features": FEATURES,
             "tables": table_stats,
             "block": {
                 "rc_entries": g.block_manager.rc_len(),

@@ -22,7 +22,13 @@ from .utils.format_table import format_table
 
 
 def _build_parser() -> argparse.ArgumentParser:
+    from . import FEATURES, __version__
+
     p = argparse.ArgumentParser(prog="garage_tpu")
+    p.add_argument(
+        "-V", "--version", action="version",
+        version=f"garage_tpu {__version__} [features: {', '.join(FEATURES)}]",
+    )
     p.add_argument("-c", "--config", default=os.environ.get(
         "GARAGE_TPU_CONFIG", "./garage.toml"
     ))

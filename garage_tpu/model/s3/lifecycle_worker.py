@@ -208,21 +208,19 @@ class LifecycleWorker(Worker):
 
             abort_days = rule.get("abort_incomplete_days")
             if abort_days is not None:
-                aborted = [
-                    ObjectVersion(v.uuid, v.timestamp, ["aborted"])
-                    for v in obj.versions()
-                    if v.is_uploading()
-                    and (now_date - next_date(v.timestamp)).days >= abort_days
-                ]
-                if aborted:
+                from .object_table import abort_uploads
+
+                n = await abort_uploads(
+                    self.garage.object_table, obj,
+                    lambda v: (now_date - next_date(v.timestamp)).days
+                    >= abort_days,
+                )
+                if n:
                     logger.info(
                         "lifecycle: aborting %d stale upload(s) of %s",
-                        len(aborted), obj.key,
+                        n, obj.key,
                     )
-                    await self.garage.object_table.insert(
-                        Object(obj.bucket_id, obj.key, aborted)
-                    )
-                    self.mpu_aborted += len(aborted)
+                    self.mpu_aborted += n
         return False
 
     @staticmethod

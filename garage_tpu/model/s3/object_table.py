@@ -292,4 +292,27 @@ class ObjectTableSchema(TableSchema):
     def matches_filter(self, entry: Object, filter: Any) -> bool:
         if filter is None:
             return entry.last_data_version() is not None
+        if filter == "uploading":
+            # node-side (ref ObjectFilter::IsUploading): rows without an
+            # in-progress upload never leave the replica — a cleanup scan
+            # must not ship a bucket's inline object bytes over RPC
+            return any(v.is_uploading() for v in entry.versions())
         return True
+
+
+async def abort_uploads(object_table, obj: Object, predicate) -> int:
+    """Abort every in-progress upload version of `obj` that matches
+    `predicate(version)`.  Inserting the aborted versions rides the
+    updated() hook cascade: MPU rows tombstone, part versions and their
+    block refs drop.  Shared by the lifecycle worker's
+    abort-incomplete-multipart-upload rule and the admin
+    `bucket cleanup-incomplete-uploads` command — the CRDT state literal
+    and the cascade contract live in exactly one place."""
+    aborted = [
+        ObjectVersion(v.uuid, v.timestamp, ["aborted"])
+        for v in obj.versions()
+        if v.is_uploading() and predicate(v)
+    ]
+    if aborted:
+        await object_table.insert(Object(obj.bucket_id, obj.key, aborted))
+    return len(aborted)
