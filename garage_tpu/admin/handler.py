@@ -670,7 +670,10 @@ class AdminRpcHandler:
                         g.object_table, obj,
                         lambda v: v.timestamp < cutoff,
                     )
-                if len(batch) < 1000:
+                # loop until an EMPTY page: with a node-side filter the
+                # coordinator re-filters after quorum merge, so a short
+                # page does not mean the range is exhausted
+                if not batch:
                     break
                 pos = batch[-1].key + "\x00"
             lines.append(f"{name}: {count} incomplete uploads aborted")
@@ -755,5 +758,11 @@ class AdminRpcHandler:
                 "bytes_read": g.block_manager.bytes_read,
                 "bytes_written": g.block_manager.bytes_written,
                 "corruptions": g.block_manager.corruptions,
+                "parity_indexed": (
+                    g.block_manager.parity_store.stats()["indexed_blocks"]
+                    if g.block_manager.parity_store else 0
+                ),
+                "local_reconstructions":
+                    g.block_manager.blocks_reconstructed,
             },
         }

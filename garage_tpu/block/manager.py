@@ -86,6 +86,9 @@ class BlockManager:
 
         # attached after construction (circular dep): BlockResyncManager
         self.resync = None
+        # attached by Garage when RS parity sidecars are enabled
+        self.parity_store = None
+        self.blocks_reconstructed = 0
 
         # metrics counters (ref block/metrics.rs:7-127)
         self.bytes_read = 0
@@ -108,6 +111,12 @@ class BlockManager:
                     fn=lambda: self.bytes_written)
             m.gauge("block_corruptions_total", "Corrupted blocks detected",
                     fn=lambda: self.corruptions)
+            m.gauge("block_parity_indexed", "Blocks covered by RS parity sidecars",
+                    fn=lambda: (self.parity_store.stats()["indexed_blocks"]
+                                if self.parity_store else 0))
+            m.gauge("block_local_reconstructions_total",
+                    "Blocks rebuilt locally from RS parity",
+                    fn=lambda: self.blocks_reconstructed)
             self.m_read_dur = m.histogram(
                 "block_read_duration_seconds", "Local block read+verify")
             self.m_write_dur = m.histogram(

@@ -1,0 +1,279 @@
+"""ParityStore — local Reed-Solomon sidecars for scrub-time self-repair.
+
+The reference repairs a corrupted block only by refetching it from a
+replica (ref src/block/resync.rs:457-468); if every replica is
+unreachable or equally damaged, the data is gone.  Here the scrub
+worker's fused verify+encode pass (the BlockCodec north star) already
+computes RS(k, m) parity over each codeword of k blocks — this module
+persists that parity as a local sidecar so a corrupted or lost block can
+be **reconstructed on this node alone**, with zero network, as long as
+≥ k of the codeword's k+m pieces survive.  Network resync remains the
+fallback; the sidecar is a best-effort cache refreshed on every scrub
+pass.
+
+Layout: one msgpack manifest per codeword under
+`<data_dir>/parity/xx/<group_id>.par` (group_id = blake2s over the
+member hashes), plus a small db tree mapping block hash → group file so
+repair can find a block's codeword in O(1).  Data shards are the member
+blocks themselves (zero-padded to the codeword width), read back from
+the block store and re-verified by content hash at reconstruction time;
+parity shards carry their own checksums.  Any mismatch disqualifies the
+piece — reconstruction either produces a block whose hash matches, or
+fails loudly and the caller falls back to the network.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional, Sequence
+
+import msgpack
+import numpy as np
+
+from ..utils.data import Hash, blake2s_sum, block_hash
+from .block import DataBlock
+
+logger = logging.getLogger("garage_tpu.block.parity")
+
+MANIFEST_VERSION = 1
+
+
+class ParityStore:
+    def __init__(self, manager, db, codec):
+        from ..db.counted_tree import CountedTree
+
+        self.manager = manager
+        self.codec = codec
+        # CountedTree: the coverage gauge reads len() per metrics scrape,
+        # and sqlite COUNT(*) is O(n)
+        self.index = CountedTree(db.open_tree("block_parity_index"))
+        # new sidecars go to the first WRITABLE data dir (a drained
+        # read_only drive must not keep accumulating them); lookups and
+        # the purge walk EVERY dir so sidecars written before a drain or
+        # layout change stay reachable and collectable
+        dirs = manager.data_layout.data_dirs
+        root = next(
+            (d.path for d in dirs if not d.read_only), dirs[0].path
+        )
+        self.dir = os.path.join(root, "parity")
+        self.all_dirs = [os.path.join(d.path, "parity") for d in dirs]
+
+    # --- write path (scrub) ------------------------------------------------
+
+    def _group_path(self, gid: bytes) -> str:
+        """Write location for a group (the writable dir)."""
+        hx = gid.hex()
+        return os.path.join(self.dir, hx[:2], hx + ".par")
+
+    def _find_group_path(self, gid: bytes) -> Optional[str]:
+        """Read location: search every data dir's parity tree."""
+        hx = gid.hex()
+        for base in self.all_dirs:
+            p = os.path.join(base, hx[:2], hx + ".par")
+            if os.path.exists(p):
+                return p
+        return None
+
+    def put_codeword(
+        self,
+        hashes: Sequence[Hash],
+        lengths: Sequence[int],
+        parity: np.ndarray,
+    ) -> None:
+        """Persist one codeword's parity: `hashes`/`lengths` are the k
+        member blocks in codeword order, `parity` is (m, maxlen) uint8.
+        Called by the scrub worker for rows whose members all verified."""
+        k = len(hashes)
+        gid = blake2s_sum(b"".join(bytes(h) for h in hashes))
+        manifest = {
+            "v": MANIFEST_VERSION,
+            "k": k,
+            "m": int(parity.shape[0]),
+            "maxlen": int(parity.shape[1]),
+            "hashes": [bytes(h) for h in hashes],
+            "lengths": [int(n) for n in lengths],
+            "parity": [parity[i].tobytes() for i in range(parity.shape[0])],
+            "parity_sums": [
+                bytes(blake2s_sum(parity[i].tobytes()))
+                for i in range(parity.shape[0])
+            ],
+        }
+        existing = self._find_group_path(bytes(gid))
+        if existing is not None:
+            # gid is a hash of the member set, so an existing file has
+            # identical content: a fresh mtime (what the purge keys on)
+            # is all a stable codeword needs — skip rewriting ~m/k of
+            # the dataset every scrub pass
+            try:
+                os.utime(existing)
+            except OSError:
+                existing = None
+        if existing is None:
+            path = self._group_path(bytes(gid))
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(msgpack.packb(manifest, use_bin_type=True))
+            os.replace(tmp, path)
+        for h in hashes:
+            self.index.insert(bytes(h), bytes(gid))
+
+    # --- repair path -------------------------------------------------------
+
+    def _load_manifest(self, h: Hash) -> Optional[dict]:
+        gid = self.index.get(bytes(h))
+        if gid is None:
+            return None
+        path = self._find_group_path(bytes(gid))
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                man = msgpack.unpackb(f.read(), raw=False)
+        except Exception:  # noqa: BLE001 — any bad sidecar = no coverage
+            return None
+        if man.get("v") != MANIFEST_VERSION or bytes(h) not in man["hashes"]:
+            return None
+        # a sidecar from an older (k, m) config cannot be decoded by the
+        # current codec; the next scrub pass rewrites it
+        if (man["k"] != self.codec.params.rs_data
+                or man["m"] != self.codec.params.rs_parity):
+            return None
+        return man
+
+    def coverage(self, h: Hash) -> bool:
+        """Is this block covered by a (possibly stale) parity sidecar?"""
+        return self._load_manifest(h) is not None
+
+    def try_reconstruct(self, h: Hash) -> Optional[bytes]:
+        """Rebuild block `h` from its codeword's surviving pieces.
+
+        Every candidate piece is verified before use (data shards by
+        content hash, parity shards by stored checksum); the rebuilt
+        block is verified against `h` before being returned.  Returns
+        the plain block bytes, or None if fewer than k trustworthy
+        pieces survive."""
+        man = self._load_manifest(h)
+        if man is None:
+            return None
+        k, m, maxlen = man["k"], man["m"], man["maxlen"]
+        hashes = [Hash(x) for x in man["hashes"]]
+        target_i = man["hashes"].index(bytes(h))
+
+        pieces: List[np.ndarray] = []
+        present: List[int] = []
+        # data shards: re-read surviving member blocks from the store
+        for i, mh in enumerate(hashes):
+            if i == target_i:
+                continue
+            raw = self._read_verified_member(mh)
+            if raw is None:
+                continue
+            shard = np.zeros(maxlen, dtype=np.uint8)
+            shard[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+            pieces.append(shard)
+            present.append(i)
+            if len(present) >= k:
+                break
+        # parity shards as needed
+        if len(present) < k:
+            for j in range(m):
+                p = np.frombuffer(man["parity"][j], dtype=np.uint8)
+                if bytes(blake2s_sum(man["parity"][j])) != bytes(
+                        man["parity_sums"][j]):
+                    continue
+                pieces.append(p)
+                present.append(k + j)
+                if len(present) >= k:
+                    break
+        if len(present) < k:
+            return None
+
+        shards = np.stack(pieces)[None, :, :]  # (1, p, maxlen)
+        try:
+            data = self.codec.rs_reconstruct(shards, present)[0]  # (k, maxlen)
+        except Exception:
+            logger.exception("parity reconstruction failed for %s",
+                             bytes(h).hex()[:16])
+            return None
+        out = data[target_i].tobytes()[: man["lengths"][target_i]]
+        if bytes(block_hash(out, self.manager.hash_algo)) != bytes(h):
+            logger.warning(
+                "parity reconstruction of %s produced wrong hash "
+                "(stale codeword?)", bytes(h).hex()[:16],
+            )
+            return None
+        logger.info("locally reconstructed block %s from RS parity",
+                    bytes(h).hex()[:16])
+        # refresh the sidecar's mtime: its row failed verify this scrub
+        # pass (that is why we are here), so the pass will not rewrite
+        # it — without the touch the purge could drop it
+        gid = self.index.get(bytes(h))
+        if gid is not None:
+            p = self._find_group_path(bytes(gid))
+            if p is not None:
+                try:
+                    os.utime(p)
+                except OSError:
+                    pass
+        return out
+
+    def _read_verified_member(self, h: Hash) -> Optional[bytes]:
+        """A member block's plain bytes, only if present and intact."""
+        found = self.manager.find_block(h)
+        if found is None:
+            return None
+        path, compressed = found
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None
+        try:
+            block = DataBlock(raw, compressed)
+            data = block.decompressed()
+        except Exception:
+            return None
+        if bytes(block_hash(data, self.manager.hash_algo)) != bytes(h):
+            return None
+        return data
+
+    def purge_stale(self, older_than: float) -> int:
+        """Delete sidecars not refreshed since `older_than` (unix time)
+        and prune index entries pointing at missing files.  Codeword
+        membership shifts with block churn, so every completed scrub
+        pass calls this with its own start time — without it, orphaned
+        .par files would accumulate on every pass."""
+        removed = 0
+        for base in self.all_dirs:
+            if not os.path.isdir(base):
+                continue
+            for sub in os.listdir(base):
+                d = os.path.join(base, sub)
+                try:
+                    names = os.listdir(d)
+                except OSError:
+                    continue
+                for name in names:
+                    p = os.path.join(d, name)
+                    try:
+                        if os.stat(p).st_mtime < older_than:
+                            os.remove(p)
+                            removed += 1
+                    except OSError:
+                        pass
+        # prune index entries whose group file is gone
+        dead = [
+            k for k, gid in list(self.index.items(None, None))
+            if self._find_group_path(bytes(gid)) is None
+        ]
+        for k in dead:
+            self.index.remove(k)
+        if removed or dead:
+            logger.info("parity purge: %d stale sidecars, %d index entries",
+                        removed, len(dead))
+        return removed
+
+    def stats(self) -> dict:
+        return {"indexed_blocks": len(self.index)}

@@ -28,6 +28,8 @@ import random
 import time
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from ..utils.background import Worker, WorkerState
 from ..utils.crdt import now_msec
 from ..utils.data import Hash
@@ -136,6 +138,7 @@ class ScrubWorkerState(Migrated):
         tranquility: int = DEFAULT_SCRUB_TRANQUILITY,
         corruptions: int = 0,
         time_last_complete: int = 0,
+        time_last_start: int = 0,
     ):
         self.position = position
         self.running = running
@@ -144,11 +147,13 @@ class ScrubWorkerState(Migrated):
         self.tranquility = tranquility
         self.corruptions = corruptions
         self.time_last_complete = time_last_complete
+        self.time_last_start = time_last_start
 
     def fields(self):
         return [
             self.position, self.running, self.paused, self.time_next_run,
             self.tranquility, self.corruptions, self.time_last_complete,
+            self.time_last_start,
         ]
 
     @classmethod
@@ -188,6 +193,10 @@ class ScrubWorker(Worker):
         # iterator's (which runs one prefix ahead)
         self._ra_task: Optional[asyncio.Task] = None
         self._verified_pos = self.state.position
+        # verified plain blocks carried between batches until a full RS
+        # codeword (k blocks) accumulates for the parity sidecar store
+        self._parity_carry: Tuple[list, list] = ([], [])
+        self._prev_pass_start = 0.0  # resumed pass: purge nothing extra
 
     def _roots(self) -> List[str]:
         return [d.path for d in self.manager.data_layout.data_dirs]
@@ -216,6 +225,13 @@ class ScrubWorker(Worker):
                 st.running, st.paused, st.position, st.corruptions = True, False, 0, 0
                 self._verified_pos = 0
                 self._drop_read_ahead()
+                self._drop_parity_carry()
+                # purge grace is ONE pass: remember the previous start
+                # before overwriting it (a sidecar skipped this pass —
+                # its row held the corruption being repaired — must
+                # survive until the NEXT pass refreshes it)
+                self._prev_pass_start = st.time_last_start / 1000.0
+                st.time_last_start = now_msec()
         elif cmd == "pause":
             st.paused = True
         elif cmd == "resume":
@@ -226,6 +242,9 @@ class ScrubWorker(Worker):
             self._verified_pos = 0
             self._drop_read_ahead()
         self._checkpoint(force=True)
+
+    def _drop_parity_carry(self) -> None:
+        self._parity_carry = ([], [])
 
     def _drop_read_ahead(self) -> None:
         if self._ra_task is not None:
@@ -270,6 +289,16 @@ class ScrubWorker(Worker):
             st.time_next_run = randomize_next_scrub()
             st.running = False
             self.iterator = None
+            self._drop_parity_carry()  # <k leftover: next pass retries
+            if self.manager.parity_store is not None:
+                # codeword membership shifts with churn: drop sidecars
+                # refreshed by NEITHER this pass nor the previous one,
+                # else orphans accumulate forever (one-pass grace keeps
+                # coverage for rows that failed verify this pass)
+                await asyncio.to_thread(
+                    self.manager.parity_store.purge_stale,
+                    self._prev_pass_start,
+                )
             self._checkpoint(force=True)
             logger.info("scrub complete, %d corruptions found", st.corruptions)
             return WorkerState.BUSY
@@ -315,27 +344,90 @@ class ScrubWorker(Worker):
             if raw is None:
                 continue
             if compressed:
-                ok = await asyncio.to_thread(_zstd_ok, raw)
-                if not ok:
+                # decompress so the codec verifies the CONTENT hash (a
+                # stronger check than the reference's zstd-checksum-only
+                # verify, block.rs:66-78) and the block joins a parity
+                # codeword — compressed blocks must be locally repairable
+                # too, not just the plain ones
+                data = await asyncio.to_thread(_try_decompress, raw)
+                if data is None:
                     await self._quarantine(h, path)
+                    continue
+                plain_idx.append(i)
+                plain_blocks.append(data)
+                plain_hashes.append(h)
             else:
                 plain_idx.append(i)
                 plain_blocks.append(raw)
                 plain_hashes.append(h)
         if plain_blocks:
-            ok = await asyncio.to_thread(
-                mgr.codec.batch_verify, plain_blocks, plain_hashes
+            store = mgr.parity_store
+            want_parity = (
+                store is not None and mgr.codec.params.rs_data > 0
             )
-            for j, good in enumerate(ok):
+            # prepend the carry (already-verified blocks from previous
+            # batches) so RS codewords align to k across batch boundaries
+            # — a per-prefix batch rarely holds k blocks by itself.  The
+            # ≤ k-1 carry blocks are re-hashed by the fused dispatch and
+            # the trailing partial row's parity is recomputed next batch:
+            # bounded waste (< k blocks per batch) accepted to keep the
+            # verify+encode a single codec call
+            carry_b, carry_h = self._parity_carry if want_parity else ([], [])
+            nc = len(carry_b)
+            all_b = carry_b + plain_blocks
+            all_h = carry_h + plain_hashes
+            ok, parity = await asyncio.to_thread(
+                mgr.codec.scrub_encode_batch, all_b, all_h, want_parity,
+            )
+            for j, good in enumerate(ok[nc:]):
                 if not good:
                     h, path, _ = batch[plain_idx[j]]
                     await self._quarantine(h, path)
+            if want_parity and parity is not None:
+                # persist RS sidecars for every COMPLETE codeword whose
+                # members all verified — this is what makes a later
+                # corruption locally repairable with zero network
+                # (the BlockCodec north star's decode-repair half)
+                k = mgr.codec.params.rs_data
+                nrows = len(all_b) // k
+                for row in range(nrows):
+                    lo = row * k
+                    if not all(ok[lo:lo + k]):
+                        continue
+                    # trim to the row's own width: pad columns beyond the
+                    # longest member are zero parity (GF-linear) and would
+                    # bloat the sidecar to the batch-global maxlen
+                    row_max = max(len(b) for b in all_b[lo:lo + k])
+                    await asyncio.to_thread(
+                        store.put_codeword,
+                        all_h[lo:lo + k],
+                        [len(b) for b in all_b[lo:lo + k]],
+                        np.asarray(parity[row])[:, :row_max],
+                    )
+                rest = nrows * k
+                self._parity_carry = (
+                    [b for b, good in zip(all_b[rest:], ok[rest:]) if good],
+                    [h for h, good in zip(all_h[rest:],
+                                          ok[rest:]) if good],
+                )
 
     async def _quarantine(self, h: Hash, path: str) -> None:
         self.state.corruptions += 1
         self.manager.corruptions += 1
         logger.error("scrub: corrupted block %s at %s", bytes(h).hex()[:16], path)
         await asyncio.to_thread(_move_aside, path)
+        # first line of defense: rebuild locally from the RS parity
+        # sidecar — with every replica down this is the ONLY repair;
+        # network resync stays as the fallback
+        store = self.manager.parity_store
+        if store is not None:
+            data = await asyncio.to_thread(store.try_reconstruct, h)
+            if data is not None:
+                from .block import DataBlock
+
+                await self.manager.write_block(h, DataBlock.plain(data))
+                self.manager.blocks_reconstructed += 1
+                return
         if self.manager.resync is not None:
             self.manager.resync.put_to_resync(h, 0.0)
 
@@ -431,14 +523,13 @@ def _try_read(path: str) -> Optional[bytes]:
         return None
 
 
-def _zstd_ok(raw: bytes) -> bool:
+def _try_decompress(raw: bytes) -> Optional[bytes]:
     import zstandard
 
     try:
-        zstandard.ZstdDecompressor().decompress(raw)
-        return True
+        return zstandard.ZstdDecompressor().decompress(raw)
     except zstandard.ZstdError:
-        return False
+        return None
 
 
 def _move_aside(path: str) -> None:

@@ -238,8 +238,20 @@ class BlockResyncManager:
             await mgr.delete_if_unneeded(h)
 
         elif rc.is_needed() and not present:
-            # we should have it but don't: fetch from a replica
+            # we should have it but don't: rebuild locally from the RS
+            # parity sidecar when possible (zero network — works with
+            # every replica down), else fetch from a replica
             # (ref resync.rs:457-468)
+            if mgr.parity_store is not None:
+                data = await asyncio.to_thread(
+                    mgr.parity_store.try_reconstruct, h
+                )
+                if data is not None:
+                    from .block import DataBlock
+
+                    await mgr.write_block(h, DataBlock.plain(data))
+                    mgr.blocks_reconstructed += 1
+                    return
             block = await mgr.rpc_get_raw_block(h)
             await mgr.write_block(h, block)
             logger.info("resynced missing block %s", bytes(h).hex()[:16])

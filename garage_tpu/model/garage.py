@@ -100,6 +100,12 @@ class Garage:
             ),
         )
         self.block_manager.resync = self.block_resync
+        if config.codec.store_parity and config.codec.rs_data > 0:
+            from ..block.parity import ParityStore
+
+            self.block_manager.parity_store = ParityStore(
+                self.block_manager, self.db, self.block_manager.codec
+            )
 
         # --- tables, wired bottom-up so hooks can reach lower tables ---
         self.bucket_table = Table(

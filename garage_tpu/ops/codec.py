@@ -70,6 +70,26 @@ class BlockCodec:
         device — the scrub/resync producers batch)."""
         return bool(self.batch_verify([block], [hash])[0])
 
+    def scrub_encode_batch(self, blocks: Sequence[bytes],
+                           hashes: Sequence[Hash],
+                           fetch_parity: bool = True):
+        """Fused scrub step: verify + RS(k, m) parity per codeword of k
+        consecutive blocks.  Returns (ok (B,), parity
+        (ceil(B/k), m, maxlen) | None); short blocks zero-pad to maxlen
+        (zero data → zero parity, GF-linear).  Device backends override
+        with a single fused dispatch; this default serves the CPU path."""
+        ok = self.batch_verify(blocks, hashes)
+        parity = None
+        k = self.params.rs_data
+        if fetch_parity and k > 0 and blocks:
+            pad = (-len(blocks)) % k
+            maxlen = max(len(b) for b in blocks)
+            arr = np.zeros((len(blocks) + pad, maxlen), dtype=np.uint8)
+            for i, b in enumerate(blocks):
+                arr[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+            parity = self.rs_encode(arr.reshape(-1, k, maxlen))
+        return ok, parity
+
     # --- Reed-Solomon ---
     def rs_encode(self, data: np.ndarray) -> np.ndarray:
         """(B, k, S) uint8 → (B, m, S) parity shards."""

@@ -78,6 +78,11 @@ class CodecConfig:
     # hybrid backend work-stealing quantum; single source of truth is the
     # CodecParams default (codec.py: cache-resident CPU-side groups)
     hybrid_group_blocks: int = _CODEC_DEFAULTS.hybrid_group_blocks
+    # persist scrub-time RS parity sidecars enabling zero-network local
+    # reconstruction of corrupted/lost blocks (the decode-repair half of
+    # the BlockCodec north star).  Opt-in: costs ~m/k extra disk (+50%
+    # at the default 8/4), refreshed and garbage-collected per scrub pass
+    store_parity: bool = False
     hybrid_window: int = 1          # hybrid backend: device in-flight groups
 
     def make(self, compression_level: Optional[int] = 1):

@@ -26,6 +26,9 @@ bootstrap_peers = ["127.0.0.1:3901", "127.0.0.1:3911", "127.0.0.1:3921"]
 s3_region = "garage"
 api_bind_addr = "127.0.0.1:39${i}0"
 
+[codec]
+store_parity = true
+
 [admin]
 api_bind_addr = "127.0.0.1:39${i}3"
 admin_token = "dev-admin-token"
