@@ -501,3 +501,37 @@ async def test_parity_sidecar_local_reconstruction(tmp_path):
             os.remove(found[0])
     assert m.parity_store.try_reconstruct(vh2) is None
     await shutdown(systems)
+
+
+async def test_resync_prefers_local_parity_over_network(tmp_path):
+    """The resync missing-block path reconstructs from the local parity
+    sidecar BEFORE trying any replica — on a 1-node cluster there are no
+    replicas at all, so success proves zero network was needed."""
+    from garage_tpu.block.parity import ParityStore
+    from garage_tpu.block.repair import ScrubWorker
+
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    m.blocks_reconstructed = 0
+    m.parity_store = ParityStore(m, open_db("memory"), m.codec)
+
+    datas = [os.urandom(12_000 + i) for i in range(8)]  # one full codeword
+    hs = [blake2s_sum(d) for d in datas]
+    for h, d in zip(hs, datas):
+        await m.write_block(h, DataBlock.plain(d))
+    w = ScrubWorker(m)
+    w.send_command("start")
+    while (await w.work()).name in ("BUSY", "THROTTLED"):
+        pass
+    assert m.parity_store.stats()["indexed_blocks"] == 8
+
+    # rc>0 + file gone → resync_block must restore it locally
+    victim = hs[3]
+    m.db.transaction(lambda tx: m.rc.block_incref(tx, victim))
+    os.remove(m.find_block(victim)[0])
+    assert await m.need_block(victim)
+    await m.resync.resync_block(victim)
+    assert m.is_block_present(victim)
+    assert (await m.read_block(victim)).inner == datas[3]
+    assert m.blocks_reconstructed == 1
+    await shutdown(systems)
