@@ -670,12 +670,20 @@ class AdminRpcHandler:
                         g.object_table, obj,
                         lambda v: v.timestamp < cutoff,
                     )
-                # loop until an EMPTY page: with a node-side filter the
-                # coordinator re-filters after quorum merge, so a short
-                # page does not mean the range is exhausted
-                if not batch:
+                if len(batch) >= 1000:
+                    pos = batch[-1].key + "\x00"
+                    continue
+                # Short/empty filtered page is AMBIGUOUS: the coordinator
+                # re-filters after the quorum merge, so matches may have
+                # been dropped mid-range and an empty page has no cursor
+                # to advance.  One unfiltered probe page answers "is the
+                # range exhausted?" and supplies the cursor if not.
+                probe = await g.object_table.get_range(
+                    bid, pos, filter="any", limit=1000
+                )
+                if len(probe) < 1000:
                     break
-                pos = batch[-1].key + "\x00"
+                pos = probe[-1].key + "\x00"
             lines.append(f"{name}: {count} incomplete uploads aborted")
         return "\n".join(lines)
 

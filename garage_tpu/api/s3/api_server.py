@@ -246,7 +246,7 @@ class S3ApiServer:
                 ctx.request.method, origin, req_headers,
             )
             if rule is not None:
-                add_cors_headers(ctx.cors_headers, rule)
+                add_cors_headers(ctx.cors_headers, rule, origin)
 
         resp = await h(ctx)
         if ctx.cors_headers and not resp.prepared:

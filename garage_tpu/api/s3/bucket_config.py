@@ -171,11 +171,16 @@ def apply_cors_headers(resp_headers: Dict[str, str], rule: Dict, origin: str) ->
         resp_headers["Access-Control-Expose-Headers"] = ", ".join(rule["expose_headers"])
 
 
-def add_cors_headers(resp_headers: Dict[str, str], rule: Dict) -> None:
-    """Full CORS header set on a matched rule (ref cors.rs
-    add_cors_headers): origins/methods/headers as configured, verbatim."""
-    resp_headers["Access-Control-Allow-Origin"] = ", ".join(
-        rule.get("allow_origins", []))
+def add_cors_headers(resp_headers: Dict[str, str], rule: Dict,
+                     origin: str) -> None:
+    """Full CORS header set on a matched rule.  Allow-Origin must be ONE
+    origin or '*' (browsers reject lists — the reference comma-joins the
+    configured origins, cors.rs add_cors_headers, which no browser
+    accepts for multi-origin rules); we echo the matched request origin
+    like apply_cors_headers does."""
+    resp_headers["Access-Control-Allow-Origin"] = (
+        "*" if "*" in rule.get("allow_origins", []) else origin
+    )
     resp_headers["Access-Control-Allow-Methods"] = ", ".join(
         rule.get("allow_methods", []))
     resp_headers["Access-Control-Allow-Headers"] = ", ".join(
@@ -218,7 +223,7 @@ def handle_options_for_bucket(request, bucket) -> web.Response:
     rule = find_matching_cors_rule(rules, req_method, origin, req_headers)
     if rule is not None:
         headers: Dict[str, str] = {}
-        add_cors_headers(headers, rule)
+        add_cors_headers(headers, rule, origin)
         return web.Response(status=200, headers=headers)
     raise ApiError("This CORS request is not allowed.", status=403,
                    code="AccessDenied")

@@ -135,6 +135,7 @@ class ParityStore:
             return None
         if man.get("v") != MANIFEST_VERSION or bytes(h) not in man["hashes"]:
             return None
+        man["_path"] = path  # saves re-resolving for the mtime touch
         # a sidecar from an older (k, m) config cannot be decoded by the
         # current codec; the next scrub pass rewrites it
         if (man["k"] != self.codec.params.rs_data
@@ -209,14 +210,10 @@ class ParityStore:
         # refresh the sidecar's mtime: its row failed verify this scrub
         # pass (that is why we are here), so the pass will not rewrite
         # it — without the touch the purge could drop it
-        gid = self.index.get(bytes(h))
-        if gid is not None:
-            p = self._find_group_path(bytes(gid))
-            if p is not None:
-                try:
-                    os.utime(p)
-                except OSError:
-                    pass
+        try:
+            os.utime(man["_path"])
+        except OSError:
+            pass
         return out
 
     def _read_verified_member(self, h: Hash) -> Optional[bytes]:
