@@ -339,12 +339,10 @@ async def test_fullcopy_replication_local_read(tmp_path):
 
 async def test_insert_queue_survives_restart(tmp_path):
     """Hook-deferred inserts (queue_insert inside an updated() txn) are
-    durable: queued entries written to a persistent engine survive a
-    crash before the InsertQueueWorker drains them, and propagate after
-    restart (ref data.rs queue_insert + queue.rs)."""
-    import os as _os
-
-    from garage_tpu.db import open_db
+    durable: the full delete cascade (object overwrite -> queued version
+    tombstone -> block_ref tombstone -> rc decrement) survives a crash
+    before the InsertQueueWorker drains it (ref data.rs queue_insert +
+    queue.rs)."""
     from garage_tpu.model import Garage
     from garage_tpu.model.s3.object_table import Object
     from garage_tpu.model.s3.version_table import Version
@@ -352,7 +350,7 @@ async def test_insert_queue_survives_restart(tmp_path):
     from garage_tpu.utils.config import config_from_dict
     from garage_tpu.utils.data import gen_uuid
 
-    def mk(i=0):
+    def mk():
         return config_from_dict({
             "metadata_dir": str(tmp_path / "meta"),
             "data_dir": str(tmp_path / "data"),
@@ -377,14 +375,15 @@ async def test_insert_queue_survives_restart(tmp_path):
     vu = gen_uuid()
     ver = Version.new(vu, bytes(bid), "qk")
     ver.add_block(0, 0, b"\xaa" * 32, 100)
-    await g.version_table.insert(ver)
-    # deleting the object's version via the hook enqueues the block_ref
-    # tombstones into version/block_ref insert queues
+    await g.version_table.insert(ver)  # queues a LIVE block_ref (incref)
     from test_model import complete_version
 
     await g.object_table.insert(Object(bid, "qk", [
         complete_version(vu, 100, b"live")]))
-    await asyncio.sleep(0.1)
+    # overwriting with a NEWER complete version prunes vu out of the row;
+    # the hook queues the version TOMBSTONE (the delete cascade's head)
+    await g.object_table.insert(Object(bid, "qk", [
+        complete_version(gen_uuid(), 200, b"newer")]))
     queued = sum(len(t.data.insert_queue) for t in g.tables)
     assert queued > 0, "expected hook-deferred inserts in the queue"
     await g.shutdown()   # workers never ran; queue is on disk
@@ -399,9 +398,19 @@ async def test_insert_queue_survives_restart(tmp_path):
         if sum(len(t.data.insert_queue) for t in g2.tables) == 0:
             break
         await asyncio.sleep(0.05)
-    assert sum(len(t.data.insert_queue) for t in g2.tables) == 0
-    # the deferred block_ref insert took effect: rc incremented
+    # draining may CASCADE (version tombstone -> new block_ref tombstone
+    # entries): wait until the queues stay empty
     from garage_tpu.utils.data import Hash
 
-    assert g2.block_manager.rc.get(Hash(b"\xaa" * 32)).is_needed()
+    for _ in range(100):
+        if (sum(len(t.data.insert_queue) for t in g2.tables) == 0
+                and not g2.block_manager.rc.get(
+                    Hash(b"\xaa" * 32)).is_needed()):
+            break
+        await asyncio.sleep(0.05)
+    # the WHOLE cascade took effect post-restart: the live ref was
+    # incref'd and then the delete cascade decref'd it back to zero
+    assert not g2.block_manager.rc.get(Hash(b"\xaa" * 32)).is_needed()
+    v2 = await g2.version_table.get(vu, "")
+    assert v2 is not None and v2.deleted.value
     await g2.shutdown()
