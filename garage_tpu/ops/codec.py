@@ -70,6 +70,22 @@ class BlockCodec:
         device — the scrub/resync producers batch)."""
         return bool(self.batch_verify([block], [hash])[0])
 
+    def rs_encode_blocks(self, blocks: Sequence[bytes]) -> np.ndarray:
+        """RS parity straight from a list of block buffers:
+        (ceil(B/k), m, maxlen), blocks zero-extended to maxlen, the batch
+        zero-padded to a whole codeword (zero data → zero parity,
+        GF-linear).  This default packs into one (B, k, S) array and calls
+        rs_encode; CpuCodec overrides with a pointer-gather kernel that
+        skips the packing pass."""
+        k = self.params.rs_data
+        assert k > 0 and blocks
+        pad = (-len(blocks)) % k
+        maxlen = max(len(b) for b in blocks)
+        arr = np.zeros((len(blocks) + pad, maxlen), dtype=np.uint8)
+        for i, b in enumerate(blocks):
+            arr[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        return self.rs_encode(arr.reshape(-1, k, maxlen))
+
     def scrub_encode_batch(self, blocks: Sequence[bytes],
                            hashes: Sequence[Hash],
                            fetch_parity: bool = True):
@@ -80,14 +96,8 @@ class BlockCodec:
         with a single fused dispatch; this default serves the CPU path."""
         ok = self.batch_verify(blocks, hashes)
         parity = None
-        k = self.params.rs_data
-        if fetch_parity and k > 0 and blocks:
-            pad = (-len(blocks)) % k
-            maxlen = max(len(b) for b in blocks)
-            arr = np.zeros((len(blocks) + pad, maxlen), dtype=np.uint8)
-            for i, b in enumerate(blocks):
-                arr[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
-            parity = self.rs_encode(arr.reshape(-1, k, maxlen))
+        if fetch_parity and self.params.rs_data > 0 and blocks:
+            parity = self.rs_encode_blocks(blocks)
         return ok, parity
 
     # --- Reed-Solomon ---

@@ -22,7 +22,11 @@ import numpy as np
 from ..utils.data import BLOCK_HASH_ALGOS, Hash
 from . import gf256
 from .codec import BlockCodec, CodecParams
-from .native import get_native_gf_matmul_blocks
+from .native import (
+    get_native_blake2s_multi,
+    get_native_gf_matmul_blocks,
+    get_native_gf_matmul_ptrs,
+)
 
 
 _SHARED_POOL = None
@@ -48,10 +52,21 @@ class CpuCodec(BlockCodec):
         self._hash_fn = BLOCK_HASH_ALGOS[params.hash_algo]
         self._pool = _hash_pool()
         self._native = get_native_gf_matmul_blocks()
+        # Multi-buffer SIMD hashing: 8 blocks per instruction stream.  On
+        # the 1-core hosts this targets, the thread pool cannot parallelise
+        # hashing at all — the SIMD lanes are the only parallelism there is.
+        self._native_hash = (
+            get_native_blake2s_multi() if params.hash_algo == "blake2s" else None
+        )
+        self._native_ptrs = get_native_gf_matmul_ptrs()
         if params.rs_data > 0:
             self._parity_mat = gf256.rs_parity_matrix(params.rs_data, params.rs_parity)
 
     def batch_hash(self, blocks: Sequence[bytes]) -> List[Hash]:
+        # Below 4 blocks the 8-lane kernel wastes over half its lanes and
+        # hashlib's C loop wins; at and above, the SIMD batch wins.
+        if self._native_hash is not None and len(blocks) >= 4:
+            return [Hash(d) for d in self._native_hash(blocks)]
         if len(blocks) <= 1:
             return [self._hash_fn(b) for b in blocks]
         return list(self._pool.map(self._hash_fn, blocks))
@@ -64,6 +79,20 @@ class CpuCodec(BlockCodec):
     def rs_encode(self, data: np.ndarray) -> np.ndarray:
         assert data.shape[-2] == self.params.rs_data, data.shape
         return self._apply(self._parity_mat, np.ascontiguousarray(data, dtype=np.uint8))
+
+    def rs_encode_blocks(self, blocks: Sequence[bytes]) -> np.ndarray:
+        """Pointer-gather override: when the GFNI kernel is present, parity
+        is computed straight from the original block buffers — the base
+        class's (B, k, S) packing memcpy alone costs more than the encode
+        it feeds."""
+        if self._native_ptrs is None:
+            return super().rs_encode_blocks(blocks)
+        k = self.params.rs_data
+        assert k > 0 and blocks
+        maxlen = max(len(b) for b in blocks)
+        pad = (-len(blocks)) % k
+        return self._native_ptrs(
+            self._parity_mat, list(blocks) + [b""] * pad, maxlen)
 
     def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int]) -> np.ndarray:
         k, m = self.params.rs_data, self.params.rs_parity

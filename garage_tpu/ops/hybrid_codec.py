@@ -300,15 +300,7 @@ class HybridCodec(BlockCodec):
         ok = self.cpu.batch_verify(gb, gh)
         parity = None
         if compute_parity:
-            k = self.params.rs_data
-            pad = (-len(gb)) % k
-            maxlen = max(len(b) for b in gb)
-            arr = np.zeros((len(gb) + pad, maxlen), dtype=np.uint8)
-            for i, b in enumerate(gb):
-                arr[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
-            parity = self.cpu.rs_encode(
-                arr.reshape(arr.shape[0] // k, k, maxlen)
-            )
+            parity = self.cpu.rs_encode_blocks(gb)
             if not fetch_parity:
                 parity = None
         return ok, parity

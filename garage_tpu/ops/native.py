@@ -1,9 +1,13 @@
-"""ctypes loader for the native C++ GF(2^8) kernel (native/gf256.cpp).
+"""ctypes loaders for the native C++ kernels in garage_tpu/native/.
 
-Resolved lazily on first use (not import — short CLI invocations must not pay
-for a compiler run); a failed build is cached on disk against the source
-mtime so it is not retried every process start.  Falls back to the numpy
-implementation in gf256.py when unavailable.
+Two kernels live here:
+  - gf256.cpp      → libgf256.so      (AVX2 split-nibble GF(2^8) matmul)
+  - blake2s_mb.cpp → libblake2smb.so  (AVX2 8-way multi-buffer BLAKE2s-256)
+
+Resolved lazily on first use (not import — short CLI invocations must not
+pay for a compiler run); a failed build is cached on disk against the
+source mtime so it is not retried every process start.  Callers fall back
+to numpy (GF) / hashlib (BLAKE2s) when a kernel is unavailable.
 """
 
 from __future__ import annotations
@@ -12,84 +16,71 @@ import ctypes
 import logging
 import os
 import subprocess
-from typing import Callable, Optional
+import threading
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 logger = logging.getLogger("garage_tpu.ops.native")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libgf256.so")
-_FAIL_MARKER = os.path.join(_NATIVE_DIR, ".build_failed")
-
-_resolved = False
-_fn: Optional[Callable[[np.ndarray, np.ndarray], np.ndarray]] = None
+_BUILD_LOCK = threading.Lock()
 
 
-def _load_lib() -> Optional[ctypes.CDLL]:
-    """Load the .so; a load failure (e.g. a stale binary built on another
-    host — the Makefile uses -march=native) triggers one clean rebuild,
-    subject to the same opt-out/fail-marker policy as _build_ok."""
-    try:
-        return ctypes.CDLL(_SO_PATH)
-    except OSError:
-        pass
-    if os.environ.get("GARAGE_TPU_NO_NATIVE_BUILD"):
-        return None
-    src_mtime = os.path.getmtime(os.path.join(_NATIVE_DIR, "gf256.cpp"))
-    if os.path.exists(_FAIL_MARKER) and os.path.getmtime(_FAIL_MARKER) >= src_mtime:
-        return None
-    try:
-        subprocess.run(
-            ["make", "-C", _NATIVE_DIR, "-s", "clean", "all"],
-            check=True, capture_output=True, timeout=120,
-        )
-        return ctypes.CDLL(_SO_PATH)
-    except Exception as e:
-        logger.debug("native gf256 rebuild failed: %s", e)
-        try:
-            with open(_FAIL_MARKER, "w") as f:
-                f.write(str(e))
-        except OSError:
-            pass
-        return None
+def _load_or_build(so_name: str, src_name: str) -> Optional[ctypes.CDLL]:
+    """Load native/<so_name>, building it (make) if missing or stale.
 
-
-def _build_ok() -> bool:
-    src_mtime = os.path.getmtime(os.path.join(_NATIVE_DIR, "gf256.cpp"))
-    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= src_mtime:
-        return True
-    if os.environ.get("GARAGE_TPU_NO_NATIVE_BUILD"):
-        return False
-    if os.path.exists(_FAIL_MARKER) and os.path.getmtime(_FAIL_MARKER) >= src_mtime:
-        return False  # previous build of this exact source failed; don't retry
-    try:
-        subprocess.run(
-            ["make", "-C", _NATIVE_DIR, "-s"],
-            check=True, capture_output=True, timeout=120,
-        )
-        return True
-    except Exception as e:
-        logger.debug("native gf256 build unavailable: %s", e)
-        try:
-            with open(_FAIL_MARKER, "w") as f:
-                f.write(str(e))
-        except OSError:
-            pass
-        return False
-
-
-def _resolve() -> Optional[Callable]:
-    global _resolved, _fn
-    if _resolved:
-        return _fn
-    _resolved = True
-    if not _build_ok():
-        return None
-    try:
-        lib = _load_lib()
-        if lib is None:
+    A load failure of an existing .so (e.g. a stale binary built on another
+    host — the Makefile uses -march=native) triggers one clean rebuild.
+    A failed build writes a marker keyed on the source mtime so this exact
+    source is never re-attempted."""
+    so_path = os.path.join(_NATIVE_DIR, so_name)
+    src_path = os.path.join(_NATIVE_DIR, src_name)
+    fail_marker = os.path.join(_NATIVE_DIR, f".build_failed_{src_name}")
+    with _BUILD_LOCK:
+        src_mtime = os.path.getmtime(src_path)
+        fresh = os.path.exists(so_path) and os.path.getmtime(so_path) >= src_mtime
+        if fresh:
+            try:
+                return ctypes.CDLL(so_path)
+            except OSError:
+                pass  # stale/foreign binary: fall through to a clean rebuild
+        if os.environ.get("GARAGE_TPU_NO_NATIVE_BUILD"):
             return None
+        if os.path.exists(fail_marker) and os.path.getmtime(fail_marker) >= src_mtime:
+            return None
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "-s", "-B", so_name],
+                check=True, capture_output=True, timeout=120,
+            )
+            return ctypes.CDLL(so_path)
+        except Exception as e:
+            logger.debug("native %s build failed: %s", so_name, e)
+            try:
+                with open(fail_marker, "w") as f:
+                    f.write(str(e))
+            except OSError:
+                pass
+            return None
+
+
+# --- GF(2^8) matmul ---
+
+_gf_resolved = False
+_gf_fn: Optional[Callable[[np.ndarray, np.ndarray], np.ndarray]] = None
+
+
+def get_native_gf_matmul_blocks() -> Optional[Callable]:
+    """The native GF kernel, or None (numpy fallback); builds on first call."""
+    global _gf_resolved, _gf_fn
+    if _gf_resolved:
+        return _gf_fn
+    _gf_resolved = True
+    lib = _load_or_build("libgf256.so", "gf256.cpp")
+    if lib is None:
+        return None
+    try:
         lib.gf_matmul_blocks.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_uint8),
@@ -97,7 +88,7 @@ def _resolve() -> Optional[Callable]:
         ]
         lib.gf_matmul_blocks.restype = None
     except Exception as e:
-        logger.debug("native gf256 load failed: %s", e)
+        logger.debug("native gf256 symbol resolution failed: %s", e)
         return None
 
     def _ptr(a: np.ndarray):
@@ -115,10 +106,108 @@ def _resolve() -> Optional[Callable]:
         lib.gf_matmul_blocks(_ptr(mat_c), _ptr(shards), _ptr(out), batch, r, k, s)
         return out
 
-    _fn = fn
-    return _fn
+    _gf_fn = fn
+    return _gf_fn
 
 
-def get_native_gf_matmul_blocks() -> Optional[Callable]:
-    """The native kernel, or None (numpy fallback); builds on first call."""
-    return _resolve()
+# --- GF(2^8) pointer-gather matmul (scrub/put encode hot path) ---
+
+_gfp_resolved = False
+_gfp_fn: Optional[Callable] = None
+
+
+def get_native_gf_matmul_ptrs() -> Optional[Callable]:
+    """fn(mat (r,k) uint8, buffers: Sequence[bytes], s) → (B, r, s) uint8,
+    where consecutive groups of k buffers form one codeword, each
+    zero-extended to width s.  len(buffers) must be a multiple of k.
+    Only available when the GFNI kernel backs it (on AVX2-only hosts,
+    packing + gf_matmul_blocks is faster than the scalar gather)."""
+    global _gfp_resolved, _gfp_fn
+    if _gfp_resolved:
+        return _gfp_fn
+    _gfp_resolved = True
+    lib = _load_or_build("libgf256.so", "gf256.cpp")
+    if lib is None:
+        return None
+    try:
+        if not lib.gf_ptrs_fast():
+            return None
+        lib.gf_matmul_ptrs.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.gf_matmul_ptrs.restype = None
+    except Exception as e:
+        logger.debug("native gf_matmul_ptrs unavailable: %s", e)
+        return None
+
+    def fn(mat: np.ndarray, buffers: Sequence[bytes], s: int) -> np.ndarray:
+        r, k = mat.shape
+        n = len(buffers)
+        assert n % k == 0, (n, k)
+        B = n // k
+        ptrs = (ctypes.c_char_p * n)(*buffers)
+        lens = (ctypes.c_uint64 * n)(*[len(b) for b in buffers])
+        out = np.zeros((B, r, s), dtype=np.uint8)
+        mat_c = np.ascontiguousarray(mat, dtype=np.uint8)
+        lib.gf_matmul_ptrs(
+            mat_c.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), ptrs, lens,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), B, r, k, s)
+        return out
+
+    _gfp_fn = fn
+    return _gfp_fn
+
+
+# --- multi-buffer BLAKE2s-256 ---
+
+_b2_resolved = False
+_b2_fn: Optional[Callable[[Sequence[bytes]], List[bytes]]] = None
+
+
+def get_native_blake2s_multi() -> Optional[Callable[[Sequence[bytes]], List[bytes]]]:
+    """Batch BLAKE2s-256 over the AVX2 8-way kernel, or None (hashlib
+    fallback).  Returns a callable blocks → [32-byte digest per block].
+
+    The wrapper sorts the batch by length before dispatch: lanes in one
+    SIMD group advance in lock-step, so grouping similar lengths minimises
+    the work wasted on lanes that finish early (compressed blocks make
+    lengths non-uniform).  Output order matches the input order."""
+    global _b2_resolved, _b2_fn
+    if _b2_resolved:
+        return _b2_fn
+    _b2_resolved = True
+    lib = _load_or_build("libblake2smb.so", "blake2s_mb.cpp")
+    if lib is None:
+        return None
+    try:
+        if not lib.blake2s_mb_supported():
+            return None  # prebuilt binary on a pre-AVX2 host
+        lib.blake2s256_multi.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.blake2s256_multi.restype = None
+    except Exception as e:
+        logger.debug("native blake2s symbol resolution failed: %s", e)
+        return None
+
+    def fn(blocks: Sequence[bytes]) -> List[bytes]:
+        n = len(blocks)
+        if n == 0:
+            return []
+        order = sorted(range(n), key=lambda i: len(blocks[i]))
+        ptrs = (ctypes.c_char_p * n)(*[blocks[i] for i in order])
+        lens = (ctypes.c_uint64 * n)(*[len(blocks[i]) for i in order])
+        out = (ctypes.c_uint8 * (32 * n))()
+        lib.blake2s256_multi(ptrs, lens,
+                             ctypes.cast(out, ctypes.c_void_p), n)
+        raw = bytes(out)
+        digests: List[bytes] = [b""] * n
+        for pos, i in enumerate(order):
+            digests[i] = raw[pos * 32:(pos + 1) * 32]
+        return digests
+
+    _b2_fn = fn
+    return _b2_fn
