@@ -248,21 +248,28 @@ extern "C" int blake2s_mb_supported() {
 extern "C" B2_TARGET void blake2s256_multi(const uint8_t *const *ptrs,
                                            const uint64_t *lens, uint8_t *out,
                                            int64_t n) {
-    static const uint8_t empty[64] = {0};
     for (int64_t i = 0; i < n; i += 8) {
         const uint8_t *p[8];
         uint64_t L[8];
         uint8_t *o[8];
         uint8_t scratch[8][32];
+        int64_t last = (i + 7 < n ? i + 7 : n - 1);
         for (int l = 0; l < 8; ++l) {
             int64_t j = i + l;
             if (j < n) {
                 p[l] = ptrs[j];
                 L[l] = lens[j];
                 o[l] = out + j * 32;
-            } else {  // pad lane: hash the empty string, discard the digest
-                p[l] = empty;
-                L[l] = 0;
+            } else {
+                // Pad lane: REPLAY the group's last real stream and discard
+                // the digest.  An empty-string pad (len 0) would pull
+                // min_interior to 0 and push the whole group — which the
+                // caller's ascending length sort fills with the LONGEST
+                // blocks — onto the masked per-lane tail path for every
+                // chunk; replaying a real lane keeps the uniform fast loop
+                // at zero extra compress cost.
+                p[l] = ptrs[last];
+                L[l] = lens[last];
                 o[l] = scratch[l];
             }
         }
