@@ -134,11 +134,16 @@ def int_param(value, name: str, default: Optional[int] = None) -> Optional[int]:
         )
 
 
-async def start_site(runner, bind_addr: str):
+async def start_site(runner, bind_addr: str, unix_mode: int = 0o222):
     """Bind an aiohttp runner to `bind_addr` — "host:port" for TCP, an
     absolute path or "unix:/path" for a unix domain socket (ref
     util/socket_address.rs UnixOrTCPSocketAddress; every API server in
-    the reference accepts both).  Returns the started site."""
+    the reference accepts both).  Returns the started site.
+
+    Unix sockets are chmod'd to `unix_mode` after bind (ref
+    api/generic_server.rs:150-152, default 0o222): connecting requires
+    write permission, and the daemon's umask would otherwise leave the
+    socket unreachable for clients running as other users."""
     from aiohttp import web
 
     is_unix = bind_addr.startswith("unix:")
@@ -156,9 +161,20 @@ async def start_site(runner, bind_addr: str):
         except FileNotFoundError:
             pass
         site = web.UnixSite(runner, bind_addr)
-    else:
-        host, port = bind_addr.rsplit(":", 1)
-        site = web.TCPSite(runner, host, int(port))
+        await site.start()
+        try:
+            os.chmod(bind_addr, unix_mode)
+        except OSError:
+            import logging
+
+            logging.getLogger("garage_tpu.api").warning(
+                "cannot chmod unix socket %s to %s — clients running as "
+                "other users will get EACCES", bind_addr, oct(unix_mode),
+                exc_info=True,
+            )
+        return site
+    host, port = bind_addr.rsplit(":", 1)
+    site = web.TCPSite(runner, host, int(port))
     await site.start()
     return site
 

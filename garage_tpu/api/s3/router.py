@@ -109,10 +109,14 @@ def _bucket_endpoint(m: str, bucket: str, q: Dict[str, str], headers) -> Endpoin
             raise NotImplementedError_(f"{m} ?{sub} not supported")
     for sub, name in _BUCKET_SUBRESOURCES_UNIMPL.items():
         if sub in q:
+            # All of these 501 at the catch-all, but verb/auth must still
+            # match per-method intent: the List special-case (?versions)
+            # applies to GET only — a PUT/DELETE on ?versions is a
+            # mutation and keeps OWNER auth, not ListObjectVersions/READ.
             verb = {"GET": "Get", "PUT": "Put", "DELETE": "Delete"}.get(m, m)
             auth = READ if m == "GET" else OWNER
-            if name.startswith("List"):  # ?versions
-                verb, auth = "", READ
+            if m == "GET" and name.startswith("List"):
+                verb = ""
             return Endpoint(verb + name, auth, bucket, query=q)
     if m == "GET":
         if "uploads" in q:

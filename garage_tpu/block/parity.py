@@ -61,6 +61,19 @@ class ParityStore:
 
     # --- write path (scrub) ------------------------------------------------
 
+    @staticmethod
+    def _gid(k: int, m: int, hashes: Sequence[Hash]) -> Hash:
+        """Group id over (manifest version, k, m, member hashes).  The
+        codec geometry is part of the identity: with member-hashes-only
+        gids, an rs_parity config change made put_codeword mtime-touch the
+        old-geometry file forever (so purge never removed it) while
+        _load_manifest rejected it on its (k, m) check — silently and
+        permanently losing local-repair coverage for the codeword."""
+        import struct
+
+        head = struct.pack("<III", MANIFEST_VERSION, k, m)
+        return blake2s_sum(head + b"".join(bytes(h) for h in hashes))
+
     def _group_path(self, gid: bytes) -> str:
         """Write location for a group (the writable dir)."""
         hx = gid.hex()
@@ -85,31 +98,34 @@ class ParityStore:
         member blocks in codeword order, `parity` is (m, maxlen) uint8.
         Called by the scrub worker for rows whose members all verified."""
         k = len(hashes)
-        gid = blake2s_sum(b"".join(bytes(h) for h in hashes))
-        manifest = {
-            "v": MANIFEST_VERSION,
-            "k": k,
-            "m": int(parity.shape[0]),
-            "maxlen": int(parity.shape[1]),
-            "hashes": [bytes(h) for h in hashes],
-            "lengths": [int(n) for n in lengths],
-            "parity": [parity[i].tobytes() for i in range(parity.shape[0])],
-            "parity_sums": [
-                bytes(blake2s_sum(parity[i].tobytes()))
-                for i in range(parity.shape[0])
-            ],
-        }
+        gid = self._gid(k, int(parity.shape[0]), hashes)
         existing = self._find_group_path(bytes(gid))
         if existing is not None:
-            # gid is a hash of the member set, so an existing file has
-            # identical content: a fresh mtime (what the purge keys on)
-            # is all a stable codeword needs — skip rewriting ~m/k of
-            # the dataset every scrub pass
+            # gid hashes the member set AND the (version, k, m) geometry,
+            # so an existing file has identical content: a fresh mtime
+            # (what the purge keys on) is all a stable codeword needs —
+            # skip rewriting ~m/k of the dataset every scrub pass
             try:
                 os.utime(existing)
             except OSError:
                 existing = None
         if existing is None:
+            # manifest built only on the miss path: in steady state most
+            # codewords take the touch shortcut, and serializing + hashing
+            # ~m rows of parity per codeword per pass would dominate it
+            manifest = {
+                "v": MANIFEST_VERSION,
+                "k": k,
+                "m": int(parity.shape[0]),
+                "maxlen": int(parity.shape[1]),
+                "hashes": [bytes(h) for h in hashes],
+                "lengths": [int(n) for n in lengths],
+                "parity": [parity[i].tobytes() for i in range(parity.shape[0])],
+                "parity_sums": [
+                    bytes(blake2s_sum(parity[i].tobytes()))
+                    for i in range(parity.shape[0])
+                ],
+            }
             path = self._group_path(bytes(gid))
             os.makedirs(os.path.dirname(path), exist_ok=True)
             tmp = path + ".tmp"

@@ -69,7 +69,15 @@ def secret_from_file(path: str) -> str:
 @dataclass
 class CodecConfig:
     """TPU block-codec settings (new vs reference — the BlockCodec seam)."""
-    backend: str = "cpu"            # cpu | tpu | hybrid (cpu + device stealing)
+    # Default is the production path: CPU floor + opportunistic device
+    # stealing.  The device codec builds on a BACKGROUND thread
+    # (make_codec passes build_device="async"), so a daemon on a host
+    # with no/dead accelerator boots instantly on the CPU floor and the
+    # TPU joins in if/when its backend initializes — a tpu-native
+    # framework whose stock config never touched the TPU would undercut
+    # its own thesis.  Set "cpu" to pin the floor, "tpu" to require the
+    # device.
+    backend: str = "hybrid"         # hybrid (cpu + device stealing) | cpu | tpu
     hash_algo: str = "blake2s"      # blake2s (TPU-offloadable) | blake2b | sha256
     rs_data: int = 8                # Reed-Solomon k (0 = replication only, no RS)
     rs_parity: int = 4              # Reed-Solomon m

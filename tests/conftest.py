@@ -41,3 +41,26 @@ def pytest_configure(config):
 # the platform through the config API as well.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+# The production default codec backend is "hybrid" (async background
+# device attach).  In-process test clusters must stay deterministic: the
+# attach landing mid-test would switch scrub/verify between backends
+# run-to-run (bit-identical results, but timing-sensitive tests would
+# exercise different code paths) and pay per-manager jit overhead on the
+# 1-core CI host.  Inject backend="cpu" wherever a test config does not
+# choose one explicitly; hybrid/tpu behavior is covered by the dedicated
+# codec tests that opt in.
+import garage_tpu.utils.config as _gconf  # noqa: E402
+
+_orig_config_from_dict = _gconf.config_from_dict
+
+
+def _cpu_codec_default(d, *a, **kw):
+    d = dict(d)
+    codec = dict(d.get("codec") or {})
+    codec.setdefault("backend", "cpu")
+    d["codec"] = codec
+    return _orig_config_from_dict(d, *a, **kw)
+
+
+_gconf.config_from_dict = _cpu_codec_default

@@ -503,6 +503,45 @@ async def test_parity_sidecar_local_reconstruction(tmp_path):
     await shutdown(systems)
 
 
+async def test_parity_geometry_change_recovers_coverage(tmp_path):
+    """Regression: the sidecar group id must include the (k, m) codec
+    geometry.  With member-hashes-only gids, changing rs_parity made
+    put_codeword mtime-touch the OLD-geometry file every pass (so the
+    purge never removed it) while _load_manifest rejected it on its
+    (k, m) check — local-repair coverage was silently and permanently
+    lost for the codeword."""
+    from garage_tpu.block.parity import ParityStore
+    from garage_tpu.ops import make_codec
+
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    db = open_db("memory")
+    m.codec = make_codec("cpu", rs_data=4, rs_parity=2, batch_blocks=64)
+    store = ParityStore(m, db, m.codec)
+
+    datas = [os.urandom(5000 + i) for i in range(4)]
+    hs = [blake2s_sum(d) for d in datas]
+    for h, d in zip(hs, datas):
+        await m.write_block(h, DataBlock.plain(d))
+    parity = m.codec.rs_encode_blocks(datas)[0]
+    store.put_codeword(hs, [len(d) for d in datas], parity)
+    assert store.coverage(hs[0])
+
+    # operator changes rs_parity 2 → 3; same members re-encode
+    m.codec = make_codec("cpu", rs_data=4, rs_parity=3, batch_blocks=64)
+    store2 = ParityStore(m, db, m.codec)
+    assert not store2.coverage(hs[0]), "old-geometry sidecar must not count"
+    parity3 = m.codec.rs_encode_blocks(datas)[0]
+    store2.put_codeword(hs, [len(d) for d in datas], parity3)
+    # the new-geometry sidecar must be a NEW file (not a touch of the old
+    # one), loadable, and able to reconstruct
+    assert store2.coverage(hs[0])
+    found = m.find_block(hs[1])
+    os.remove(found[0])
+    assert store2.try_reconstruct(hs[1]) == datas[1]
+    await shutdown(systems)
+
+
 async def test_resync_prefers_local_parity_over_network(tmp_path):
     """The resync missing-block path reconstructs from the local parity
     sidecar BEFORE trying any replica — on a 1-node cluster there are no

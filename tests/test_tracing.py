@@ -201,36 +201,52 @@ async def test_api_request_emits_parented_spans(tmp_path):
     assert await req("PUT", "/tracebkt/obj", payload) == 200
     assert await req("GET", "/tracebkt/obj") == 200
 
-    await g.system.tracer.flush()
-    spans = []
-    for batch in received:
-        spans.extend(batch["resourceSpans"][0]["scopeSpans"][0]["spans"])
-    by_name = {}
-    for s in spans:
-        by_name.setdefault(s["name"], []).append(s)
-    assert "S3 PUT" in by_name and "S3 GET" in by_name
-    # the GET's table/RPC/block children share the request's trace id;
-    # under load a client retry can produce an extra root with no
-    # children, so ANY matching root carrying the full child set passes
-    get_roots = [s for s in by_name["S3 GET"]
-                 if any(a["key"] == "path" and
-                        a["value"]["stringValue"] == "/tracebkt/obj"
-                        for a in s["attributes"])]
-    assert get_roots
-    ok = False
-    for root in get_roots:
-        same_trace = [s for s in spans
-                      if s["traceId"] == root["traceId"]
-                      and s["name"] != "S3 GET"]
-        names = {s["name"] for s in same_trace}
-        if ("Table object get" in names
-                and any(n.startswith("RPC garage/table/object")
-                        for n in names)
-                and all("parentSpanId" in s for s in same_trace)):
-            ok = True
+    # Spans buffer when they END, and some children (quorum background
+    # drain, block IO) end in tasks scheduled after the response is sent —
+    # a single flush races with them under load.  Deterministic barrier:
+    # flush-and-check in a loop until the full child set has arrived (or
+    # a generous deadline proves it never will).
+    def _collect():
+        spans = []
+        for batch in received:
+            spans.extend(batch["resourceSpans"][0]["scopeSpans"][0]["spans"])
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        return spans, by_name
+
+    def _parented_get_trace_found(spans, by_name):
+        if "S3 PUT" not in by_name or "S3 GET" not in by_name:
+            return False
+        # a client retry can produce an extra root with no children, so
+        # ANY matching root carrying the full child set passes
+        get_roots = [s for s in by_name["S3 GET"]
+                     if any(a["key"] == "path" and
+                            a["value"]["stringValue"] == "/tracebkt/obj"
+                            for a in s["attributes"])]
+        for root in get_roots:
+            same_trace = [s for s in spans
+                          if s["traceId"] == root["traceId"]
+                          and s["name"] != "S3 GET"]
+            names = {s["name"] for s in same_trace}
+            if ("Table object get" in names
+                    and any(n.startswith("RPC garage/table/object")
+                            for n in names)
+                    and all("parentSpanId" in s for s in same_trace)):
+                return True
+        return False
+
+    deadline = asyncio.get_event_loop().time() + 10.0
+    while True:
+        await g.system.tracer.flush()
+        spans, by_name = _collect()
+        if _parented_get_trace_found(spans, by_name):
             break
-    assert ok, [ {s["name"] for s in spans
-                  if s["traceId"] == r["traceId"]} for r in get_roots ]
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(
+                f"parented GET trace never arrived; spans seen: "
+                f"{sorted(by_name)}, dropped={g.system.tracer.dropped}")
+        await asyncio.sleep(0.05)
 
     await server.stop()
     await g.shutdown()
