@@ -40,15 +40,23 @@ the device executable is AOT-warmed through the persistent XLA
 compilation cache WITHOUT spending link bandwidth; and if the device is
 dead the hybrid codec degrades to its CPU floor instead of reporting 0.
 
-Prints ONE JSON line:
-  {"metric": "scrub_rs84_throughput", "value": <hybrid GiB/s>,
-   "unit": "GiB/s", "vs_baseline": <hybrid/cpu>, "cpu_gibs": <cpu GiB/s>,
-   "tpu_frac": <fraction of bytes the device took>,
-   "put_p50_ms": <ms>, "put_p99_ms": <ms>}
+Prints ONE JSON line covering all five BASELINE configs:
+  value/vs_baseline/baseline_gibs/cpu_gibs/tpu_frac/device_gibs —
+    config #2 (fused scrub, hybrid headline + its decomposition);
+  put_p50_ms/put_p99_ms/put_get_p50_ms — config #1 (3-node 3-replica
+    PutObject/GetObject of 1 MiB objects; put_solo_* = 1-node shadow
+    for cross-round comparability);
+  rs42_put_4mib_p50_ms/rs42_covered_blocks/rs42_total_blocks —
+    config #3 (RS(4,2) encode ON the put path, write-time coverage);
+  rs84_repair_2loss_gibs — config #4's codec half (decode-repair of 2
+    lost members per codeword);
+  mp_mibs/mp_part_mibs_p50/mp_gib_moved — config #5 (10 GiB multipart,
+    time-capped, concurrent write-time RS + batched BLAKE2).
 """
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 import os
@@ -260,101 +268,221 @@ def bench_reference_serial(batches) -> float:
     return n * BLOCK / dt / 2**30
 
 
-# --- PutObject latency phase (BASELINE.md metric #2) ------------------------
+# --- S3-level phases (BASELINE configs #1, #3, #5) --------------------------
 #
-# Runs in a subprocess with JAX_PLATFORMS=cpu (the daemon path never needs
-# the device): 1-node in-process cluster + real S3ApiServer on loopback,
-# SigV4-signed 1 MiB PutObject requests, p50/p99 over N_PUTS.
+# Each runs in its own subprocess with JAX_PLATFORMS=cpu (the daemon path
+# never needs the device); all drive the REAL S3ApiServer with SigV4-signed
+# requests on loopback, on the native logdb engine.
 #
-# 120 samples, not 40: with 40, "p99" is the single worst sample, and on a
-# shared-tenancy 1-core VM one scheduler stall made r02 report p99 = 4.7×
-# p50 (59 ms).  With an honest sample count (and the put phase ordered
-# before the hybrid device drain) the tail is ~1.5-1.7× p50.  Runs on the
-# native logdb engine — the framework's default-engine slot.
+#   #1  put/get:  3-node in-process cluster, replication mode "3" (write
+#       quorum 2) — the reference's 3-replica dev-cluster shape.  120
+#       samples, not 40: with 40, "p99" is the single worst sample, and on
+#       a shared-tenancy 1-core VM one scheduler stall made r02 report
+#       p99 = 4.7× p50.
+#   #3  rs42-put: RS(4,2) encode ON the PutObject path (parity_on_write),
+#       4 MiB objects; also asserts every written block is parity-covered
+#       right after the last put + drain — no scrub pass involved.
+#   #5  mp10g:    one 10 GiB multipart upload (64 MiB parts), with
+#       concurrent write-time RS-encode + batched BLAKE2 — streamed until
+#       done or MP_TIME_CAP, reports sustained MiB/s and bytes moved.
 
 N_PUTS = 120
+RS42_PUTS = 12
+RS42_OBJ = 4 << 20
+MP_TOTAL = 10 << 30
+MP_PART = 64 << 20
+MP_TIME_CAP = 300.0
 
 
-async def _put_phase_async() -> dict:
+async def _mk_cluster(tmp, n=1, repl="none", codec_cfg=None, quotas=None):
+    """n in-process Garage daemons with an applied layout + one S3 server
+    on node 0; returns (garages, server, port, key_id, secret)."""
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.model import Garage
+    from garage_tpu.rpc.layout import ClusterLayout, NodeRole
+    from garage_tpu.utils.config import config_from_dict
+
+    garages = []
+    for i in range(n):
+        cfg = {
+            "metadata_dir": str(tmp / f"n{i}" / "meta"),
+            "data_dir": str(tmp / f"n{i}" / "data"),
+            "replication_mode": repl,
+            "rpc_bind_addr": "127.0.0.1:0",
+            "rpc_secret": "bench",
+            "db_engine": "native",
+            "bootstrap_peers": [],
+        }
+        if codec_cfg:
+            cfg["codec"] = dict(codec_cfg)
+        garages.append(Garage(config_from_dict(cfg)))
+    for g in garages:
+        await g.system.netapp.listen("127.0.0.1:0")
+    ports = [g.system.netapp._server.sockets[0].getsockname()[1]
+             for g in garages]
+    for i, a in enumerate(garages):
+        for j, b in enumerate(garages):
+            if i < j:
+                await a.system.netapp.connect(
+                    f"127.0.0.1:{ports[j]}", expected_id=b.system.id)
+        a.system.config.rpc_public_addr = f"127.0.0.1:{ports[i]}"
+    lay = garages[0].system.layout
+    for g in garages:
+        lay.stage_role(bytes(g.system.id), NodeRole("dc1", 1000))
+    lay.apply_staged_changes()
+    enc = lay.encode()
+    for g in garages:
+        g.system.layout = ClusterLayout.decode(enc)
+        g.system._rebuild_ring()
+        g.spawn_workers()
+
+    helper = garages[0].helper()
+    key = await helper.create_key("bench")
+    key.params().allow_create_bucket.update(True)
+    await garages[0].key_table.insert(key)
+    server = S3ApiServer(garages[0])
+    await server.start("127.0.0.1:0")
+    return garages, server, server.port, key.key_id, key.params().secret_key
+
+
+class _S3:
+    """Minimal SigV4 client against the in-process server."""
+
+    def __init__(self, session, port, kid, secret):
+        self.session, self.port, self.kid, self.secret = (
+            session, port, kid, secret)
+
+    async def req(self, method, path, body=b"", query=()):
+        import aiohttp  # noqa: F401
+        import yarl
+
+        from garage_tpu.api.signature import sign_request
+
+        headers = {"host": f"127.0.0.1:{self.port}"}
+        headers.update(sign_request(
+            self.kid, self.secret, "garage", method, path, list(query),
+            headers, body, path_is_raw=True,
+        ))
+        qs = "&".join(f"{k}={v}" for k, v in query)
+        url = yarl.URL(
+            f"http://127.0.0.1:{self.port}{path}" + (f"?{qs}" if qs else ""),
+            encoded=True)
+        async with self.session.request(
+            method, url, data=body, headers=headers,
+        ) as r:
+            return r.status, await r.read(), r.headers
+
+
+async def _put_phase_async(n=3, repl="3", prefix="put") -> dict:
+    """Config #1: 3-replica PutObject/GetObject of 1 MiB objects.
+    Also run as a 1-node shadow (prefix="put_solo") for cross-round
+    comparability: earlier rounds measured 1-node with a REUSED payload,
+    whose blocks dedup'd away the disk write — unique payloads plus 3
+    replicas is the honest config-#1 number and reads higher."""
     import pathlib
     import shutil
     import tempfile
 
     import aiohttp
-    import yarl
-
-    from garage_tpu.api.s3.api_server import S3ApiServer
-    from garage_tpu.api.signature import sign_request, uri_encode
-    from garage_tpu.model import Garage
-    from garage_tpu.rpc.layout import ClusterLayout, NodeRole
-    from garage_tpu.utils.config import config_from_dict
 
     tmp = pathlib.Path(tempfile.mkdtemp(prefix="garage_tpu_bench_"))
     try:
-        g = Garage(config_from_dict({
-            "metadata_dir": str(tmp / "meta"),
-            "data_dir": str(tmp / "data"),
-            "replication_mode": "none",
-            "rpc_bind_addr": "127.0.0.1:0",
-            "rpc_secret": "bench",
-            "db_engine": "native",
-            "bootstrap_peers": [],
-        }))
-        await g.system.netapp.listen("127.0.0.1:0")
-        lay = g.system.layout
-        lay.stage_role(bytes(g.system.id), NodeRole("dc1", 1000))
-        lay.apply_staged_changes()
-        g.system.layout = ClusterLayout.decode(lay.encode())
-        g.system._rebuild_ring()
-        g.spawn_workers()
-
-        helper = g.helper()
-        key = await helper.create_key("bench")
-        key.params().allow_create_bucket.update(True)
-        await g.key_table.insert(key)
-        server = S3ApiServer(g)
-        await server.start("127.0.0.1:0")
-        port = server.port
-        kid, secret = key.key_id, key.params().secret_key
-
-        payload = np.random.default_rng(1).integers(
-            0, 256, BLOCK, dtype=np.uint8
-        ).tobytes()
-
-        async def put(session, path):
-            headers = {"host": f"127.0.0.1:{port}"}
-            sig = sign_request(
-                kid, secret, "garage", "PUT", path, [], headers, payload,
-                path_is_raw=True,
-            )
-            headers.update(sig)
-            url = yarl.URL(f"http://127.0.0.1:{port}{path}", encoded=True)
-            t0 = time.perf_counter()
-            async with session.put(url, data=payload, headers=headers) as r:
-                await r.read()
-                assert r.status == 200, r.status
-            return (time.perf_counter() - t0) * 1000.0
-
+        # backend pinned to cpu: the latency phase must not let the hybrid
+        # default's background device-init thread drag the accelerator
+        # backend (and its init stalls) into a subprocess that never
+        # batches anything
+        garages, server, port, kid, secret = await _mk_cluster(
+            tmp, n=n, repl=repl, codec_cfg={"backend": "cpu"})
+        rng = np.random.default_rng(1)
         async with aiohttp.ClientSession() as session:
-            # create bucket
-            headers = {"host": f"127.0.0.1:{port}"}
-            sig = sign_request(kid, secret, "garage", "PUT", "/benchbkt",
-                               [], headers, b"", path_is_raw=True)
-            headers.update(sig)
-            async with session.put(
-                yarl.URL(f"http://127.0.0.1:{port}/benchbkt", encoded=True),
-                headers=headers,
-            ) as r:
-                assert r.status == 200, r.status
-            await put(session, "/benchbkt/warmup")  # warmup
-            lat = []
+            s3 = _S3(session, port, kid, secret)
+            st, _b, _h = await s3.req("PUT", "/benchbkt")
+            assert st == 200, st
+            await s3.req("PUT", "/benchbkt/warmup",
+                         rng.integers(0, 256, BLOCK, dtype=np.uint8).tobytes())
+            put_lat, get_lat = [], []
             for i in range(N_PUTS):
-                lat.append(await put(session, f"/benchbkt/obj-{i:04d}"))
+                # unique payload per object: identical blocks dedup (both
+                # here and in the reference, manager.rs:717-735) and would
+                # skip the disk write the latency is supposed to include
+                payload = rng.integers(0, 256, BLOCK, dtype=np.uint8).tobytes()
+                t0 = time.perf_counter()
+                st, _b, _h = await s3.req("PUT", f"/benchbkt/obj-{i:04d}", payload)
+                put_lat.append((time.perf_counter() - t0) * 1000.0)
+                assert st == 200, st
+            for i in range(0, N_PUTS, 4):
+                t0 = time.perf_counter()
+                st, body, _h = await s3.req("GET", f"/benchbkt/obj-{i:04d}")
+                get_lat.append((time.perf_counter() - t0) * 1000.0)
+                assert st == 200 and len(body) == BLOCK
 
+        put_lat.sort()
+        get_lat.sort()
+        out = {
+            f"{prefix}_p50_ms": round(put_lat[len(put_lat) // 2], 2),
+            f"{prefix}_p99_ms": round(
+                put_lat[min(len(put_lat) - 1, int(len(put_lat) * 0.99))], 2),
+            f"{prefix}_get_p50_ms": round(get_lat[len(get_lat) // 2], 2),
+        }
+        await server.stop()
+        for g in garages:
+            await g.shutdown()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+async def _rs_put_phase_async() -> dict:
+    """Config #3: RS(4,2) encode on the PutObject path, 4 MiB objects.
+    Reports per-object latency AND verifies parity coverage exists right
+    after the puts (write-time encoding, no scrub)."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="garage_tpu_bench_rs_"))
+    try:
+        garages, server, port, kid, secret = await _mk_cluster(
+            tmp, n=1, repl="none", codec_cfg={
+                "rs_data": 4, "rs_parity": 2,
+                "store_parity": True, "parity_on_write": True,
+                "backend": "cpu",
+            })
+        g = garages[0]
+        rng = np.random.default_rng(2)
+        async with aiohttp.ClientSession() as session:
+            s3 = _S3(session, port, kid, secret)
+            st, _b, _h = await s3.req("PUT", "/rsbkt")
+            assert st == 200, st
+            await s3.req(
+                "PUT", "/rsbkt/warmup",
+                rng.integers(0, 256, RS42_OBJ, dtype=np.uint8).tobytes())
+            lat = []
+            for i in range(RS42_PUTS):
+                # unique payload per object — identical payloads dedup to
+                # the same stored blocks and skip the write entirely
+                payload = rng.integers(
+                    0, 256, RS42_OBJ, dtype=np.uint8).tobytes()
+                t0 = time.perf_counter()
+                st, _b, _h = await s3.req("PUT", f"/rsbkt/obj-{i:03d}", payload)
+                lat.append((time.perf_counter() - t0) * 1000.0)
+                assert st == 200, st
+        await g.block_manager.write_parity.drain()
+        store = g.block_manager.parity_store
+        covered = store.stats()["indexed_blocks"]
+        total_blocks = sum(
+            1 for _ in _iter_block_files(tmp / "n0" / "data"))
+        # every stored block must be parity-covered with zero scrub
+        # passes — a silent write-time coverage regression must FAIL the
+        # phase, not just skew a field nothing checks
+        assert covered == total_blocks, (covered, total_blocks)
         lat.sort()
         out = {
-            "put_p50_ms": round(lat[len(lat) // 2], 2),
-            "put_p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2),
+            "rs42_put_4mib_p50_ms": round(lat[len(lat) // 2], 2),
+            "rs42_covered_blocks": covered,
+            "rs42_total_blocks": total_blocks,
         }
         await server.stop()
         await g.shutdown()
@@ -363,31 +491,157 @@ async def _put_phase_async() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def run_put_phase_subprocess() -> dict:
+def _iter_block_files(root):
+    for dirpath, _dirs, files in os.walk(root):
+        if os.path.basename(os.path.dirname(dirpath)) == "parity" or \
+                "parity" in dirpath.split(os.sep):
+            continue
+        for f in files:
+            if not f.endswith((".par", ".tmp")):
+                yield os.path.join(dirpath, f)
+
+
+async def _mp_phase_async() -> dict:
+    """Config #5: one 10 GiB S3 multipart upload (64 MiB parts) with
+    write-time RS(8,4) encode + batched BLAKE2 running concurrently.
+    Time-capped; reports sustained MiB/s over whatever it moved."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="garage_tpu_bench_mp_"))
+    try:
+        garages, server, port, kid, secret = await _mk_cluster(
+            tmp, n=1, repl="none", codec_cfg={
+                "store_parity": True, "parity_on_write": True,
+                "backend": "cpu",
+            })
+        g = garages[0]
+        rng = np.random.default_rng(3)
+        base = rng.integers(0, 256, MP_PART, dtype=np.uint8)
+        moved = 0
+        async with aiohttp.ClientSession() as session:
+            s3 = _S3(session, port, kid, secret)
+            st, _b, _h = await s3.req("PUT", "/mpbkt")
+            assert st == 200, st
+            st, body, _h = await s3.req("POST", "/mpbkt/big", query=[("uploads", "")])
+            assert st == 200, (st, body[:200])
+            upload_id = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0]
+            uid = upload_id.decode()
+            etags = []
+            part_rates = []
+            t0 = time.perf_counter()
+            n_parts = MP_TOTAL // MP_PART
+            for pn in range(1, n_parts + 1):
+                # stamp the part number into every 1 MiB block so each
+                # stored block is unique — identical blocks dedup and
+                # would skip the disk writes being measured
+                base[::BLOCK] = pn & 0xFF
+                base[1::BLOCK] = (pn >> 8) & 0xFF
+                part = base.tobytes()
+                tp = time.perf_counter()
+                st, _b, hdr = await s3.req(
+                    "PUT", "/mpbkt/big", part,
+                    query=[("partNumber", str(pn)), ("uploadId", uid)])
+                assert st == 200, st
+                part_rates.append(
+                    len(part) / (time.perf_counter() - tp) / 2**20)
+                moved += len(part)
+                etags.append((pn, hdr.get("ETag", "").strip('"')))
+                if time.perf_counter() - t0 > MP_TIME_CAP:
+                    break
+            dt = time.perf_counter() - t0
+            # complete (validated against the recorded part etags)
+            xml = ("<CompleteMultipartUpload>" + "".join(
+                f"<Part><PartNumber>{pn}</PartNumber><ETag>{et}</ETag></Part>"
+                for pn, et in etags) + "</CompleteMultipartUpload>").encode()
+            st, body, _h = await s3.req(
+                "POST", "/mpbkt/big", xml, query=[("uploadId", uid)])
+            assert st == 200, (st, body[:300])
+        part_rates.sort()
+        out = {
+            "mp_mibs": round(moved / dt / 2**20, 1),
+            "mp_part_mibs_p50": round(part_rates[len(part_rates) // 2], 1),
+            "mp_gib_moved": round(moved / 2**30, 2),
+        }
+        await server.stop()
+        await g.shutdown()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _put_solo_phase_async():
+    return _put_phase_async(n=1, repl="none", prefix="put_solo")
+
+
+_PHASES = {
+    "--put-phase": _put_phase_async,
+    "--put-solo-phase": _put_solo_phase_async,
+    "--rs-put-phase": _rs_put_phase_async,
+    "--mp-phase": _mp_phase_async,
+}
+
+
+def run_phase_subprocess(flag: str, timeout: float = 600) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # drain the previous phase's writeback so its dirty pages don't stall
+    # this phase's writes (phases share one disk and one core)
+    try:
+        os.sync()
+    except OSError:
+        pass
     try:
         r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--put-phase"],
-            capture_output=True, text=True, timeout=600, env=env,
+            [sys.executable, os.path.abspath(__file__), flag],
+            capture_output=True, text=True, timeout=timeout, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         for line in reversed(r.stdout.strip().splitlines()):
             if line.startswith("{"):
                 return json.loads(line)
-        print(f"# put phase failed rc={r.returncode}: "
+        print(f"# {flag} failed rc={r.returncode}: "
               f"{r.stderr.strip()[-400:]}", file=sys.stderr)
     except subprocess.TimeoutExpired:
-        print("# put phase timed out", file=sys.stderr)
+        print(f"# {flag} timed out", file=sys.stderr)
     return {}
 
 
-def main() -> None:
-    if "--put-phase" in sys.argv:
-        import asyncio
+def bench_repair(batches) -> float:
+    """Config #4's codec half: RS(8,4) decode-repair rate with 2 data
+    shards lost per codeword (the per-codeword effect of 2 node
+    failures; the cluster half — resync pulling cross-node pieces — is
+    exercised by the integration tests).  Reports GiB/s of RECOVERED
+    data (the 2 missing members) through the decode kernel."""
+    from garage_tpu.ops import make_codec
 
-        print(json.dumps(asyncio.run(_put_phase_async())))
-        return
+    codec = make_codec("cpu", rs_data=K, rs_parity=M, batch_blocks=BATCH)
+    blocks, _hashes = batches[0]
+    n_cw = len(blocks) // K
+    data = np.stack([np.frombuffer(b, dtype=np.uint8) for b in blocks])
+    shards = np.ascontiguousarray(data.reshape(n_cw, K, BLOCK))
+    parity = codec.rs_encode(shards)
+    # lose members 2 and 5 of every codeword; decode from 6 data + 2 parity
+    present = [0, 1, 3, 4, 6, 7, K, K + 1]
+    surv = np.concatenate(
+        [shards[:, [0, 1, 3, 4, 6, 7], :], parity[:, :2, :]], axis=1)
+    codec.rs_reconstruct(surv[:1], present, rows=[2, 5])  # warm
+    t0 = time.perf_counter()
+    rec = codec.rs_reconstruct(surv, present, rows=[2, 5])
+    dt = time.perf_counter() - t0
+    assert (rec[:, 0, :] == shards[:, 2, :]).all()
+    assert (rec[:, 1, :] == shards[:, 5, :]).all()
+    return n_cw * 2 * BLOCK / dt / 2**30
+
+
+def main() -> None:
+    for flag, phase in _PHASES.items():
+        if flag in sys.argv:
+            print(json.dumps(asyncio.run(phase())))
+            return
 
     os.makedirs(JAX_CACHE_DIR, exist_ok=True)
     rng = np.random.default_rng(0)
@@ -403,11 +657,26 @@ def main() -> None:
 
     # Everything that must not be contaminated by the hybrid phase's
     # background device drain runs FIRST (1-core host): the serial
-    # reference baseline, the CPU floor, and the put-latency phase.
-    baseline = bench_reference_serial(batches)
-    cpu = bench_cpu(batches)
-    extra = run_put_phase_subprocess()
+    # reference baseline, the CPU floor, repair decode, and the
+    # S3-level subprocess phases (BASELINE configs #1, #3, #5).
+    #
+    # The cheap in-process phases take BEST-OF-TWO, and the baseline is
+    # re-measured again right before the hybrid phase: this host sees
+    # multi-minute CPU-steal storms (observed: an entire early-phase
+    # window running 3-60× slow while the final phase of the same run was
+    # full speed), so a single sample — or a numerator and denominator
+    # from different time windows — can misrepresent either side by
+    # several ×.  Max-of-samples compares best-case to best-case.
+    baseline = max(bench_reference_serial(batches),
+                   bench_reference_serial(batches))
+    cpu = max(bench_cpu(batches), bench_cpu(batches))
+    repair = max(bench_repair(batches), bench_repair(batches))
+    extra = run_phase_subprocess("--put-phase")
+    extra.update(run_phase_subprocess("--put-solo-phase"))
+    extra.update(run_phase_subprocess("--rs-put-phase"))
+    extra.update(run_phase_subprocess("--mp-phase", timeout=MP_TIME_CAP + 180))
 
+    baseline = max(baseline, bench_reference_serial(batches))
     hybrid, tpu_frac, device_gibs = 0.0, 0.0, 0.0
     try:
         hybrid, tpu_frac, device_gibs = bench_hybrid(batches, tpu_ok)
@@ -423,6 +692,7 @@ def main() -> None:
         "cpu_gibs": round(cpu, 4),
         "tpu_frac": round(tpu_frac, 4),
         "device_gibs": round(device_gibs, 4),
+        "rs84_repair_2loss_gibs": round(repair, 4),
         **extra,
     }))
 

@@ -115,8 +115,13 @@ class AdminRpcHandler:
         }
 
     async def _cmd_connect(self, msg) -> str:
+        from ..utils.data import Uuid
+
         addr = msg["addr"]
-        expected = bytes.fromhex(msg["node_id"]) if msg.get("node_id") else None
+        # Uuid, not raw bytes: netapp's id-mismatch diagnostics call
+        # hex_short() on it (a raw-bytes expected_id turned a clean
+        # "peer is X, expected Y" error into an AttributeError)
+        expected = Uuid(bytes.fromhex(msg["node_id"])) if msg.get("node_id") else None
         await self.garage.system.netapp.connect(addr, expected_id=expected)
         self.garage.system.peering.add_peer(addr, expected)
         return "connected"
@@ -619,9 +624,17 @@ class AdminRpcHandler:
         g = self.garage
         n = 0
         data = g.block_ref_table.data
+        from ..model.parity_index_table import is_parity_ref
+
         for _k, raw in list(data.store.items(b"", None)):
             br = data.decode_entry(raw)
             if br.deleted.value:
+                continue
+            if is_parity_ref(br.version):
+                # distributed-parity refs answer to the parity index
+                # (tombstoned by its hook on codeword death), not to the
+                # version table — reaping them here would orphan live
+                # parity shards
                 continue
             v = await g.version_table.get(br.version, "")
             if v is None or v.deleted.value:

@@ -177,56 +177,103 @@ async def save_stream(
         raise
 
 
+# Streaming write batching knobs.  HASH_WINDOW: blocks hashed per worker
+# hop — ≥4 engages the 8-way SIMD BLAKE2s kernel (4.6× hashlib), and one
+# to_thread hop amortizes over the window.  META_BATCH: the version row
+# (whole-row CRDT re-insert whose hook creates block refs) lands every
+# N blocks instead of every block — ~9 metadata commits/block measured
+# down to ~1/block.  Cost: a crash mid-upload can orphan up to
+# META_BATCH written-but-unreferenced blocks (the reference's concurrent
+# block/meta writes have the same window at 1 block); `repair blocks`
+# reclaims them, and the final insert still precedes the Complete row.
+HASH_WINDOW = 8
+META_BATCH = 8
+
+
 async def read_and_put_blocks(
     ctx, version: Version, part_number: int, first_block: bytes,
     chunker: Chunker, md5, sha256,
 ) -> Tuple[int, Hash]:
-    """Pipelined per-block loop (ref put.rs:286-360): overlap the block
-    quorum-write + version-meta insert with reading/hashing the next
-    chunk.  Returns (total_size, first_block_hash)."""
+    """Windowed streaming loop (ref put.rs:286-360 is strictly per-block):
+    read up to HASH_WINDOW blocks ahead, hash the window in one worker
+    hop (SIMD multi-buffer BLAKE2s; md5/sha256 advance sequentially in
+    the same hop), pipeline the per-block quorum writes, and batch the
+    version-meta inserts.  Returns (total_size, first_block_hash)."""
     garage = ctx.garage
     algo = garage.block_manager.hash_algo
+    codec = garage.block_manager.codec
     offset = 0
-    block = first_block
     first_hash: Optional[Hash] = None
     put_task: Optional[asyncio.Task] = None
+    unflushed = 0
 
-    async def put_one(h: Hash, data: bytes, off: int):
+    async def put_one(h: Hash, data: bytes, off: int, flush_meta: bool):
+        # add_block runs HERE, not in the dispatch loop: a concurrent
+        # flush insert must never encode a version row referencing a
+        # block whose quorum write has not started (crash would leave
+        # replicas holding rc for a hash no node stores).  Inside the
+        # task, the row only ever includes blocks whose write is at
+        # least concurrent with the insert — the reference's window.
         version.add_block(part_number, off, bytes(h), len(data))
-        # insert updated version row (hook creates the block ref) in
-        # parallel with the block quorum write (put.rs:362-390)
-        await asyncio.gather(
-            garage.block_manager.rpc_put_block(h, data),
-            garage.version_table.insert(version),
-        )
+        if flush_meta:
+            # version row (hook creates the block refs) in parallel with
+            # the block quorum write (put.rs:362-390)
+            await asyncio.gather(
+                garage.block_manager.rpc_put_block(h, data),
+                garage.version_table.insert(version),
+            )
+        else:
+            await garage.block_manager.rpc_put_block(h, data)
+
+    def hash_window(window):
+        for b in window:
+            md5.update(b)
+            sha256.update(b)
+        if len(window) >= 4:
+            return codec.batch_hash(window)
+        return [block_hash(b, algo) for b in window]
 
     try:
+        block = first_block
         while block:
-            # First block hashes inline: single-block objects (the p50
-            # latency case) skip the executor hop entirely.  Subsequent
-            # blocks take ONE worker-thread hop each — md5+sha256+content
-            # hash advance together off the event loop (ref
-            # util/async_hash.rs semantics at a third of the hops; a
-            # dedicated AsyncHasher thread pair costs ~2 ms/request in
-            # spawns, measured)
-            if (offset == 0 and chunker.eof and not chunker.buf
-                    and len(block) <= (1 << 20)):
-                # truly single-block body — nothing follows to overlap
-                # with, and ≤1 MiB bounds the inline loop stall to the
-                # few ms that measurably beat the executor hop; larger
-                # single blocks (big block_size configs) stay off-loop
-                h = _hash_block(md5, sha256, block, algo)
+            window = [block]
+            while len(window) < HASH_WINDOW:
+                nb = await chunker.next()
+                if nb is None:
+                    break
+                window.append(nb)
+            if (offset == 0 and len(window) == 1 and chunker.eof
+                    and not chunker.buf and len(window[0]) <= (1 << 20)):
+                # truly single-block body (the p50 latency case): hash
+                # inline — nothing follows to overlap with, and ≤1 MiB
+                # bounds the loop stall to less than an executor hop
+                hashes = [hash_window(window)[0]]
             else:
-                h = await asyncio.to_thread(
-                    _hash_block, md5, sha256, block, algo)
-            if first_hash is None:
-                first_hash = h
-            if put_task is not None:
-                await put_task
-            put_task = asyncio.ensure_future(put_one(h, block, offset))
-            offset += len(block)
+                hashes = await asyncio.to_thread(hash_window, window)
+            for b, h in zip(window, hashes):
+                if first_hash is None:
+                    first_hash = h
+                unflushed += 1
+                if put_task is not None:
+                    await put_task
+                flush = unflushed >= META_BATCH
+                if flush:
+                    unflushed = 0
+                put_task = asyncio.ensure_future(
+                    put_one(h, b, offset, flush))
+                offset += len(b)
             block = await chunker.next()
-        if put_task is not None:
+        # the version row must hold every block before the caller lands
+        # the Complete object row (a racing GET could miss the tail);
+        # gathering with the final block write keeps the small-object
+        # overlap the per-block path always had
+        if put_task is not None and unflushed:
+            # one yield guarantees the task's synchronous prefix (its
+            # add_block) ran before the insert encodes the row
+            await asyncio.sleep(0)
+            await asyncio.gather(
+                put_task, garage.version_table.insert(version))
+        elif put_task is not None:
             await put_task
     except BaseException:
         if put_task is not None:

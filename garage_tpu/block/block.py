@@ -39,11 +39,17 @@ class DataBlockHeader:
 
 
 class DataBlock:
-    __slots__ = ("compressed", "inner")
+    # `parity`: this block is a distributed-parity shard (travels with
+    # fetches so re-writes on other nodes keep it out of the write-time
+    # codeword accumulators — parity of parity protects nothing the
+    # decode can use)
+    __slots__ = ("compressed", "inner", "parity")
 
-    def __init__(self, inner: bytes, compressed: bool):
+    def __init__(self, inner: bytes, compressed: bool,
+                 parity: bool = False):
         self.inner = inner
         self.compressed = compressed
+        self.parity = parity
 
     @classmethod
     def plain(cls, data: bytes) -> "DataBlock":

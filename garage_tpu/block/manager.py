@@ -79,6 +79,11 @@ class BlockManager:
             os.makedirs(d.path, exist_ok=True)
 
         self.rc = BlockRc(db.open_tree("block_local_rc"))
+        # node-local record of which stored blocks are distributed-parity
+        # shards: the is_parity RPC flag is transient, but resync
+        # refetches and offload transfers must not feed parity back into
+        # the accumulators (parity-of-parity cascade)
+        self._parity_marks = db.open_tree("block_parity_marks")
         self._locks = [asyncio.Lock() for _ in range(MUTEX_SHARDS)]
 
         self.endpoint = system.netapp.endpoint("garage/block")
@@ -88,6 +93,15 @@ class BlockManager:
         self.resync = None
         # attached by Garage when RS parity sidecars are enabled
         self.parity_store = None
+        # attached by Garage when codec.parity_on_write is also enabled:
+        # locally-stored blocks join write-time codewords → LOCAL sidecars
+        self.write_parity = None
+        # attached by Garage when codec.parity_distribute is enabled:
+        # blocks THIS node writes into the cluster join distinct-node
+        # codewords whose parity is distributed cross-node
+        self.ec_accumulator = None
+        # async h -> plain bytes | None, decoding from cross-node pieces
+        self.parity_reconstructor = None
         self.blocks_reconstructed = 0
 
         # metrics counters (ref block/metrics.rs:7-127)
@@ -161,12 +175,36 @@ class BlockManager:
             f"Block {op}", block=bytes(h).hex()[:16], op=op
         )
 
-    async def write_block(self, h: Hash, data: DataBlock) -> None:
-        with self._span("write", h), maybe_time(self.m_write_dur):
-            async with self._lock_for(h):
-                await asyncio.to_thread(self._write_block_sync, h, data)
+    def is_parity_block(self, h: Hash) -> bool:
+        """Was this hash ever stored here as a distributed-parity shard?"""
+        return self._parity_marks.get(bytes(h)) is not None
 
-    def _write_block_sync(self, h: Hash, data: DataBlock) -> None:
+    def is_assigned(self, h: Hash) -> bool:
+        """Is this node in the block's data replica set?  (With
+        data_replication_mode < replication_mode, the block_ref/rc
+        partition holds rc on nodes the data ring does NOT assign.)"""
+        return any(bytes(n) == bytes(self.system.id)
+                   for n in self.replication.write_nodes(h))
+
+    async def write_block(self, h: Hash, data: DataBlock,
+                          is_parity: bool = False) -> None:
+        with self._span("write", h), maybe_time(self.m_write_dur):
+            if is_parity and not self.is_parity_block(h):
+                self._parity_marks.insert(bytes(h), b"1")
+            with_parity = is_parity or self.is_parity_block(h)
+            async with self._lock_for(h):
+                wrote = await asyncio.to_thread(
+                    self._write_block_sync, h, data
+                )
+            if wrote and self.write_parity is not None and not with_parity:
+                # write-time RS: the block joins an in-progress codeword;
+                # encoding happens off this path (see WriteParityAccumulator).
+                # Parity blocks themselves are excluded — wrapping parity
+                # into further codewords would cascade encode rounds
+                # across the cluster for no durability the decode can use.
+                self.write_parity.add(h, data)
+
+    def _write_block_sync(self, h: Hash, data: DataBlock) -> bool:
         root = self.data_layout.primary_dir(h)
         final = self.block_path(root, h, data.compressed)
         existing = self.find_block(h)
@@ -175,7 +213,7 @@ class BlockManager:
             if compressed or not data.compressed:
                 # an equal-or-better copy exists (compressed preferred):
                 # keep it (ref manager.rs:717-735 dedupe)
-                return
+                return False
         d = os.path.dirname(final)
         os.makedirs(d, exist_ok=True)
         tmp = final + ".tmp"
@@ -199,6 +237,7 @@ class BlockManager:
             except OSError:
                 pass
         self.bytes_written += len(data.inner)
+        return True
 
     async def read_block(self, h: Hash) -> DataBlock:
         """Read + verify; on corruption move the file aside and requeue a
@@ -227,7 +266,11 @@ class BlockManager:
 
     async def delete_if_unneeded(self, h: Hash) -> None:
         """Delete the local copy if rc says it's deletable (resync path,
-        ref resync.rs:431-455)."""
+        ref resync.rs:431-455).  Deliberately NO cluster-wide side
+        effects here: local deletion also happens during migration and
+        offload, which says nothing about the block's global liveness
+        (the distributed-parity GC listens to the block_ref table's
+        global deletion signal instead)."""
         async with self._lock_for(h):
             if not self.rc.get(h).is_deletable():
                 return
@@ -258,19 +301,30 @@ class BlockManager:
 
     # --- RPC client side ---
 
-    async def rpc_put_block(self, h: Hash, data: bytes) -> None:
+    async def rpc_put_block(self, h: Hash, data: bytes,
+                            is_parity: bool = False) -> None:
         """Compress + quorum-write to the block's replica set
-        (ref manager.rs:356-377)."""
+        (ref manager.rs:356-377).  is_parity marks distributed-parity
+        shards so receiving nodes don't wrap them into codewords of
+        their own."""
         who = self.replication.write_nodes(h)
+        # re-sends of a shard this node stored as parity (resync offload,
+        # repair re-push) must carry the flag even when the caller
+        # doesn't know the provenance
+        is_parity = is_parity or self.is_parity_block(h)
         block = await asyncio.to_thread(
             DataBlock.from_buffer, data, self.compression_level
         )
         from ..rpc.rpc_helper import RequestStrategy
 
         async def send(node):
+            msg = {"t": "put_block", "h": bytes(h),
+                   "hdr": block.header().pack()}
+            if is_parity:
+                msg["parity"] = True
             await self.endpoint.call(
                 node,
-                {"t": "put_block", "h": bytes(h), "hdr": block.header().pack()},
+                msg,
                 prio=PRIO_NORMAL,
                 timeout=BLOCK_RW_TIMEOUT,
                 body=_chunks(block.inner),
@@ -287,6 +341,15 @@ class BlockManager:
             ),
             make_call=send,
         )
+        if (self.ec_accumulator is not None and not is_parity
+                and not self.ec_accumulator.recently_added(h)):
+            # writer-side distributed codewords: grouping HERE (not on the
+            # storing node) is what spreads a codeword's members across
+            # distinct nodes — see WriteParityAccumulator's invariant note.
+            # recently_added dedups re-PUTs of identical content, which
+            # would otherwise mint a fresh codeword (new gid, new parity
+            # blocks, new index rows) for an unchanged block every upload.
+            self.ec_accumulator.add(h, block)
 
     async def rpc_get_block(self, h: Hash, order_tag: Optional[int] = None) -> bytes:
         """Fetch + decompress a block, trying replicas one at a time in
@@ -314,7 +377,10 @@ class BlockManager:
                 finally:
                     if stream is not None:
                         await stream.aclose()  # no-op if fully consumed
-                return DataBlock(raw, DataBlockHeader.unpack(resp["hdr"]).compressed)
+                return DataBlock(
+                    raw, DataBlockHeader.unpack(resp["hdr"]).compressed,
+                    parity=bool(resp.get("parity")),
+                )
             except Exception as e:
                 errors.append(f"{bytes(node).hex()[:8]}: {e}")
         raise GarageError(
@@ -381,8 +447,13 @@ class BlockManager:
         )
 
     async def need_block(self, h: Hash) -> bool:
-        """Do we need a copy of this block? (rc>0 but no local file)"""
-        return self.rc.get(h).is_needed() and not self.is_block_present(h)
+        """Do we need a copy of this block? (ring-assigned + rc>0 but no
+        local file; the assignment check keeps rc holders outside the
+        data ring — possible when data_replication_mode differs — from
+        accumulating copies)"""
+        return (self.rc.get(h).is_needed()
+                and not self.is_block_present(h)
+                and self.is_assigned(h))
 
     # --- RPC server side (ref manager.rs:671-687) ---
 
@@ -392,7 +463,8 @@ class BlockManager:
             h = Hash(bytes(msg["h"]))
             hdr = DataBlockHeader.unpack(msg["hdr"])
             raw = await body.read_all() if body is not None else b""
-            await self.write_block(h, DataBlock(raw, hdr.compressed))
+            await self.write_block(h, DataBlock(raw, hdr.compressed),
+                                   is_parity=bool(msg.get("parity")))
             return {"ok": True}, None
         if t == "get_block":
             h = Hash(bytes(msg["h"]))
@@ -400,7 +472,10 @@ class BlockManager:
                 block = await self.read_block(h)
             except (NoSuchBlock, CorruptData) as e:
                 return {"err": str(e)}, None
-            return {"hdr": block.header().pack()}, _chunks(block.inner)
+            hdr = {"hdr": block.header().pack()}
+            if self.is_parity_block(h):
+                hdr["parity"] = True
+            return hdr, _chunks(block.inner)
         if t == "need_block":
             h = Hash(bytes(msg["h"]))
             return {"needed": await self.need_block(h)}, None

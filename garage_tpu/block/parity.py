@@ -94,10 +94,18 @@ class ParityStore:
         lengths: Sequence[int],
         parity: np.ndarray,
     ) -> None:
-        """Persist one codeword's parity: `hashes`/`lengths` are the k
-        member blocks in codeword order, `parity` is (m, maxlen) uint8.
-        Called by the scrub worker for rows whose members all verified."""
-        k = len(hashes)
+        """Persist one codeword's parity: `hashes`/`lengths` are the j ≤ k
+        member blocks in codeword order, `parity` is (m, maxlen) uint8
+        encoded at the codec's (k, m) geometry.  j < k means a PARTIAL
+        codeword (write-time encoding flushes one before k blocks
+        accumulate): members j..k-1 are implicit all-zero shards —
+        GF-linear, so the parity is identical to a k-member codeword
+        whose tail members are zero, and reconstruction counts the zero
+        shards as always-available pieces.  Called by the scrub worker
+        (full rows whose members all verified) and the write-path
+        accumulator (possibly partial)."""
+        k = self.codec.params.rs_data
+        assert 0 < len(hashes) <= k, (len(hashes), k)
         gid = self._gid(k, int(parity.shape[0]), hashes)
         existing = self._find_group_path(bytes(gid))
         if existing is not None:
@@ -157,6 +165,8 @@ class ParityStore:
         if (man["k"] != self.codec.params.rs_data
                 or man["m"] != self.codec.params.rs_parity):
             return None
+        if len(man["hashes"]) > man["k"]:
+            return None  # malformed
         return man
 
     def coverage(self, h: Hash) -> bool:
@@ -193,6 +203,14 @@ class ParityStore:
             present.append(i)
             if len(present) >= k:
                 break
+        # implicit zero shards of a partial codeword: members j..k-1 are
+        # all-zero by construction, always "present" at no cost
+        if len(present) < k:
+            for i in range(len(hashes), k):
+                pieces.append(np.zeros(maxlen, dtype=np.uint8))
+                present.append(i)
+                if len(present) >= k:
+                    break
         # parity shards as needed
         if len(present) < k:
             for j in range(m):
@@ -209,12 +227,15 @@ class ParityStore:
 
         shards = np.stack(pieces)[None, :, :]  # (1, p, maxlen)
         try:
-            data = self.codec.rs_reconstruct(shards, present)[0]  # (k, maxlen)
+            # rows=[target_i]: a single-block repair pays for ONE decoded
+            # row, not all k (k× GF work saving)
+            data = self.codec.rs_reconstruct(
+                shards, present, rows=[target_i])[0]  # (1, maxlen)
         except Exception:
             logger.exception("parity reconstruction failed for %s",
                              bytes(h).hex()[:16])
             return None
-        out = data[target_i].tobytes()[: man["lengths"][target_i]]
+        out = data[0].tobytes()[: man["lengths"][target_i]]
         if bytes(block_hash(out, self.manager.hash_algo)) != bytes(h):
             logger.warning(
                 "parity reconstruction of %s produced wrong hash "
@@ -290,3 +311,235 @@ class ParityStore:
 
     def stats(self) -> dict:
         return {"indexed_blocks": len(self.index)}
+
+
+# Distributed parity shards carry an 8-byte header {magic, salt}: the
+# salt is searched so the shard's CONTENT HASH — which is its identity
+# and therefore its ring placement — lands on a node carrying no other
+# piece of the codeword.  Without it, hash-random placement can stack
+# several pieces on one node and a single node loss can exceed m.  With
+# it (and the accumulator's distinct-member-node invariant), a codeword
+# of k+m pieces occupies k+m distinct nodes whenever the cluster has
+# that many — deterministic m-node-loss tolerance, not probabilistic.
+PARITY_SHARD_MAGIC = b"GTPS"
+PARITY_SHARD_HEADER = 8
+_SALT_TRIES = 32
+
+
+def pack_parity_shard(shard: bytes, salt: int) -> bytes:
+    import struct
+
+    return PARITY_SHARD_MAGIC + struct.pack("<I", salt) + shard
+
+
+def unpack_parity_shard(blob: bytes) -> Optional[bytes]:
+    if blob[:4] != PARITY_SHARD_MAGIC:
+        return None
+    return blob[PARITY_SHARD_HEADER:]
+
+
+class ParityDistributor:
+    """Cross-node half of write-time parity: stores each parity shard as
+    an ordinary refcounted BLOCK (ring-placed on the cluster, fetched via
+    rpc_get_block, scrubbed like any block) and records the codeword in
+    the replicated parity index table, sharded by member hash.  See
+    model/parity_index_table.py for the durability economics vs the
+    reference's replication-only model."""
+
+    def __init__(self, manager, parity_index_table):
+        self.manager = manager
+        self.table = parity_index_table
+        self.codewords_distributed = 0
+
+    def _salted(self, shard: bytes, taken: set) -> tuple:
+        """(blob, hash) for the first salt whose placement avoids nodes
+        already carrying a piece of this codeword; best-effort after
+        _SALT_TRIES (small clusters can't always avoid overlap)."""
+        best = None
+        for salt in range(_SALT_TRIES):
+            blob = pack_parity_shard(shard, salt)
+            ph = block_hash(blob, self.manager.hash_algo)
+            nodes = self.manager.replication.write_nodes(ph)
+            node = bytes(nodes[0]) if nodes else b""
+            if node not in taken:
+                taken.add(node)
+                return blob, ph
+            if best is None:
+                best = (blob, ph, node)
+        blob, ph, node = best
+        taken.add(node)
+        return blob, ph
+
+    async def distribute(self, hashes: Sequence[Hash],
+                         lengths: Sequence[int],
+                         parity: np.ndarray) -> None:
+        from ..model.parity_index_table import ParityIndexEntry
+        from ..utils.crdt import now_msec
+
+        m = int(parity.shape[0])
+        k = self.manager.codec.params.rs_data
+        gid = ParityStore._gid(k, m, hashes)
+        taken = set()
+        for h in hashes:
+            nodes = self.manager.replication.write_nodes(Hash(h))
+            if nodes:
+                taken.add(bytes(nodes[0]))
+        blobs, phashes = [], []
+        for j in range(m):
+            blob, ph = self._salted(parity[j].tobytes(), taken)
+            blobs.append(blob)
+            phashes.append(ph)
+        # parity blocks first, index second: the index's member-0 entry
+        # refs the parity hashes, and a ref to a not-yet-written block
+        # would trigger spurious resync fetches
+        for ph, b in zip(phashes, blobs):
+            await self.manager.rpc_put_block(ph, b, is_parity=True)
+        ts = now_msec()
+        entries = [
+            ParityIndexEntry(
+                member=Hash(h), gid=gid, timestamp=ts, k=k, m=m,
+                member_index=i,
+                members=[bytes(x) for x in hashes],
+                lengths=[int(n) for n in lengths],
+                parity_hashes=[bytes(p) for p in phashes],
+            )
+            for i, h in enumerate(hashes)
+        ]
+        await self.table.insert_many(entries)
+        self.codewords_distributed += 1
+
+
+class WriteParityAccumulator:
+    """Write-time RS encoding: parity exists from first write, not from
+    the first scrub pass 25 days later.
+
+    The reference's put path offers no erasure protection at all — a
+    freshly-PUT block is guarded only by replication
+    (ref src/api/s3/put.rs:286-360 writes, src/rpc/replication_mode.rs
+    durability) — and the scrub-generated sidecars above leave a window
+    between write and first scrub.  This accumulator closes the window:
+    blocks join an in-progress codeword; when k members accumulate (or
+    `flush_after` seconds pass — partial codewords encode against
+    implicit zero shards) the parity is encoded OFF the write path (one
+    to_thread hop through the codec's gather kernel).  PutObject latency
+    is unaffected: the put path only appends bytes it already holds.
+
+    Two deployments with DIFFERENT grouping invariants:
+      - storing-node side (`store` set): every block this node stores
+        joins a codeword persisted as a LOCAL sidecar — co-location is
+        the point (zero-network local repair).
+      - writer side (`distributor` set, hooked into rpc_put_block):
+        codewords group blocks bound for DISTINCT nodes — add() flushes
+        early rather than admit two members placed on the same node, so
+        RS(k, m) deterministically survives m member-node losses.
+        Grouping on the storing side instead would co-locate all k
+        members on the dying node, reducing node-loss tolerance to
+        codewords with ≤ m members.
+
+    All mutation happens on the event loop; the encode runs on a
+    snapshot in a worker thread.  Blocks deleted before their codeword's
+    other members merely cost decode head-room (the sidecar holds m
+    parity shards), and the next scrub pass re-groups survivors."""
+
+    def __init__(self, store: Optional[ParityStore], codec,
+                 flush_after: float = 5.0,
+                 distributor: Optional[ParityDistributor] = None,
+                 manager=None):
+        self.store = store
+        self.codec = codec
+        self.flush_after = flush_after
+        self.distributor = distributor
+        self.manager = manager if manager is not None else (
+            store.manager if store is not None else None)
+        self._pending: List[tuple] = []  # (hash, DataBlock)
+        self._pending_nodes: set = set()  # primary data node per member
+        self._timer: Optional[object] = None  # asyncio.TimerHandle
+        self._tasks: set = set()
+        # writer-side re-PUT dedup: an OrderedDict-as-LRU of hashes this
+        # writer recently wrapped into codewords (bounded; cross-writer
+        # repeats still duplicate, which the ref-driven GC cleans up)
+        from collections import OrderedDict
+
+        self._recent: "OrderedDict[bytes, None]" = OrderedDict()
+        self._recent_cap = 4096
+        self.codewords_encoded = 0
+
+    def recently_added(self, h: Hash) -> bool:
+        return bytes(h) in self._recent
+
+    def add(self, h: Hash, block: "DataBlock") -> None:
+        """Register a freshly-written block.  Event loop only; the block
+        is held as stored (possibly compressed) and decompressed on the
+        encode thread, so the write path pays nothing."""
+        import asyncio
+
+        k = self.codec.params.rs_data
+        if k <= 0:
+            return
+        if self.distributor is not None and self.manager is not None:
+            self._recent[bytes(h)] = None
+            self._recent.move_to_end(bytes(h))
+            while len(self._recent) > self._recent_cap:
+                self._recent.popitem(last=False)
+            # distinct-node invariant for distributed codewords
+            nodes = self.manager.replication.write_nodes(h)
+            node = bytes(nodes[0]) if nodes else b""
+            if node in self._pending_nodes:
+                self._flush()
+            self._pending_nodes.add(node)
+        self._pending.append((h, block))
+        if len(self._pending) >= k:
+            self._flush()
+        elif self._timer is None:
+            loop = asyncio.get_running_loop()
+            self._timer = loop.call_later(self.flush_after, self._flush)
+
+    def _flush(self) -> None:
+        import asyncio
+
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if not self._pending:
+            return
+        group, self._pending = self._pending, []
+        self._pending_nodes = set()
+        task = asyncio.get_running_loop().create_task(
+            self._encode_and_store(group)
+        )
+        # keep a strong ref (create_task results are weakly held)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _encode_and_store(self, group: List[tuple]) -> None:
+        import asyncio
+
+        try:
+            hashes = [h for h, _ in group]
+
+            def encode_and_store():
+                blocks = [b.decompressed() for _, b in group]
+                # rs_encode_blocks zero-pads the member count to a whole
+                # codeword — exactly the partial-codeword zero-shard
+                # semantics
+                parity = self.codec.rs_encode_blocks(blocks)
+                if self.store is not None:
+                    self.store.put_codeword(
+                        hashes, [len(b) for b in blocks], parity[0])
+                return parity[0], [len(b) for b in blocks]
+
+            parity_row, lengths = await asyncio.to_thread(encode_and_store)
+            self.codewords_encoded += 1
+            if self.distributor is not None:
+                await self.distributor.distribute(hashes, lengths, parity_row)
+        except Exception:  # noqa: BLE001 — write-path parity is best-effort
+            logger.exception("write-time parity encode failed")
+
+    async def drain(self) -> None:
+        """Flush the partial codeword and wait for in-flight encodes
+        (shutdown path — a clean stop must not lose the tail)."""
+        import asyncio
+
+        self._flush()
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)

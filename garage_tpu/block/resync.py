@@ -220,14 +220,17 @@ class BlockResyncManager:
                 block = await mgr.read_block(h)
                 from .manager import _chunks
 
+                msg = {
+                    "t": "put_block",
+                    "h": bytes(h),
+                    "hdr": block.header().pack(),
+                }
+                if mgr.is_parity_block(h):
+                    msg["parity"] = True
                 for node in needy:
                     await mgr.endpoint.call(
                         node,
-                        {
-                            "t": "put_block",
-                            "h": bytes(h),
-                            "hdr": block.header().pack(),
-                        },
+                        msg,
                         prio=PRIO_BACKGROUND,
                         timeout=60.0,
                         body=_chunks(block.inner),
@@ -237,11 +240,15 @@ class BlockResyncManager:
                 )
             await mgr.delete_if_unneeded(h)
 
-        elif rc.is_needed() and not present:
-            # we should have it but don't: rebuild locally from the RS
-            # parity sidecar when possible (zero network — works with
-            # every replica down), else fetch from a replica
-            # (ref resync.rs:457-468)
+        elif rc.is_needed() and not present and mgr.is_assigned(h):
+            # we are ring-ASSIGNED this block but don't have it: rebuild
+            # locally from the RS parity sidecar when possible (zero
+            # network — works with every replica down), else fetch from a
+            # replica (ref resync.rs:457-468).  is_assigned matters when
+            # data_replication_mode < replication_mode: the block_ref
+            # partition (meta factor) then holds rc on nodes the data
+            # ring does NOT assign the block to, and without the check
+            # every rc holder would pull its own copy.
             if mgr.parity_store is not None:
                 data = await asyncio.to_thread(
                     mgr.parity_store.try_reconstruct, h
@@ -252,8 +259,28 @@ class BlockResyncManager:
                     await mgr.write_block(h, DataBlock.plain(data))
                     mgr.blocks_reconstructed += 1
                     return
-            block = await mgr.rpc_get_raw_block(h)
-            await mgr.write_block(h, block)
+            try:
+                block = await mgr.rpc_get_raw_block(h)
+            except Exception:
+                # every replica is unreachable or damaged: last line of
+                # defense is DISTRIBUTED parity — fetch ≥ k surviving
+                # codeword pieces from across the cluster and decode the
+                # missing row (survives whole-node loss, which local
+                # sidecars cannot; the reference's only answer here is
+                # replication, resync.rs:457-468)
+                if mgr.parity_reconstructor is None:
+                    raise
+                data = await mgr.parity_reconstructor(h)
+                if data is None:
+                    raise
+                from .block import DataBlock
+
+                await mgr.write_block(h, DataBlock.plain(data))
+                mgr.blocks_reconstructed += 1
+                logger.info("reconstructed block %s from DISTRIBUTED parity",
+                            bytes(h).hex()[:16])
+                return
+            await mgr.write_block(h, block, is_parity=block.parity)
             logger.info("resynced missing block %s", bytes(h).hex()[:16])
 
     async def next_due_in(self) -> float:

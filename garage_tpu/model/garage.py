@@ -56,6 +56,17 @@ class Garage:
     def __init__(self, config: Config, db: Optional[Db] = None):
         self.config = config
         self.replication_mode = parse_replication_mode(config.replication_mode)
+        # Optional asymmetric durability (the erasure-coded storage
+        # class): metadata tables keep replication_mode, while BLOCK
+        # placement uses data_replication_mode — e.g. meta "3" + data
+        # "none" + codec.parity_distribute stores 1× data + m/k parity
+        # (1.5× total at RS(8,4)) yet survives the loss of any m
+        # codeword nodes, where the reference can only trade whole
+        # replicas (replication_mode.rs:41-56, 3× for 2-loss).
+        self.data_replication_mode = (
+            parse_replication_mode(config.data_replication_mode)
+            if config.data_replication_mode else self.replication_mode
+        )
 
         os.makedirs(config.metadata_dir, exist_ok=True)
         self._owns_db = db is None
@@ -80,7 +91,10 @@ class Garage:
         # addressed, self-verifying); metadata reads/writes use quorums;
         # control tables (buckets/keys/aliases) are fully replicated
         self.data_rep = TableShardedReplication(
-            self.system, factor, 1, self.replication_mode.write_quorum
+            self.system,
+            self.data_replication_mode.replication_factor,
+            1,
+            self.data_replication_mode.write_quorum,
         )
         self.meta_rep = TableShardedReplication(
             self.system,
@@ -101,11 +115,19 @@ class Garage:
         )
         self.block_manager.resync = self.block_resync
         if config.codec.store_parity and config.codec.rs_data > 0:
-            from ..block.parity import ParityStore
+            from ..block.parity import ParityStore, WriteParityAccumulator
 
             self.block_manager.parity_store = ParityStore(
                 self.block_manager, self.db, self.block_manager.codec
             )
+            if config.codec.parity_on_write:
+                # BASELINE config #3: RS encode on the PutObject path —
+                # parity exists from first write, not from the first
+                # scrub pass (encoding itself runs off the write path)
+                self.block_manager.write_parity = WriteParityAccumulator(
+                    self.block_manager.parity_store,
+                    self.block_manager.codec,
+                )
 
         # --- tables, wired bottom-up so hooks can reach lower tables ---
         self.bucket_table = Table(
@@ -141,6 +163,41 @@ class Garage:
         self.block_ref_table = Table(
             self.system, block_ref_schema, self.meta_rep, self.db
         )
+
+        # cross-node parity: index sharded by member hash at META
+        # replication (the index must outlive data-node loss), parity
+        # shards stored as ordinary ring-placed blocks
+        from .parity_index_table import ParityIndexTableSchema
+
+        self.parity_index_table = Table(
+            self.system, ParityIndexTableSchema(self.block_ref_table),
+            self.meta_rep, self.db,
+        )
+        if config.codec.parity_distribute and config.codec.rs_data > 0:
+            from ..block.parity import (
+                ParityDistributor,
+                WriteParityAccumulator,
+            )
+            from .parity_repair import make_parity_reconstructor
+
+            # writer-side accumulator: distinct-node codewords, parity
+            # distributed cross-node (independent of the storing-side
+            # local-sidecar accumulator above)
+            self.block_manager.ec_accumulator = WriteParityAccumulator(
+                None, self.block_manager.codec,
+                distributor=ParityDistributor(
+                    self.block_manager, self.parity_index_table
+                ),
+                manager=self.block_manager,
+            )
+            from .parity_repair import make_parity_gc
+
+            self.block_manager.parity_reconstructor = (
+                make_parity_reconstructor(self)
+            )
+            # GC rides the GLOBAL deletion signal (last live version-ref
+            # tombstoned), never local/migration deletes
+            block_ref_schema.on_ref_dropped = make_parity_gc(self)
 
         version_schema = VersionTableSchema(self.block_ref_table)
         self.version_table = Table(
@@ -186,6 +243,7 @@ class Garage:
             self.object_counter_table,
             self.mpu_counter_table,
             self.block_ref_table,
+            self.parity_index_table,
             self.version_table,
             self.mpu_table,
             self.object_table,
@@ -266,6 +324,11 @@ class Garage:
         await self.system.run()
 
     async def shutdown(self) -> None:
+        # flush partial write-time codewords before workers stop
+        if self.block_manager.write_parity is not None:
+            await self.block_manager.write_parity.drain()
+        if self.block_manager.ec_accumulator is not None:
+            await self.block_manager.ec_accumulator.drain()
         await self.bg.shutdown()
         tracer = getattr(self.system, "tracer", None)
         if tracer is not None:

@@ -1,0 +1,241 @@
+"""Cross-node RS decode-repair — rebuild a block no replica can serve.
+
+The last line of the resync fallback chain (local sidecar → replicas →
+THIS): look the lost block up in the replicated parity index
+(model/parity_index_table.py), fetch ≥ k surviving codeword pieces from
+across the cluster — member blocks and parity blocks alike are ordinary
+ring-placed blocks — and decode exactly the missing row.  Every fetched
+piece is verified by content hash before use and the rebuilt block must
+hash to the requested id, so damaged or stale pieces can only cause a
+fallback, never wrong data.
+
+The reference has no equivalent: its resync gives up when every replica
+is gone (ref src/block/resync.rs:457-468).  Here, with data replication
+"none" + RS(8,4) distribution, the cluster stores 1.5× the data and any
+block survives the loss of up to m = 4 of its codeword's nodes — versus
+the reference's 3× storage tolerating 2.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import numpy as np
+
+from ..utils.data import Hash, block_hash
+
+logger = logging.getLogger("garage_tpu.model.parity_repair")
+
+
+# How many index rows to consider per member during GC/repair: a block
+# can belong to several codewords over its life (re-groupings); tombstones
+# keep occupying slots, so the scan must look well past the live ones.
+INDEX_SCAN_LIMIT = 64
+
+
+def make_parity_gc(garage):
+    """Bind the GC trigger: fired (post-commit, on the block_ref
+    partition's nodes) when a live version-ref for a member block is
+    tombstoned.  If NO live version-ref remains, the block is globally
+    dead and its parity-index rows tombstone — which, via the member-0
+    row, decrefs the codeword's parity blocks so their storage is
+    reclaimed by normal block GC.
+
+    The trigger is deliberately NOT physical deletion: a node deleting
+    its local copy during migration/offload says nothing about the
+    block's global liveness, and GC'ing coverage there would strip
+    erasure protection from a block that still exists (with an or-merged
+    sticky tombstone, unrecoverably — the gid is deterministic).  The
+    block_ref and parity_index tables shard by the same hash, so this
+    check reads only local rows."""
+    from .parity_index_table import is_parity_ref
+    from .s3.block_ref_table import BlockRef
+
+    def on_ref_dropped(h: Hash) -> None:
+        task = asyncio.get_running_loop().create_task(_gc_if_dead(garage, h))
+        _GC_TASKS.add(task)
+        task.add_done_callback(_GC_TASKS.discard)
+
+    async def _gc_if_dead(garage, h: Hash) -> None:
+        try:
+            from ..table.schema import hash_partition_key
+
+            data = garage.block_ref_table.data
+            prefix = bytes(hash_partition_key(bytes(h)))
+            for k, raw in data.store.items(prefix, None):
+                if k[:32] != prefix:
+                    break
+                br: BlockRef = data.decode_entry(raw)
+                if not br.deleted.value and not is_parity_ref(br.version):
+                    return  # still referenced somewhere: keep coverage
+            entries = await garage.parity_index_table.get_range(
+                bytes(h), None, limit=INDEX_SCAN_LIMIT)
+            dead = [e for e in entries if not e.is_tombstone()]
+            for e in dead:
+                e.deleted.set()
+            if dead:
+                await garage.parity_index_table.insert_many(dead)
+        except Exception:
+            logger.debug("parity GC for %s failed (will retry on next "
+                         "ref drop)", bytes(h).hex()[:16], exc_info=True)
+
+    return on_ref_dropped
+
+
+_GC_TASKS: set = set()
+
+
+def make_parity_reconstructor(garage):
+    """Bind a `async h -> plain bytes | None` reconstructor over the
+    garage's parity index table + block manager (attached to the block
+    manager as `parity_reconstructor`)."""
+
+    async def reconstruct(h: Hash) -> Optional[bytes]:
+        try:
+            entries = await garage.parity_index_table.get_range(
+                bytes(h), None, limit=INDEX_SCAN_LIMIT)
+        except Exception:
+            logger.warning("parity index unreachable for %s",
+                           bytes(h).hex()[:16], exc_info=True)
+            return None
+        for ent in entries:
+            if ent.is_tombstone():
+                continue
+            data = await _try_codeword(garage, h, ent)
+            if data is not None:
+                return data
+        return None
+
+    return reconstruct
+
+
+async def _fetch_verified(garage, mh: bytes) -> Optional[bytes]:
+    """A codeword piece (member or parity block), verified against its
+    content hash.  Tries the ring placement first; if that misses —
+    mid-migration after a layout change, the piece may still sit on a
+    node the NEW ring no longer lists for it — falls back to asking
+    every other alive peer.  O(cluster) worst case, but this only runs
+    during disaster repair, where completeness beats elegance."""
+    mgr = garage.block_manager
+    h = Hash(mh)
+    raw = None
+    try:
+        raw = await mgr.rpc_get_block(h)
+    except Exception as ring_err:
+        ring_nodes = {bytes(x) for x in mgr.replication.read_nodes(h)}
+        tried = []
+        # liveness ORDERS the sweep (likely-up peers first) but never
+        # vetoes it: is_up is a stale hint (ping cadence), and skipping a
+        # reachable holder during disaster repair turns a recoverable
+        # codeword into data loss — a dead peer just fails fast instead
+        peers = sorted(
+            garage.system.peering.peers.items(),
+            key=lambda kv: not kv[1].is_up,
+        )
+        for nid, st in peers:
+            if bytes(nid) in ring_nodes:
+                continue
+            try:
+                resp, stream = await mgr.endpoint.call_streaming(
+                    nid, {"t": "get_block", "h": bytes(h)},
+                    timeout=30.0,
+                )
+                if resp.get("err") or stream is None:
+                    tried.append(f"{bytes(nid).hex()[:8]}:miss")
+                    continue
+                from ..block.block import DataBlock, DataBlockHeader
+
+                hdr = DataBlockHeader.unpack(resp["hdr"])
+                raw = DataBlock(
+                    await stream.read_all(), hdr.compressed).decompressed()
+                break
+            except Exception as e:
+                tried.append(f"{bytes(nid).hex()[:8]}:{type(e).__name__}")
+                continue
+        if raw is None:
+            logger.info(
+                "repair fetch of piece %s failed everywhere: ring=%s; "
+                "sweep=%s", bytes(mh).hex()[:12], ring_err, tried)
+    if raw is None:
+        return None
+    if bytes(block_hash(raw, mgr.hash_algo)) != bytes(mh):
+        logger.warning("repair fetch of piece %s: hash mismatch",
+                       bytes(mh).hex()[:12])
+        return None
+    return raw
+
+
+async def _try_codeword(garage, h: Hash, ent) -> Optional[bytes]:
+    k, m = ent.k, ent.m
+    target_i = ent.member_index
+    lengths = ent.lengths
+    maxlen = max(lengths) if lengths else 0
+    if maxlen == 0 or target_i >= len(ent.members):
+        return None
+
+    pieces, present = [], []
+
+    def pad(raw: bytes) -> np.ndarray:
+        shard = np.zeros(maxlen, dtype=np.uint8)
+        shard[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        return shard
+
+    # surviving data members (fetched concurrently — they live on
+    # different nodes, and a dead node costs a full timeout serially)
+    others = [i for i in range(len(ent.members)) if i != target_i]
+    fetched = await asyncio.gather(
+        *[_fetch_verified(garage, ent.members[i]) for i in others])
+    for i, raw in zip(others, fetched):
+        if raw is None or len(present) >= k:
+            continue
+        pieces.append(pad(raw))
+        present.append(i)
+    # implicit zero shards of a partial codeword
+    for i in range(len(ent.members), k):
+        if len(present) >= k:
+            break
+        pieces.append(np.zeros(maxlen, dtype=np.uint8))
+        present.append(i)
+    # parity blocks as needed (verified blobs carry the salt header —
+    # strip it to get the shard bytes; see block/parity.py placement)
+    if len(present) < k:
+        from ..block.parity import unpack_parity_shard
+
+        pfetched = await asyncio.gather(
+            *[_fetch_verified(garage, ph) for ph in ent.parity_hashes])
+        for j, raw in enumerate(pfetched):
+            if raw is None or len(present) >= k:
+                continue
+            shard = unpack_parity_shard(raw)
+            if shard is None:
+                continue
+            pieces.append(pad(shard))
+            present.append(k + j)
+    if len(present) < k:
+        logger.info(
+            "codeword for %s unrecoverable: %d of %d pieces survive",
+            bytes(h).hex()[:16], len(present), k)
+        return None
+
+    # decode with the ENTRY's geometry (it may predate a codec config
+    # change); only the missing row is computed
+    from ..ops.codec import CodecParams
+    from ..ops.cpu_codec import CpuCodec
+
+    codec = CpuCodec(CodecParams(rs_data=k, rs_parity=m))
+    shards = np.stack(pieces)[None, :, :]
+    try:
+        row = await asyncio.to_thread(
+            codec.rs_reconstruct, shards, present, [target_i])
+    except Exception:
+        logger.exception("distributed decode failed for %s",
+                         bytes(h).hex()[:16])
+        return None
+    out = row[0, 0].tobytes()[: lengths[target_i]]
+    if bytes(block_hash(out, garage.block_manager.hash_algo)) != bytes(h):
+        logger.warning("distributed decode of %s produced wrong hash",
+                       bytes(h).hex()[:16])
+        return None
+    return out

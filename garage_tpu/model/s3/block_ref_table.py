@@ -51,18 +51,39 @@ class BlockRefTableSchema(TableSchema):
 
     def __init__(self, block_manager=None):
         self.block_manager = block_manager
+        # set by Garage when distributed parity is on: fired (post-commit)
+        # with the block hash when a LIVE version-ref transitions to dead —
+        # the receiver checks whether any live version-ref remains and
+        # tombstones the block's parity-index rows if not.  This is the
+        # GLOBAL deletion signal; a node deleting its local copy during
+        # migration/offload must never GC cluster-wide parity state.
+        self.on_ref_dropped = None
 
     def updated(self, tx, old: Optional[BlockRef], new: Optional[BlockRef]) -> None:
         """ref block_ref_table.rs:65-81."""
         if self.block_manager is None:
             return
-        block = (old or new).block
+        ent = old or new
+        block = ent.block
         was = old is not None and not old.deleted.value
         now = new is not None and not new.deleted.value
         if now and not was:
             self.block_manager.block_incref(tx, block)
         if was and not now:
             self.block_manager.block_decref(tx, block)
+            # Global-deletion signal: only a LOGICAL tombstone (new row
+            # with deleted=True) means the reference is gone cluster-wide.
+            # new=None is PHYSICAL removal — partition offload after a
+            # layout change, or tombstone GC — and says nothing about
+            # liveness; firing there tombstoned (stickily) the parity
+            # index of blocks that were merely migrating.
+            if (self.on_ref_dropped is not None and new is not None
+                    and new.deleted.value):
+                from ..parity_index_table import is_parity_ref
+
+                if not is_parity_ref(ent.version):
+                    cb, h = self.on_ref_dropped, block
+                    tx.on_commit(lambda: cb(h))
 
     def matches_filter(self, entry: BlockRef, filter: Any) -> bool:
         from ...table.schema import DeletedFilter

@@ -105,9 +105,15 @@ class BlockCodec:
         """(B, k, S) uint8 → (B, m, S) parity shards."""
         raise NotImplementedError
 
-    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int]) -> np.ndarray:
+    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int],
+                       rows: Optional[Sequence[int]] = None) -> np.ndarray:
         """shards (B, p, S) = the surviving shards, in the order listed by
-        `present` (indices into the k+m codeword, p ≥ k) → (B, k, S) data."""
+        `present` (indices into the k+m codeword, p ≥ k) → (B, k, S) data.
+
+        `rows` restricts decoding to those data-row indices, returning
+        (B, len(rows), S) — a repair that lost j of k members only pays
+        for the j missing rows instead of re-deriving all k (a k/j GF
+        work saving, 8× for the common single-block repair)."""
         raise NotImplementedError
 
     # --- compression (CPU-side on both backends) ---

@@ -15,7 +15,7 @@ from __future__ import annotations
 import concurrent.futures
 import os
 import threading
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -94,7 +94,10 @@ class CpuCodec(BlockCodec):
         return self._native_ptrs(
             self._parity_mat, list(blocks) + [b""] * pad, maxlen)
 
-    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int]) -> np.ndarray:
+    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int],
+                       rows: Optional[Sequence[int]] = None) -> np.ndarray:
         k, m = self.params.rs_data, self.params.rs_parity
         dec = gf256.rs_decode_matrix(k, m, present)
+        if rows is not None:
+            dec = np.ascontiguousarray(dec[list(rows)])
         return self._apply(dec, np.ascontiguousarray(shards[..., :k, :], dtype=np.uint8))

@@ -427,5 +427,6 @@ class HybridCodec(BlockCodec):
     def rs_encode(self, data: np.ndarray) -> np.ndarray:
         return self.cpu.rs_encode(data)
 
-    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int]) -> np.ndarray:
-        return self.cpu.rs_reconstruct(shards, present)
+    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int],
+                       rows: Optional[Sequence[int]] = None) -> np.ndarray:
+        return self.cpu.rs_reconstruct(shards, present, rows)

@@ -306,12 +306,15 @@ class TpuCodec(BlockCodec):
         out = self._gf_apply_np(flat, self._K_enc)[:n]
         return out.reshape(lead + out.shape[-2:])
 
-    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int]) -> np.ndarray:
+    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int],
+                       rows: Optional[Sequence[int]] = None) -> np.ndarray:
         k, m = self.params.rs_data, self.params.rs_parity
-        key = tuple(present[:k])
+        key = (tuple(present[:k]), tuple(rows) if rows is not None else None)
         K = self._decode_w_cache.get(key)
         if K is None:
             dec = gf256.rs_decode_matrix(k, m, present)
+            if rows is not None:
+                dec = np.ascontiguousarray(dec[list(rows)])
             K = jnp.asarray(gf_mask_consts(dec))
             self._decode_w_cache[key] = K
         lead = shards.shape[:-2]

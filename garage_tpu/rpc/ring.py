@@ -51,7 +51,21 @@ class Ring:
             and len(layout.ring_assignment_data) == N_PARTITIONS * f
         ):
             for p in range(N_PARTITIONS):
-                self._partitions.append(layout.partition_nodes(p))
+                nodes = layout.partition_nodes(p)
+                # Rotate each partition's replica list by the partition
+                # index: the optimizer balances the replica SETS but not
+                # their order, and with full-factor writes order never
+                # mattered — every set member stores a copy.  Under
+                # data_replication_mode with a smaller data factor the
+                # FIRST entries are load-bearing (they are the only
+                # storage nodes), and unrotated lists concentrated every
+                # primary on a couple of nodes (measured: 3 equal nodes →
+                # 256/256 primaries on one).  The rotation is a pure
+                # function of (partition, set) so every node agrees.
+                if nodes:
+                    r = p % len(nodes)
+                    nodes = nodes[r:] + nodes[:r]
+                self._partitions.append(nodes)
         else:
             self._partitions = [[] for _ in range(N_PARTITIONS)]
 

@@ -265,10 +265,34 @@ class System:
         self._disk_cache = (now, vals)
         return vals
 
+    def _peer_book(self) -> list:
+        """[(id, addr)] of every dialable peer this node knows, plus
+        itself — the payload of peer-list gossip."""
+        out = []
+        my_addr = self.config.rpc_public_addr
+        if my_addr:
+            out.append([bytes(self.id), my_addr])
+        for nid, (addr, _up, _lat) in self.peering.peer_info().items():
+            if addr:
+                out.append([bytes(nid), addr])
+        return out
+
     async def _status_exchange_loop(self):
         while not self._stopped.is_set():
             try:
-                msg = {"t": "advertise_status", "status": self._local_status().pack()}
+                # Peer-list gossip rides the status broadcast: an operator
+                # who runs `connect` against ONE node (the star bootstrap
+                # every real deployment starts as) must converge to a full
+                # mesh — without address exchange, nodes only ever know
+                # the peers someone explicitly dialed for them, and a
+                # partition heals only by operator action (observed: star
+                # survivors couldn't reach table quorums after node loss).
+                # ref: netapp's FullMeshPeeringStrategy PeerList exchange.
+                msg = {
+                    "t": "advertise_status",
+                    "status": self._local_status().pack(),
+                    "peers": self._peer_book(),
+                }
                 await self.rpc.broadcast(self.endpoint, msg, prio=PRIO_HIGH, timeout=10.0)
             except Exception as e:
                 logger.debug("status exchange failed: %s", e)
@@ -363,6 +387,15 @@ class System:
             # a peer with a newer layout triggers a pull
             if st.layout_version > self.layout.version:
                 asyncio.get_running_loop().create_task(self._pull_layout(remote))
+            # peer-list gossip: learn every (id, addr) the sender knows;
+            # the peering tick dials the ones we aren't connected to
+            for pair in msg.get("peers", []) or []:
+                try:
+                    nid, addr = bytes(pair[0]), str(pair[1])
+                    if len(nid) == 32 and nid != bytes(self.id):
+                        self.peering.add_peer(addr, FixedBytes32(nid))
+                except Exception:  # noqa: BLE001 — gossip is best-effort
+                    continue
             return {"ok": True}, None
         if t == "ping":
             return {"pong": True, "id": bytes(self.id)}, None

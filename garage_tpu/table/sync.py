@@ -214,6 +214,7 @@ class SyncWorker(Worker):
         self.todo: List = []
         self.next_full_sync = time.monotonic() + random.uniform(0.0, 30.0)
         self._notify = asyncio.Event()
+        self._fail_streak = 0
 
     def name(self) -> str:
         return f"{self.syncer.data.schema.TABLE_NAME} sync"
@@ -234,12 +235,27 @@ class SyncWorker(Worker):
         st.progress = f"partition {partition}"
         try:
             await self.syncer.sync_partition(partition, first_hash)
+            self._fail_streak = 0
         except Exception as e:
+            # A failed partition goes to the BACK of the queue and the
+            # worker keeps going — raising here fed the runner's global
+            # exponential backoff, so a ~30 s peer outage during a
+            # 256-partition pass racked up enough consecutive errors to
+            # freeze sync for the better part of an hour AFTER the peer
+            # came back (observed during node-loss recovery).  Only when
+            # a whole sweep makes no progress do we pause briefly.
             logger.debug(
-                "%s: sync of partition %d failed: %s",
+                "%s: sync of partition %d failed (requeued): %s",
                 self.syncer.data.schema.TABLE_NAME, partition, e,
             )
-            raise
+            st.errors += 1
+            st.last_error = f"{type(e).__name__}: {e}"
+            st.last_error_time = time.time()
+            self.todo.append((partition, first_hash))
+            self._fail_streak += 1
+            if self._fail_streak >= max(8, len(self.todo)):
+                self._fail_streak = 0
+                await asyncio.sleep(10.0)
         return WorkerState.BUSY
 
     async def wait_for_work(self) -> None:

@@ -83,7 +83,10 @@ class Worker:
 
 # ref background/worker.rs:28-33: backoff base 1.5^n, 10 errors before warn
 _ERROR_RETRY_BASE = 1.0
-_ERROR_RETRY_MAX = 3600.0
+# 120 s, not the reference's ~1 h scale: a worker stuck at an hour-long
+# retry cap cannot participate in self-healing after a transient outage
+# (a dead peer during one queue sweep is enough to saturate the cap)
+_ERROR_RETRY_MAX = 120.0
 
 
 class BackgroundRunner:

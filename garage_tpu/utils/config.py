@@ -91,6 +91,20 @@ class CodecConfig:
     # the BlockCodec north star).  Opt-in: costs ~m/k extra disk (+50%
     # at the default 8/4), refreshed and garbage-collected per scrub pass
     store_parity: bool = False
+    # RS-encode on the PutObject path (BASELINE config #3): freshly
+    # written blocks join write-time codewords whose parity is encoded
+    # off the write path and persisted immediately — no unprotected
+    # window until the first scrub pass.  Effective only with
+    # store_parity; partial codewords (small objects, flush timeouts)
+    # encode against implicit zero shards.
+    parity_on_write: bool = True
+    # Cross-node parity (requires store_parity + parity_on_write): each
+    # codeword's parity shards are stored as ordinary ring-placed blocks
+    # on OTHER nodes and indexed in a replicated table, so RS survives
+    # whole-NODE loss, not just local corruption.  Pair with
+    # data_replication_mode = "none" for the erasure-coded storage class
+    # (1 + m/k × storage tolerating m codeword-node losses).
+    parity_distribute: bool = False
     hybrid_window: int = 1          # hybrid backend: device in-flight groups
 
     def make(self, compression_level: Optional[int] = 1):
@@ -140,6 +154,10 @@ class Config:
     data_dir: List[Dict[str, Any]] = field(default_factory=list)  # [{path, capacity?, read_only?}]
     block_size: int = 1024 * 1024       # ref config.rs:234-236 default 1 MiB
     replication_mode: str = "3"         # ref rpc/replication_mode.rs
+    # Block placement may use a DIFFERENT mode than the metadata tables
+    # (None = same): meta "3" + data "none" + codec.parity_distribute is
+    # the erasure-coded storage class — see CodecConfig.parity_distribute.
+    data_replication_mode: Optional[str] = None
     compression_level: Optional[int] = 1  # zstd level; None = off (ref config.rs:342-394)
     rpc_bind_addr: str = "0.0.0.0:3901"
     rpc_public_addr: Optional[str] = None
@@ -182,7 +200,8 @@ def read_config(path: str) -> Config:
 def config_from_dict(raw: Dict[str, Any]) -> Config:
     cfg = Config(raw=raw)
     for key in (
-        "metadata_dir", "block_size", "replication_mode", "compression_level",
+        "metadata_dir", "block_size", "replication_mode",
+        "data_replication_mode", "compression_level",
         "rpc_bind_addr", "rpc_public_addr", "rpc_secret", "bootstrap_peers",
         "db_engine", "metadata_fsync", "data_fsync", "root_domain",
     ):

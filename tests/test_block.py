@@ -503,6 +503,87 @@ async def test_parity_sidecar_local_reconstruction(tmp_path):
     await shutdown(systems)
 
 
+async def test_write_time_parity(tmp_path):
+    """BASELINE config #3: parity exists from FIRST WRITE (no scrub pass
+    needed).  Full codewords flush at k blocks; a partial codeword
+    (object smaller than k blocks) flushes on drain and reconstructs
+    against implicit zero shards."""
+    from garage_tpu.block.parity import ParityStore, WriteParityAccumulator
+
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    m.blocks_reconstructed = 0
+    db = open_db("memory")
+    m.parity_store = ParityStore(m, db, m.codec)
+    m.write_parity = WriteParityAccumulator(m.parity_store, m.codec,
+                                            flush_after=0.2)
+
+    k = m.codec.params.rs_data
+    # one full codeword: k blocks, varying sizes, one compressible
+    datas = [os.urandom(8000 + 321 * i) for i in range(k - 1)]
+    datas.append(b"compressible " * 700)
+    hs = [blake2s_sum(d) for d in datas]
+    for h, d in zip(hs, datas):
+        await m.write_block(h, DataBlock.from_buffer(d, 3))
+    # k-th write triggers the flush; encode runs async — wait for it
+    for _ in range(100):
+        if m.parity_store.coverage(hs[0]):
+            break
+        await asyncio.sleep(0.02)
+    assert all(m.parity_store.coverage(h) for h in hs), \
+        "full codeword must be covered right after the k-th write, no scrub"
+
+    # corrupt one member on disk; read path detects, resync repairs from
+    # the WRITE-TIME sidecar (zero network: single-node cluster)
+    victim = hs[2]
+    path, _ = m.find_block(victim)
+    raw = bytearray(open(path, "rb").read())
+    raw[50] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    assert m.parity_store.try_reconstruct(victim) == datas[2]
+
+    # partial codeword: 2 more blocks (< k), flushed by the timer
+    small = [os.urandom(5000), os.urandom(6000)]
+    sh = [blake2s_sum(d) for d in small]
+    for h, d in zip(sh, small):
+        await m.write_block(h, DataBlock.plain(d))
+    for _ in range(200):
+        if m.parity_store.coverage(sh[0]):
+            break
+        await asyncio.sleep(0.02)
+    assert m.parity_store.coverage(sh[0]) and m.parity_store.coverage(sh[1])
+    # delete one member: reconstruction uses the survivor + zero shards
+    p2, _ = m.find_block(sh[1])
+    os.remove(p2)
+    assert m.parity_store.try_reconstruct(sh[1]) == small[1]
+
+    # dedupe: re-writing an existing block must not enter a new codeword
+    before = len(m.write_parity._pending)
+    await m.write_block(hs[0], DataBlock.from_buffer(datas[0], 3))
+    assert len(m.write_parity._pending) == before
+    await shutdown(systems)
+
+
+async def test_write_time_parity_drain_flushes_tail(tmp_path):
+    """Shutdown with a partial codeword pending: drain() must flush and
+    persist it (clean stop loses nothing)."""
+    from garage_tpu.block.parity import ParityStore, WriteParityAccumulator
+
+    systems, managers = await make_block_cluster(tmp_path, n=1, mode="1")
+    m = managers[0]
+    db = open_db("memory")
+    m.parity_store = ParityStore(m, db, m.codec)
+    m.write_parity = WriteParityAccumulator(m.parity_store, m.codec,
+                                            flush_after=60.0)  # never fires
+    d = os.urandom(7000)
+    h = blake2s_sum(d)
+    await m.write_block(h, DataBlock.plain(d))
+    assert not m.parity_store.coverage(h)
+    await m.write_parity.drain()
+    assert m.parity_store.coverage(h)
+    await shutdown(systems)
+
+
 async def test_parity_geometry_change_recovers_coverage(tmp_path):
     """Regression: the sidecar group id must include the (k, m) codec
     geometry.  With member-hashes-only gids, changing rs_parity made

@@ -237,3 +237,55 @@ async def test_system_status_gossip_triggers_layout_pull(tmp_path):
     assert s2.layout.version == 1
     for s in (s1, s2):
         await s.shutdown()
+
+
+async def test_peer_list_gossip_converges_star_to_mesh(monkeypatch, tmp_path):
+    """An operator bootstraps a cluster by connecting every node to ONE
+    hub (`garage node connect` against a single address — the realistic
+    flow).  Peer-list gossip on the status exchange must teach every
+    node every other node's address, and the peering loop then dials
+    them: the star converges to a full mesh with no operator help."""
+    import garage_tpu.rpc.system as system_mod
+    from garage_tpu.rpc.system import System
+    from garage_tpu.utils.config import config_from_dict
+
+    monkeypatch.setattr(system_mod, "STATUS_EXCHANGE_INTERVAL", 0.2)
+    import garage_tpu.net.peering as peering_mod
+
+    monkeypatch.setattr(peering_mod, "PING_INTERVAL", 0.3)
+
+    n = 5
+    systems = []
+    for i in range(n):
+        cfg = config_from_dict({
+            "metadata_dir": str(tmp_path / f"n{i}" / "meta"),
+            "data_dir": str(tmp_path / f"n{i}" / "data"),
+            "replication_mode": "3",
+            "rpc_bind_addr": "127.0.0.1:0",
+            "rpc_secret": "gossip-test",
+            "bootstrap_peers": [],
+        })
+        s = System(cfg, parse_replication_mode("3"))
+        await s.run()  # listens + starts peering/status loops
+        port = s.netapp._server.sockets[0].getsockname()[1]
+        s.config.rpc_public_addr = f"127.0.0.1:{port}"
+        systems.append(s)
+
+    # star: every node connects only to the hub (node 0)
+    hub_addr = systems[0].config.rpc_public_addr
+    for s in systems[1:]:
+        await s.netapp.connect(hub_addr, expected_id=systems[0].id)
+        s.peering.add_peer(hub_addr, systems[0].id)
+
+    try:
+        deadline = asyncio.get_event_loop().time() + 20.0
+        while asyncio.get_event_loop().time() < deadline:
+            conns = [len(s.netapp.conns) for s in systems]
+            if all(c == n - 1 for c in conns):
+                break
+            await asyncio.sleep(0.2)
+        assert all(len(s.netapp.conns) == n - 1 for s in systems), \
+            [len(s.netapp.conns) for s in systems]
+    finally:
+        for s in systems:
+            await s.shutdown()
