@@ -354,41 +354,49 @@ class BlockManager:
     async def rpc_get_block(self, h: Hash, order_tag: Optional[int] = None) -> bytes:
         """Fetch + decompress a block, trying replicas one at a time in
         latency order (ref manager.rs:231-317)."""
-        block = await self.rpc_get_raw_block(h, order_tag)
-        return await asyncio.to_thread(block.decompressed)
+        chunks = []
+        async for c in self.rpc_get_block_streaming(h, order_tag):
+            chunks.append(c)
+        return b"".join(chunks)
 
     async def rpc_get_raw_block(
-        self, h: Hash, order_tag: Optional[int] = None
+        self, h: Hash, order_tag: Optional[int] = None,
+        for_storage: bool = False,
     ) -> DataBlock:
-        who = self.system.rpc.request_order(self.replication.read_nodes(h))
-        errors = []
-        for node in who:
-            try:
-                resp, stream = await self.endpoint.call_streaming(
-                    node,
-                    {"t": "get_block", "h": bytes(h), "order": order_tag},
-                    prio=PRIO_NORMAL,
-                    timeout=BLOCK_RW_TIMEOUT,
-                )
-                try:
-                    if resp.get("err"):
-                        raise NoSuchBlock(resp["err"])
-                    raw = await stream.read_all() if stream is not None else b""
-                finally:
-                    if stream is not None:
-                        await stream.aclose()  # no-op if fully consumed
-                return DataBlock(
-                    raw, DataBlockHeader.unpack(resp["hdr"]).compressed,
-                    parity=bool(resp.get("parity")),
-                )
-            except Exception as e:
-                errors.append(f"{bytes(node).hex()[:8]}: {e}")
-        raise GarageError(
-            f"could not get block {bytes(h).hex()[:16]} from any node: {errors}"
-        )
+        """Fetch one block as a storable DataBlock.  Rides the SAME
+        streaming failover path as the GET plane — mid-transfer node
+        death resumes from the next replica at the delivered offset
+        (raw offsets are not comparable across replicas, which may hold
+        different encodings, so failover happens in the decompressed
+        domain).  With for_storage, the result is re-compressed so a
+        resynced/repaired copy keeps the storage economics of the
+        original."""
+        meta: dict = {}
+        chunks = []
+        async for c in self.rpc_get_block_streaming(h, order_tag,
+                                                    meta_out=meta):
+            chunks.append(c)
+        data = b"".join(chunks)
+        if for_storage:
+            raw = meta.get("raw_chunks")
+            if raw is not None:
+                # whole block arrived from one replica: store the wire
+                # bytes as received — zero codec work (re-compressing
+                # every resynced block would tax whole-node rebuilds)
+                return DataBlock(b"".join(raw),
+                                 compressed=bool(meta.get("compressed")),
+                                 parity=bool(meta.get("parity")))
+            block = await asyncio.to_thread(
+                DataBlock.from_buffer, data, self.compression_level
+            )
+            return DataBlock(block.inner, block.compressed,
+                             parity=bool(meta.get("parity")))
+        return DataBlock(data, compressed=False,
+                         parity=bool(meta.get("parity")))
 
     async def rpc_get_block_streaming(
-        self, h: Hash, order_tag: Optional[int] = None
+        self, h: Hash, order_tag: Optional[int] = None,
+        meta_out: Optional[dict] = None,
     ) -> AsyncIterator[bytes]:
         """Async-iterate a block's DECOMPRESSED bytes with mid-transfer
         node failover: if the serving node dies mid-stream, the read
@@ -410,6 +418,13 @@ class BlockManager:
                 if resp.get("err"):
                     raise NoSuchBlock(resp["err"])
                 compressed = DataBlockHeader.unpack(resp["hdr"]).compressed
+                if meta_out is not None:
+                    meta_out["parity"] = bool(resp.get("parity"))
+                    meta_out["compressed"] = compressed
+                    # wire frames as received: valid for storage as long
+                    # as no failover stitched two replicas' (possibly
+                    # differently-encoded) streams together
+                    meta_out["raw_chunks"] = [] if delivered == 0 else None
                 decomp = None
                 if compressed:
                     import zstandard
@@ -419,6 +434,9 @@ class BlockManager:
                 try:
                     if stream is not None:
                         async for chunk in stream:
+                            if (meta_out is not None
+                                    and meta_out.get("raw_chunks") is not None):
+                                meta_out["raw_chunks"].append(bytes(chunk))
                             out = decomp.decompress(chunk) if decomp else chunk
                             if not out:
                                 continue
@@ -439,8 +457,16 @@ class BlockManager:
                     if stream is not None:
                         await stream.aclose()
                 return
-            except (GarageError, OSError, asyncio.TimeoutError) as e:
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # ANY per-replica failure fails over to the next replica —
+                # a malformed header (version skew) or a corrupt zstd
+                # frame from one node must not mask a healthy copy one
+                # hop away (ref manager.rs:231-317 tries each in turn)
                 errors.append(f"{bytes(node).hex()[:8]}: {e}")
+                if meta_out is not None and delivered > 0:
+                    meta_out["raw_chunks"] = None  # stitched: frames mixed
         raise GarageError(
             f"could not stream block {bytes(h).hex()[:16]} from any node "
             f"(delivered {delivered} bytes): {errors}"

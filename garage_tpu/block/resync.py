@@ -260,7 +260,7 @@ class BlockResyncManager:
                     mgr.blocks_reconstructed += 1
                     return
             try:
-                block = await mgr.rpc_get_raw_block(h)
+                block = await mgr.rpc_get_raw_block(h, for_storage=True)
             except Exception:
                 # every replica is unreachable or damaged: last line of
                 # defense is DISTRIBUTED parity — fetch ≥ k surviving

@@ -121,6 +121,20 @@ async def _fetch_verified(garage, mh: bytes) -> Optional[bytes]:
     mgr = garage.block_manager
     h = Hash(mh)
     raw = None
+    # the repairing node's OWN store first: after a layout change the
+    # new ring may route a piece elsewhere while this node still holds
+    # the only live copy (observed: repair stalled on pieces sitting in
+    # the repairer's own block dir)
+    if mgr.is_block_present(h):
+        try:
+            block = await mgr.read_block(h)
+            raw = await asyncio.to_thread(block.decompressed)
+        except Exception:
+            raw = None
+    if raw is not None:
+        if bytes(block_hash(raw, mgr.hash_algo)) == bytes(mh):
+            return raw
+        raw = None
     try:
         raw = await mgr.rpc_get_block(h)
     except Exception as ring_err:

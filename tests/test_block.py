@@ -331,6 +331,13 @@ async def test_streaming_get_midstream_failover(tmp_path):
         async for chunk in m0.rpc_get_block_streaming(h):
             got.extend(chunk)
         assert bytes(got) == payload
+
+        # the RAW fetch path (resync/repair) rides the SAME failover:
+        # a storable DataBlock comes back whole despite the mid-stream
+        # death, re-compressed when that pays (for_storage)
+        block = await m0.rpc_get_raw_block(h, for_storage=True)
+        assert block.decompressed() == payload
+        assert bytes(blake2s_sum(block.decompressed())) == bytes(h)
         await shutdown(systems)
 
 
