@@ -141,7 +141,7 @@ def bench_device_resident(codec) -> float:
 
     tpu = codec.tpu
     if tpu is None:
-        return 0.0
+        return 0.0, 0.0, 0.0
     try:
         n = 32
         rng = np.random.default_rng(7)
@@ -166,10 +166,43 @@ def bench_device_resident(codec) -> float:
             out = tpu._scrub_jit(da, dl, de, tpu._K_enc, k=k)
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
-        return reps * n * BLOCK / dt / 2**30
+        fused = reps * n * BLOCK / dt / 2**30
+
+        # north-star comparison: HBM-resident GF apply, Pallas kernel vs
+        # the XLA mask-XOR formulation, same data
+        pallas_gibs = xla_gf_gibs = 0.0
+        try:
+            from garage_tpu.ops.pallas_gf import PallasGf
+            from garage_tpu.ops.tpu_codec import bytes_view_u32
+            from garage_tpu.ops import gf256
+
+            u32 = jax.device_put(
+                bytes_view_u32(jnp.asarray(parr)).reshape(n // k, k, -1))
+            jax.block_until_ready(u32)
+            mat = gf256.rs_parity_matrix(k, codec.params.rs_parity)
+            pg = PallasGf(mat)
+            jax.block_until_ready(pg(u32))  # compile
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                o = pg(u32)
+            jax.block_until_ready(o)
+            pallas_gibs = reps * n * BLOCK / (time.perf_counter() - t0) / 2**30
+        except Exception:
+            print("# pallas GF kernel unavailable on device",
+                  file=sys.stderr)
+        try:
+            jax.block_until_ready(tpu._gf_jit(u32, tpu._K_enc))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                o = tpu._gf_jit(u32, tpu._K_enc)
+            jax.block_until_ready(o)
+            xla_gf_gibs = reps * n * BLOCK / (time.perf_counter() - t0) / 2**30
+        except Exception:
+            traceback.print_exc()
+        return fused, pallas_gibs, xla_gf_gibs
     except Exception:
         traceback.print_exc()
-        return 0.0
+        return 0.0, 0.0, 0.0
 
 
 def bench_hybrid(batches, tpu_ok: bool):
@@ -211,7 +244,7 @@ def bench_hybrid(batches, tpu_ok: bool):
             # UNAVAILABLE mid-run): degrade to the CPU floor, never to 0
             traceback.print_exc()
             codec.tpu = None
-    device_gibs = bench_device_resident(codec)
+    device_gibs, pallas_gf_gibs, xla_gf_gibs = bench_device_resident(codec)
     codec.pop_stats()
 
     # one scrub_many pass over the whole stream: a single work-stealing
@@ -226,7 +259,8 @@ def bench_hybrid(batches, tpu_ok: bool):
     bytes_cpu, bytes_tpu = codec.pop_stats()
     total = bytes_cpu + bytes_tpu
     frac = bytes_tpu / total if total else 0.0
-    return N_BATCHES * BATCH * BLOCK / dt / 2**30, frac, device_gibs
+    return (N_BATCHES * BATCH * BLOCK / dt / 2**30, frac, device_gibs,
+            pallas_gf_gibs, xla_gf_gibs)
 
 
 def bench_cpu(batches) -> float:
@@ -678,8 +712,10 @@ def main() -> None:
 
     baseline = max(baseline, bench_reference_serial(batches))
     hybrid, tpu_frac, device_gibs = 0.0, 0.0, 0.0
+    pallas_gf_gibs = xla_gf_gibs = 0.0
     try:
-        hybrid, tpu_frac, device_gibs = bench_hybrid(batches, tpu_ok)
+        (hybrid, tpu_frac, device_gibs,
+         pallas_gf_gibs, xla_gf_gibs) = bench_hybrid(batches, tpu_ok)
     except Exception:
         traceback.print_exc()
 
@@ -692,6 +728,8 @@ def main() -> None:
         "cpu_gibs": round(cpu, 4),
         "tpu_frac": round(tpu_frac, 4),
         "device_gibs": round(device_gibs, 4),
+        "pallas_gf_gibs": round(pallas_gf_gibs, 4),
+        "xla_gf_gibs": round(xla_gf_gibs, 4),
         "rs84_repair_2loss_gibs": round(repair, 4),
         **extra,
     }))

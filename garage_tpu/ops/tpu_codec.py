@@ -163,8 +163,15 @@ class TpuCodec(BlockCodec):
             )
         if params.rs_data > 0:
             pm = gf256.rs_parity_matrix(params.rs_data, params.rs_parity)
+            self._enc_mat = pm
             self._K_enc = jnp.asarray(gf_mask_consts(pm))
         self._decode_w_cache = {}
+        # Pallas GF kernels (north star): VMEM-resident mask-XOR apply,
+        # one HBM read per input byte.  Built lazily per matrix; the
+        # first runtime failure (a backend without Mosaic support)
+        # permanently falls back to the XLA kernel.
+        self._pallas_cache = {}
+        self._pallas_ok = True
         self.mesh = None
         if params.shard_mesh > 1:
             devs = (devices or jax.devices())[: params.shard_mesh]
@@ -288,14 +295,43 @@ class TpuCodec(BlockCodec):
             )
         return flat, n
 
-    def _gf_apply_np(self, flat: np.ndarray, K) -> np.ndarray:
+    def _pallas_for(self, mat: np.ndarray):
+        """PallasGf for this matrix, or None (unsupported backend)."""
+        if not self._pallas_ok:
+            return None
+        key = mat.tobytes()
+        pg = self._pallas_cache.get(key)
+        if pg is None:
+            from .pallas_gf import PallasGf
+
+            pg = PallasGf(mat)
+            self._pallas_cache[key] = pg
+        return pg
+
+    def _gf_apply_np(self, flat: np.ndarray, K,
+                     mat: Optional[np.ndarray] = None) -> np.ndarray:
         """(N, k, S) uint8 through the mask-XOR kernel; S padded to ×4 for
-        the uint32 view, result truncated back."""
+        the uint32 view, result truncated back.  Prefers the Pallas
+        kernel when the matrix is known and the backend supports Mosaic;
+        falls back to the XLA formulation."""
         s = flat.shape[-1]
         pad = (-s) % 4
         if pad:
             flat = np.pad(flat, [(0, 0)] * (flat.ndim - 1) + [(0, pad)])
         u32 = bytes_view_u32(jnp.asarray(flat))
+        if mat is not None:
+            pg = self._pallas_for(mat)
+            if pg is not None:
+                try:
+                    out = u32_view_bytes(pg(u32))
+                    return np.asarray(out)[..., :s]
+                except Exception:
+                    import logging
+
+                    logging.getLogger("garage_tpu.ops").warning(
+                        "pallas GF kernel unavailable on this backend; "
+                        "using the XLA kernel", exc_info=True)
+                    self._pallas_ok = False
         out = u32_view_bytes(self._gf_jit(u32, K))
         return np.asarray(out)[..., :s]
 
@@ -303,23 +339,24 @@ class TpuCodec(BlockCodec):
         assert data.shape[-2] == self.params.rs_data, data.shape
         lead = data.shape[:-2]
         flat, n = self._flat_padded(data)
-        out = self._gf_apply_np(flat, self._K_enc)[:n]
+        out = self._gf_apply_np(flat, self._K_enc, mat=self._enc_mat)[:n]
         return out.reshape(lead + out.shape[-2:])
 
     def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int],
                        rows: Optional[Sequence[int]] = None) -> np.ndarray:
         k, m = self.params.rs_data, self.params.rs_parity
         key = (tuple(present[:k]), tuple(rows) if rows is not None else None)
-        K = self._decode_w_cache.get(key)
-        if K is None:
+        cached = self._decode_w_cache.get(key)
+        if cached is None:
             dec = gf256.rs_decode_matrix(k, m, present)
             if rows is not None:
                 dec = np.ascontiguousarray(dec[list(rows)])
-            K = jnp.asarray(gf_mask_consts(dec))
-            self._decode_w_cache[key] = K
+            cached = (jnp.asarray(gf_mask_consts(dec)), dec)
+            self._decode_w_cache[key] = cached
+        K, dec_mat = cached
         lead = shards.shape[:-2]
         flat, n = self._flat_padded(shards[..., :k, :])
-        out = self._gf_apply_np(flat, K)[:n]
+        out = self._gf_apply_np(flat, K, mat=dec_mat)[:n]
         return out.reshape(lead + out.shape[-2:])
 
     # --- fused pipelined scrub (the north-star hot path) ---

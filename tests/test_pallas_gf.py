@@ -1,0 +1,48 @@
+"""Bit-identity of the Pallas GF(2^8) kernel against the numpy oracle.
+
+Runs the kernel through the Pallas INTERPRETER (no TPU needed), so what
+is verified is the kernel's math, not Mosaic codegen; the device-rate
+comparison against the XLA formulation happens in bench.py on real
+hardware (pallas_gf_gibs)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from garage_tpu.ops import gf256  # noqa: E402
+from garage_tpu.ops.pallas_gf import PallasGf, reference_apply  # noqa: E402
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 4)])
+def test_encode_matrix_bit_identity(k, m):
+    rng = np.random.default_rng(k * 10 + m)
+    mat = gf256.rs_parity_matrix(k, m)
+    pg = PallasGf(mat, tile=128, interpret=True)
+    sh = rng.integers(0, 2**32, (2, k, 300), dtype=np.uint32)
+    out = np.asarray(pg(jnp.asarray(sh)))
+    assert (out == reference_apply(mat, sh)).all()
+
+
+def test_decode_matrix_and_row_restriction():
+    rng = np.random.default_rng(7)
+    dec = gf256.rs_decode_matrix(8, 4, [0, 1, 3, 4, 6, 7, 8, 9])
+    pg = PallasGf(dec, tile=128, interpret=True)
+    sh = rng.integers(0, 2**32, (1, 8, 257), dtype=np.uint32)
+    assert (np.asarray(pg(jnp.asarray(sh)))
+            == reference_apply(dec, sh)).all()
+    rows = np.ascontiguousarray(dec[[2, 5]])
+    pgr = PallasGf(rows, tile=128, interpret=True)
+    assert (np.asarray(pgr(jnp.asarray(sh)))
+            == reference_apply(rows, sh)).all()
+
+
+def test_tile_padding_and_batch_fold():
+    """Columns not divisible by the tile and multi-codeword batches."""
+    rng = np.random.default_rng(3)
+    mat = gf256.rs_parity_matrix(4, 2)
+    pg = PallasGf(mat, tile=256, interpret=True)
+    for b, s4 in [(1, 100), (3, 511), (5, 256)]:
+        sh = rng.integers(0, 2**32, (b, 4, s4), dtype=np.uint32)
+        assert (np.asarray(pg(jnp.asarray(sh)))
+                == reference_apply(mat, sh)).all(), (b, s4)
