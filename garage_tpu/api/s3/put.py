@@ -207,7 +207,8 @@ async def read_and_put_blocks(
     put_task: Optional[asyncio.Task] = None
     unflushed = 0
 
-    async def put_one(h: Hash, data: bytes, off: int, flush_meta: bool):
+    async def put_one(h: Hash, data: bytes, off: int, flush_meta: bool,
+                      started: asyncio.Event):
         # add_block runs HERE, not in the dispatch loop: a concurrent
         # flush insert must never encode a version row referencing a
         # block whose quorum write has not started (crash would leave
@@ -215,6 +216,7 @@ async def read_and_put_blocks(
         # task, the row only ever includes blocks whose write is at
         # least concurrent with the insert — the reference's window.
         version.add_block(part_number, off, bytes(h), len(data))
+        started.set()
         if flush_meta:
             # version row (hook creates the block refs) in parallel with
             # the block quorum write (put.rs:362-390)
@@ -259,8 +261,9 @@ async def read_and_put_blocks(
                 flush = unflushed >= META_BATCH
                 if flush:
                     unflushed = 0
+                started = asyncio.Event()
                 put_task = asyncio.ensure_future(
-                    put_one(h, b, offset, flush))
+                    put_one(h, b, offset, flush, started))
                 offset += len(b)
             block = await chunker.next()
         # the version row must hold every block before the caller lands
@@ -268,9 +271,10 @@ async def read_and_put_blocks(
         # gathering with the final block write keeps the small-object
         # overlap the per-block path always had
         if put_task is not None and unflushed:
-            # one yield guarantees the task's synchronous prefix (its
-            # add_block) ran before the insert encodes the row
-            await asyncio.sleep(0)
+            # the explicit event (set right after add_block) guarantees
+            # the row encodes the tail block regardless of event-loop
+            # scheduling policy
+            await started.wait()
             await asyncio.gather(
                 put_task, garage.version_table.insert(version))
         elif put_task is not None:

@@ -291,13 +291,21 @@ class BlockManager:
 
     def block_decref(self, tx, h: Hash) -> None:
         if self.rc.block_decref(tx, h):
-            # reached zero: schedule deletion check after the GC delay
+            # reached zero: schedule deletion check after the GC delay —
+            # unless this node is no longer ring-assigned the block (a
+            # layout change moved it away; the decref is the block_ref
+            # partition offloading).  Waiting the full delay there left
+            # sole-copy blocks (data replication "none") unreadable for
+            # 10 minutes after a node left the layout; the prompt resync
+            # offers the block to its new owners and only deletes once
+            # they all confirm possession (resync migration branch).
             if self.resync is not None:
                 from .rc import BLOCK_GC_DELAY_MS
 
-                tx.on_commit(
-                    lambda: self.resync.put_to_resync(h, BLOCK_GC_DELAY_MS / 1000.0)
-                )
+                delay = BLOCK_GC_DELAY_MS / 1000.0
+                if not self.is_assigned(h):
+                    delay = 2.0
+                tx.on_commit(lambda: self.resync.put_to_resync(h, delay))
 
     # --- RPC client side ---
 
@@ -481,6 +489,28 @@ class BlockManager:
                 and not self.is_block_present(h)
                 and self.is_assigned(h))
 
+    async def drop_stray_copy(self, h: Hash) -> None:
+        """Physically delete a local copy this node is NOT assigned —
+        migration cleanup, called by resync only after every assigned
+        node confirmed possession.  Unlike delete_if_unneeded this does
+        not wait out the rc GC delay: the copies exist where the ring
+        wants them, so the stray is redundant regardless of timers.  A
+        freshly-arrived local ref (rc>0 again) vetoes, to be safe."""
+        async with self._lock_for(h):
+            if self.rc.get(h).is_needed() or self.is_assigned(h):
+                return
+            while True:
+                found = self.find_block(h)
+                if found is None:
+                    break
+                await asyncio.to_thread(os.remove, found[0])
+            # also drop the Deletable{at_time} rc row: nothing would
+            # ever clear it for a departed block (clear_deleted_block_rc
+            # only fires from delete_if_unneeded after the timer), and a
+            # phantom row inflates rc_len and re-enqueues a no-op resync
+            # on every `repair blocks` pass forever
+            self.rc.clear_stray_rc(h)
+
     # --- RPC server side (ref manager.rs:671-687) ---
 
     async def _handle(self, remote, msg, body):
@@ -504,7 +534,11 @@ class BlockManager:
             return hdr, _chunks(block.inner)
         if t == "need_block":
             h = Hash(bytes(msg["h"]))
-            return {"needed": await self.need_block(h)}, None
+            # "present" lets a departing holder learn when every assigned
+            # node has a copy, unlocking prompt stray deletion (see
+            # resync._resync_block_inner migration branch)
+            return {"needed": await self.need_block(h),
+                    "present": self.is_block_present(h)}, None
         raise GarageError(f"unknown block rpc {t!r}")
 
     # --- introspection ---

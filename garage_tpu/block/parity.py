@@ -24,6 +24,7 @@ fails loudly and the caller falls back to the network.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 from typing import List, Optional, Sequence
@@ -378,7 +379,17 @@ class ParityDistributor:
 
         m = int(parity.shape[0])
         k = self.manager.codec.params.rs_data
-        gid = ParityStore._gid(k, m, hashes)
+        # Salted gid: DISTRIBUTED codeword ids must be unique per encode,
+        # not deterministic — a revert after a failed index insert leaves
+        # a sticky or-merged tombstone under the gid, and a deterministic
+        # id would make any later re-encode of the same member set merge
+        # into that tombstone and silently yield zero coverage.  (The
+        # LOCAL sidecar store keeps the deterministic _gid: its files are
+        # refreshed in place each scrub pass and carry no CRDT.)  Cost:
+        # two writers racing the same group create two independent
+        # codewords — double parity until GC, never wrong coverage.
+        gid = blake2s_sum(
+            bytes(ParityStore._gid(k, m, hashes)) + os.urandom(8))
         taken = set()
         for h in hashes:
             nodes = self.manager.replication.write_nodes(Hash(h))
@@ -405,8 +416,44 @@ class ParityDistributor:
             )
             for i, h in enumerate(hashes)
         ]
-        await self.table.insert_many(entries)
+        # The shards are on disk cluster-wide but carry rc only once the
+        # index's member-0 row lands (parity_index_table.updated).  If
+        # the insert is lost the shards are orphans nothing reclaims, so
+        # retry, then on terminal failure mark them Deletable through
+        # the ordinary ref machinery (incref+decref → GC delay → reclaim).
+        for attempt in range(3):
+            try:
+                await self.table.insert_many(entries)
+                break
+            except Exception:
+                if attempt == 2:
+                    logger.exception(
+                        "parity index insert failed for gid %s; "
+                        "tombstoning the codeword", bytes(gid).hex()[:16])
+                    await self._revert_codeword(entries)
+                    return
+                await asyncio.sleep(0.5 * (attempt + 1))
         self.codewords_distributed += 1
+
+    async def _revert_codeword(self, entries) -> None:
+        """Best-effort revert after a terminal index-insert failure.
+
+        Tombstone the INDEX rows, not the parity block-refs: a quorum
+        failure can be a partial success, and a minority node that
+        applied a live member-0 row would anti-entropy it cluster-wide
+        later.  The or-merged tombstone neutralizes any such row (its
+        updated() hook then performs the decref that reclaims the
+        shards); if no row was applied anywhere, the shards simply have
+        rc = 0 and phase 2 of `repair blocks` hands them to resync,
+        which deletes unreferenced local blocks."""
+        for e in entries:
+            e.deleted.set()
+        try:
+            await self.table.insert_many(entries)
+        except Exception:
+            logger.warning(
+                "codeword revert insert also failed; shards are rc-less "
+                "orphans until the next `repair blocks` pass")
 
 
 class WriteParityAccumulator:
@@ -471,8 +518,6 @@ class WriteParityAccumulator:
         """Register a freshly-written block.  Event loop only; the block
         is held as stored (possibly compressed) and decompressed on the
         encode thread, so the write path pays nothing."""
-        import asyncio
-
         k = self.codec.params.rs_data
         if k <= 0:
             return
@@ -495,8 +540,6 @@ class WriteParityAccumulator:
             self._timer = loop.call_later(self.flush_after, self._flush)
 
     def _flush(self) -> None:
-        import asyncio
-
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
@@ -512,8 +555,6 @@ class WriteParityAccumulator:
         task.add_done_callback(self._tasks.discard)
 
     async def _encode_and_store(self, group: List[tuple]) -> None:
-        import asyncio
-
         try:
             hashes = [h for h, _ in group]
 
@@ -538,8 +579,6 @@ class WriteParityAccumulator:
     async def drain(self) -> None:
         """Flush the partial codeword and wait for in-flight encodes
         (shutdown path — a clean stop must not lose the tail)."""
-        import asyncio
-
         self._flush()
         while self._tasks:
             await asyncio.gather(*list(self._tasks), return_exceptions=True)

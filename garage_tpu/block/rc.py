@@ -101,6 +101,19 @@ class BlockRc:
 
         self.tree.db.transaction(txn)
 
+    def clear_stray_rc(self, h: Hash) -> None:
+        """Remove a zero-count entry regardless of its timer — migration
+        cleanup after drop_stray_copy, where the timer's grace serves no
+        purpose (the ring no longer assigns this node the block and every
+        owner confirmed possession).  A concurrent incref vetoes."""
+
+        def txn(tx: Transaction):
+            ent = RcEntry.parse(tx.get(self.tree, bytes(h)))
+            if ent.is_zero():
+                tx.remove(self.tree, bytes(h))
+
+        self.tree.db.transaction(txn)
+
     def rc_len(self) -> int:
         return len(self.tree)
 

@@ -202,11 +202,17 @@ class BlockResyncManager:
         rc = mgr.rc.get(h)
         present = mgr.is_block_present(h)
 
-        if rc.is_deletable() and present:
+        unassigned = not mgr.is_assigned(h)
+        migrating = rc.is_zero() and present and unassigned
+        if (rc.is_deletable() and present) or migrating:
             # we hold a block nobody references: offer to under-replicated
-            # peers, then delete (ref resync.rs:376-455)
+            # peers, then delete (ref resync.rs:376-455).  The migrating
+            # case (rc just hit zero because a layout change moved the
+            # block's refs away) runs the same offer/push immediately —
+            # with data replication "none" this node may hold the ONLY
+            # copy, and its new owner cannot serve reads until it lands.
             who = [n for n in mgr.replication.write_nodes(h) if n != mgr.system.id]
-            needy = []
+            needy, remote_present = [], 0
             for node in who:
                 resp = await mgr.endpoint.call(
                     node,
@@ -216,6 +222,8 @@ class BlockResyncManager:
                 )
                 if resp.get("needed"):
                     needy.append(node)
+                elif resp.get("present"):
+                    remote_present += 1
             if needy:
                 block = await mgr.read_block(h)
                 from .manager import _chunks
@@ -238,7 +246,21 @@ class BlockResyncManager:
                 logger.info(
                     "offloaded block %s to %d nodes", bytes(h).hex()[:16], len(needy)
                 )
-            await mgr.delete_if_unneeded(h)
+            confirmed = bool(who) and remote_present + len(needy) >= len(who)
+            if unassigned and not confirmed:
+                # owners' refs (rc) haven't migrated yet, so they
+                # answered neither needed nor present.  Hold the only
+                # copy and retry soon — NEVER delete unconfirmed, even
+                # after the GC timer expires (a backlogged meta sync must
+                # not turn into data loss; the timer's promise is only
+                # valid where the ring still assigns us the block).
+                self.put_to_resync(h, 30.0)
+            elif rc.is_deletable():
+                await mgr.delete_if_unneeded(h)
+            else:
+                # unassigned, every owner confirmed, timer still running:
+                # the stray is redundant, drop it without waiting
+                await mgr.drop_stray_copy(h)
 
         elif rc.is_needed() and not present and mgr.is_assigned(h):
             # we are ring-ASSIGNED this block but don't have it: rebuild

@@ -198,6 +198,7 @@ class Garage:
             # GC rides the GLOBAL deletion signal (last live version-ref
             # tombstoned), never local/migration deletes
             block_ref_schema.on_ref_dropped = make_parity_gc(self)
+            self._want_parity_sweeper = True
 
         version_schema = VersionTableSchema(self.block_ref_table)
         self.version_table = Table(
@@ -307,6 +308,11 @@ class Garage:
             ),
         )
         self.bg.spawn(self.lifecycle_worker)
+        if getattr(self, "_want_parity_sweeper", False):
+            from .parity_repair import ParityGcSweeper
+
+            self.parity_gc_sweeper = ParityGcSweeper(self)
+            self.bg.spawn(self.parity_gc_sweeper)
         self.bg_vars.register_ro(
             "lifecycle-last-completed",
             lambda: (

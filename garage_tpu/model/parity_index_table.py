@@ -139,7 +139,16 @@ class ParityIndexTableSchema(TableSchema):
             for ph in (new.parity_hashes or []):
                 self.block_ref_table.data.queue_insert(
                     tx, BlockRef(Hash(ph), refv))
-        elif was and not now:
+        elif was and not now and new is not None:
+            # Decref ONLY on a logical tombstone (new row with
+            # deleted=True).  new=None is PHYSICAL removal — partition
+            # offload after a layout change (table/sync.py
+            # delete_if_equal) or tombstone GC — and says nothing about
+            # cluster-wide liveness.  The deleted BlockRefs queued here
+            # are sticky or-merged tombstones that propagate everywhere;
+            # firing them on offload would decref and GC live parity
+            # blocks cluster-wide, permanently stripping erasure
+            # coverage (same hazard block_ref_table.py:74-81 guards).
             for ph in (old.parity_hashes or []):
                 self.block_ref_table.data.queue_insert(
                     tx, BlockRef(Hash(ph), refv, deleted=True))

@@ -20,10 +20,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 import numpy as np
 
+from ..utils.background import Worker
 from ..utils.data import Hash, block_hash
 
 logger = logging.getLogger("garage_tpu.model.parity_repair")
@@ -33,6 +35,85 @@ logger = logging.getLogger("garage_tpu.model.parity_repair")
 # can belong to several codewords over its life (re-groupings); tombstones
 # keep occupying slots, so the scan must look well past the live ones.
 INDEX_SCAN_LIMIT = 64
+
+# Delay between "looks dead" and the irreversible index tombstone: long
+# enough for every node's insert queue to drain a just-queued live ref
+# (the worker pushes batches immediately; seconds covers a busy node).
+# Tests shrink this.
+PARITY_GC_GRACE_S = 5.0
+
+
+async def has_live_ref(garage, h: Hash) -> bool:
+    """Any live non-parity BlockRef for `h`, looking progressively
+    further: applied local store → local insert queue → paginated quorum
+    read (a dedup'd block can carry any number of refs, and one live ref
+    past the page edge must still veto the GC)."""
+    from ..table.schema import DeletedFilter, hash_partition_key
+    from .parity_index_table import is_parity_ref
+    from .s3.block_ref_table import BlockRef  # noqa: F401 — decode type
+
+    data = garage.block_ref_table.data
+    prefix = bytes(hash_partition_key(bytes(h)))
+    for k, raw in data.store.items(prefix, None):
+        if k[:32] != prefix:
+            break
+        br = data.decode_entry(raw)
+        if not br.deleted.value and not is_parity_ref(br.version):
+            return True  # still referenced somewhere: keep coverage
+    # A live ref from a concurrent PUT may still sit in the local
+    # insert queue (queue_insert keys by tree_key = partition prefix +
+    # sort key) without having reached the store yet — the index
+    # tombstone is sticky, so looking only at the applied store would
+    # permanently strip coverage for a block that is very much alive.
+    for k, raw in data.insert_queue.items(prefix, None):
+        if k[:32] != prefix:
+            break
+        br = data.decode_entry(raw)
+        if not br.deleted.value and not is_parity_ref(br.version):
+            return True
+    # Local rows can lag the cluster (this node may have missed the
+    # PUT's quorum); confirm against a quorum read before tombstoning.
+    cursor = None
+    while True:
+        remote = await garage.block_ref_table.get_range(
+            bytes(h), cursor, filter=DeletedFilter.NOT_DELETED,
+            limit=INDEX_SCAN_LIMIT)
+        for br in remote:
+            if not br.deleted.value and not is_parity_ref(br.version):
+                return True
+        if len(remote) < INDEX_SCAN_LIMIT:
+            break
+        cursor = bytes(remote[-1].sort_key) + b"\x00"
+    return False
+
+
+async def gc_if_dead(garage, h: Hash, grace: Optional[float] = None,
+                     *, pre_checked: bool = False) -> bool:
+    """Tombstone `h`'s parity-index rows if no live ref remains anywhere.
+    Returns True if rows were tombstoned.  Raises on read/insert failure
+    (callers decide whether to retry; keeping coverage is always safe).
+    pre_checked: the caller already ran has_live_ref AND served the grace
+    (the drain path batches both); only the final re-check runs here."""
+    if not pre_checked:
+        if await has_live_ref(garage, h):
+            return False
+        # Grace re-check: a live ref for a deduplicated block may sit in
+        # a REMOTE node's insert queue (a version-partition node's hook
+        # queued it; its InsertQueueWorker hasn't pushed yet) — invisible
+        # to both the local scans and the quorum read.  The queues drain
+        # in seconds; waiting out one drain cycle before the irreversible
+        # or-merged tombstone closes the practical window.
+        await asyncio.sleep(PARITY_GC_GRACE_S if grace is None else grace)
+    if await has_live_ref(garage, h):
+        return False
+    entries = await garage.parity_index_table.get_range(
+        bytes(h), None, limit=INDEX_SCAN_LIMIT)
+    dead = [e for e in entries if not e.is_tombstone()]
+    for e in dead:
+        e.deleted.set()
+    if dead:
+        await garage.parity_index_table.insert_many(dead)
+    return bool(dead)
 
 
 def make_parity_gc(garage):
@@ -47,44 +128,163 @@ def make_parity_gc(garage):
     its local copy during migration/offload says nothing about the
     block's global liveness, and GC'ing coverage there would strip
     erasure protection from a block that still exists (with an or-merged
-    sticky tombstone, unrecoverably — the gid is deterministic).  The
-    block_ref and parity_index tables shard by the same hash, so this
-    check reads only local rows."""
-    from .parity_index_table import is_parity_ref
-    from .s3.block_ref_table import BlockRef
+    sticky tombstone, unrecoverably).  The block_ref and parity_index
+    tables shard by the same hash, so the first-line check reads only
+    local rows.
+
+    Dropped hashes accumulate in a pending SET drained by one task —
+    a bulk delete tombstoning refs for 100k blocks costs one set of
+    hashes and one serialized read loop, not 100k concurrent 5-second
+    tasks each firing quorum reads.  The grace sleep is amortized per
+    drain batch, not paid per hash.  Best-effort: anything left pending
+    at a crash is reclaimed by the ParityGcSweeper's next pass."""
+
+    pending: set = set()
+    state = {"drainer": None}
 
     def on_ref_dropped(h: Hash) -> None:
-        task = asyncio.get_running_loop().create_task(_gc_if_dead(garage, h))
-        _GC_TASKS.add(task)
-        task.add_done_callback(_GC_TASKS.discard)
+        pending.add(bytes(h))
+        if state["drainer"] is None or state["drainer"].done():
+            state["drainer"] = asyncio.get_running_loop().create_task(
+                _drain())
 
-    async def _gc_if_dead(garage, h: Hash) -> None:
-        try:
-            from ..table.schema import hash_partition_key
-
-            data = garage.block_ref_table.data
-            prefix = bytes(hash_partition_key(bytes(h)))
-            for k, raw in data.store.items(prefix, None):
-                if k[:32] != prefix:
-                    break
-                br: BlockRef = data.decode_entry(raw)
-                if not br.deleted.value and not is_parity_ref(br.version):
-                    return  # still referenced somewhere: keep coverage
-            entries = await garage.parity_index_table.get_range(
-                bytes(h), None, limit=INDEX_SCAN_LIMIT)
-            dead = [e for e in entries if not e.is_tombstone()]
-            for e in dead:
-                e.deleted.set()
-            if dead:
-                await garage.parity_index_table.insert_many(dead)
-        except Exception:
-            logger.debug("parity GC for %s failed (will retry on next "
-                         "ref drop)", bytes(h).hex()[:16], exc_info=True)
+    async def _drain() -> None:
+        while pending:
+            batch = [pending.pop()
+                     for _ in range(min(len(pending), GC_DRAIN_BATCH))]
+            try:
+                looks_dead = []
+                for hb in batch:
+                    if not await has_live_ref(garage, Hash(hb)):
+                        looks_dead.append(hb)
+                if looks_dead:
+                    # one grace sleep for the whole batch: remote insert
+                    # queues drain while we wait, then each candidate is
+                    # re-checked by gc_if_dead's first has_live_ref
+                    await asyncio.sleep(PARITY_GC_GRACE_S)
+                for hb in looks_dead:
+                    try:
+                        await gc_if_dead(garage, Hash(hb), pre_checked=True)
+                    except Exception:
+                        logger.debug(
+                            "parity GC for %s failed (sweeper will retry)",
+                            hb.hex()[:16], exc_info=True)
+            except Exception:
+                logger.debug("parity GC drain batch failed (sweeper will "
+                             "retry)", exc_info=True)
 
     return on_ref_dropped
 
 
-_GC_TASKS: set = set()
+GC_DRAIN_BATCH = 256
+
+
+class ParityGcSweeper(Worker):
+    """Convergent backstop for the one-shot ref-drop GC trigger: slowly
+    walks this node's LOCAL parity_index rows and re-runs the liveness
+    check for each live member row.  Any codeword whose ref-drop event
+    was lost — trigger crashed mid-grace, quorum read failed during the
+    check, node was down when the delete happened — is reclaimed on a
+    later pass, backing the "GC will retry" promise with convergence
+    rather than hope."""
+
+    SWEEP_BATCH = 64
+    SWEEP_INTERVAL_S = 3600.0  # full-pass cadence
+    # inter-batch throttle: every live row costs a (mostly local, but up
+    # to quorum) read — "slowly walks" must be enforced, not promised;
+    # with 64-row batches this caps the sweep at ~64 rows/s per node
+    SWEEP_BATCH_PAUSE_S = 1.0
+    # never judge a codeword younger than this: a fresh distribution's
+    # FIRST version-ref may still be in flight through remote insert
+    # queues, and the sweep's liveness check would see a dead block
+    MIN_AGE_MS = 10 * 60 * 1000
+
+    def __init__(self, garage):
+        self.garage = garage
+        self.cursor: bytes = b""
+        self._next_pass = 0.0
+        self.swept = 0  # current-pass counters, snapshot at pass end
+        self.reclaimed = 0
+
+    def name(self) -> str:
+        return "parity GC sweeper"
+
+    async def work(self):
+        from ..utils.background import WorkerState
+        from ..utils.crdt import now_msec
+
+        if self.cursor == b"" and time.monotonic() < self._next_pass:
+            return WorkerState.IDLE
+        data = self.garage.parity_index_table.data
+        batch = []
+        for k, raw in data.store.items(self.cursor, None):
+            if k == self.cursor:
+                continue
+            batch.append((k, raw))
+            if len(batch) >= self.SWEEP_BATCH:
+                break
+        if not batch:
+            self.cursor = b""
+            self._next_pass = time.monotonic() + self.SWEEP_INTERVAL_S
+            self.status().progress = (
+                f"last pass: {self.swept} checked, "
+                f"{self.reclaimed} reclaimed")
+            self.swept = self.reclaimed = 0
+            return WorkerState.IDLE
+        now = now_msec()
+        for k, raw in batch:
+            self.cursor = k
+            try:
+                ent = data.decode_entry(raw)
+            except Exception:
+                continue
+            if (ent.is_tombstone()
+                    or now - ent.timestamp < self.MIN_AGE_MS):
+                continue
+            # Evidence-of-death gate: after a layout change, the
+            # block_ref partition for this member may reach this node
+            # LATER than the parity_index partition (independent table
+            # syncers), and a quorum read interrupted after the two
+            # fastest — equally freshly-synced — replicas can also come
+            # back empty.  An absent partition is indistinguishable from
+            # a dead block by liveness checks alone, so the sweep only
+            # judges members whose local block_ref rows exist (a dead
+            # block leaves tombstoned refs; a lagging sync leaves
+            # nothing).  A fully tombstone-GC'd partition is skipped too
+            # — the previous passes had hours to act before that.
+            if not self._local_ref_evidence(ent.member):
+                continue
+            try:
+                # EVERY member's row is checked (not only member-0): each
+                # member has its own partition's rows, and the lost-event
+                # leak applies to each independently.  gc_if_dead(h)
+                # tombstones all of member h's rows; the member-0 row's
+                # hook is what decrefs the parity blocks.  Full grace
+                # applies — the sweep races fresh dedup PUTs exactly like
+                # the trigger does, and only sleeps when a row looks dead.
+                if await gc_if_dead(self.garage, ent.member):
+                    self.reclaimed += 1
+            except Exception:
+                logger.debug("sweep GC for %s failed (next pass retries)",
+                             bytes(ent.member).hex()[:16], exc_info=True)
+            self.swept += 1
+        await asyncio.sleep(self.SWEEP_BATCH_PAUSE_S)
+        return WorkerState.BUSY
+
+    def _local_ref_evidence(self, member: Hash) -> bool:
+        """Any block_ref row (live or tombstoned) for the member in the
+        LOCAL store — proof the ref partition has actually synced here."""
+        from ..table.schema import hash_partition_key
+
+        data = self.garage.block_ref_table.data
+        prefix = bytes(hash_partition_key(bytes(member)))
+        for k, _raw in data.store.items(prefix, None):
+            return k[:32] == prefix
+        return False
+
+    async def wait_for_work(self) -> None:
+        delay = max(1.0, self._next_pass - time.monotonic())
+        await asyncio.sleep(min(delay, 30.0))
 
 
 def make_parity_reconstructor(garage):

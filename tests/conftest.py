@@ -64,3 +64,10 @@ def _cpu_codec_default(d, *a, **kw):
 
 
 _gconf.config_from_dict = _cpu_codec_default
+
+# Parity GC grace shields live blocks from in-flight insert-queue refs;
+# real clusters wait 5 s, but in-process tests would spend that wall-
+# clock on every deletion.  0.3 s still exercises the re-check path.
+import garage_tpu.model.parity_repair as _gpr  # noqa: E402
+
+_gpr.PARITY_GC_GRACE_S = 0.3

@@ -868,3 +868,159 @@ async def test_distributed_parity_gc_on_member_deletion(tmp_path):
     assert all([not (await live_entries(h)) for h in hs]), \
         "index rows must tombstone after object deletion"
     await shutdown(garages)
+
+
+async def test_parity_survives_layout_offload(tmp_path):
+    """Regression (advisor r3, high): a layout change makes nodes offload
+    parity_index partitions they no longer own — table/sync.py offload
+    ends in delete_if_equal → updated(old, None), a PHYSICAL removal.
+    The index hook must not treat it as logical deletion: doing so queued
+    sticky-deleted BlockRefs for every parity shard, decref'ing live
+    parity blocks cluster-wide and permanently stripping erasure
+    coverage of blocks that still exist."""
+    import os
+
+    from garage_tpu.model.parity_index_table import is_parity_ref
+    from garage_tpu.rpc.layout import ClusterLayout
+
+    garages = await make_ec_cluster(tmp_path, 5)
+    try:
+        datas = [os.urandom(18_000 + 53 * i) for i in range(12)]
+        hs = [blake2s_sum(d) for d in datas]
+        bucket_id = gen_uuid()
+        vu = gen_uuid()
+        ver = Version.new(vu, bytes(bucket_id), "offload-obj")
+        for off, (h, d) in enumerate(zip(hs, datas)):
+            await garages[0].block_manager.rpc_put_block(h, d)
+            ver.add_block(0, off, bytes(h), len(d))
+        await garages[0].version_table.insert(ver)
+
+        async def live_entries(g, h):
+            ents = await g.parity_index_table.get_range(bytes(h), None)
+            return [e for e in ents if not e.is_tombstone()]
+
+        entries = {}
+        for _ in range(400):
+            entries = {}
+            for h in hs:
+                live = await live_entries(garages[0], h)
+                if live:
+                    entries[bytes(h)] = live[0]
+            if len(entries) == len(hs):
+                break
+            await asyncio.sleep(0.05)
+        assert len(entries) == len(hs), "write-time parity never distributed"
+
+        # layout change: the LAST node leaves the cluster; its syncer must
+        # offload every partition it held (incl. parity_index rows) and
+        # delete them locally — the updated(old, None) storm under test
+        leaver = garages[-1]
+        lay = ClusterLayout.decode(garages[0].system.layout.encode())
+        lay.stage_role(bytes(leaver.system.id), None)
+        lay.apply_staged_changes()
+        enc = lay.encode()
+        for g in garages:
+            g.system.layout = ClusterLayout.decode(enc)
+            g.system._rebuild_ring()
+
+        for _ in range(400):
+            left = len(list(
+                leaver.parity_index_table.data.store.items(b"", None)))
+            if left == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert left == 0, f"{left} index rows still on removed node"
+        # give queued block_ref inserts (the bug's vehicle) time to drain
+        await asyncio.sleep(1.0)
+
+        # 1. no parity block-ref was tombstoned anywhere
+        survivors = garages[:-1]
+        for g in survivors + [leaver]:
+            data = g.block_ref_table.data
+            for _k, raw in data.store.items(b"", None):
+                br = data.decode_entry(raw)
+                if is_parity_ref(br.version):
+                    assert not br.deleted.value, (
+                        "parity shard ref tombstoned by physical offload "
+                        f"on {bytes(g.system.id).hex()[:8]}")
+        # 2. index rows are still live cluster-wide
+        for h in hs:
+            assert await live_entries(survivors[0], h), \
+                "parity coverage lost after layout offload"
+        # 3. every parity shard still exists SOMEWHERE (migration to the
+        # new ring placement may still be in flight — what matters is
+        # that no shard was GC'd; the buggy decref marked them Deletable)
+        seen_ph = set()
+        for ent in entries.values():
+            for ph in ent.parity_hashes:
+                seen_ph.add(bytes(ph))
+        for ph in seen_ph:
+            assert any(
+                g.block_manager.is_block_present(Hash(ph))
+                for g in survivors + [leaver]
+            ), f"parity shard {ph.hex()[:12]} vanished after offload"
+    finally:
+        await shutdown(garages)
+
+
+async def test_parity_gc_sweeper_reclaims_lost_events(tmp_path):
+    """The ref-drop GC trigger is one-shot; if it is lost (node down,
+    quorum read failed mid-check) the codeword would leak forever.  The
+    ParityGcSweeper walks local index rows and reclaims dead codewords
+    convergently.  Simulate a lost event by disabling the trigger before
+    the deletion, then drive the sweeper directly."""
+    import os
+
+    from garage_tpu.model.parity_repair import ParityGcSweeper
+    from garage_tpu.utils.background import WorkerState
+
+    garages = await make_ec_cluster(tmp_path, 3)
+    try:
+        # lose every ref-drop event from here on
+        for g in garages:
+            g.block_ref_table.data.schema.on_ref_dropped = None
+
+        datas = [os.urandom(15_000 + 11 * i) for i in range(8)]
+        hs = [blake2s_sum(d) for d in datas]
+        bucket_id = gen_uuid()
+        vu = gen_uuid()
+        ver = Version.new(vu, bytes(bucket_id), "sweep-obj")
+        for off, (h, d) in enumerate(zip(hs, datas)):
+            await garages[0].block_manager.rpc_put_block(h, d)
+            ver.add_block(0, off, bytes(h), len(d))
+        await garages[0].version_table.insert(ver)
+
+        async def live_entries(h):
+            ents = await garages[0].parity_index_table.get_range(
+                bytes(h), None)
+            return [e for e in ents if not e.is_tombstone()]
+
+        for _ in range(400):
+            if all([await live_entries(h) for h in hs]):
+                break
+            await asyncio.sleep(0.05)
+        assert all([await live_entries(h) for h in hs])
+
+        # delete the object; with the trigger disabled the index rows
+        # must survive (the leak under test)
+        await garages[0].version_table.insert(
+            Version.new(vu, bytes(bucket_id), "sweep-obj", deleted=True))
+        await asyncio.sleep(1.5)
+        assert any([await live_entries(h) for h in hs]), \
+            "rows tombstoned without the trigger — test setup is wrong"
+
+        # the sweeper reclaims them (age gate dropped for the test)
+        for g in garages:
+            sw = ParityGcSweeper(g)
+            sw.MIN_AGE_MS = 0
+            for _ in range(50):
+                if await sw.work() == WorkerState.IDLE:
+                    break
+        for _ in range(100):
+            if all([not (await live_entries(h)) for h in hs]):
+                break
+            await asyncio.sleep(0.05)
+        assert all([not (await live_entries(h)) for h in hs]), \
+            "sweeper did not reclaim dead codewords"
+    finally:
+        await shutdown(garages)
