@@ -260,7 +260,7 @@ class AdminRpcHandler:
             raise GarageError(f"no such alias {name!r}")
         bid = alias.bucket_id()
         b = await self.helper.get_existing_bucket(bid)
-        if len([1 for _n, l in b.params().aliases.items.items() if l.value]) <= 1:
+        if self.helper.bucket_name_count(b) <= 1:
             raise GarageError("cannot remove the last alias of a bucket")
         b.params().aliases.update(name, False)
         alias.state.update(None)

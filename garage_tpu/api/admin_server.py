@@ -36,6 +36,8 @@ class AdminApiServer:
         async def bad_request_guard(request, handler):
             """Malformed admin requests (missing required query params,
             invalid JSON bodies) render as 400 JSON, not bare 500s."""
+            from ..utils.error import GarageError
+
             try:
                 return await handler(request)
             except web.HTTPException:
@@ -44,6 +46,11 @@ class AdminApiServer:
                 return web.json_response(
                     {"error": f"bad request: {e!r}"}, status=400
                 )
+            except GarageError as e:
+                # domain errors raised by handlers that bypass _rpc_json
+                # (e.g. NoSuchBucket from a direct helper call) must
+                # render as JSON 400s like every other admin error
+                return web.json_response({"error": str(e)}, status=400)
 
         app = web.Application(middlewares=[bad_request_guard])
         app.router.add_get("/health", self.handle_health)
@@ -68,7 +75,38 @@ class AdminApiServer:
         app.router.add_post("/v1/key", self.handle_key_post)
         app.router.add_post("/v1/key/import", self.handle_key_import)
         app.router.add_delete("/v1/key", self.handle_key_delete)
+        app.router.add_put("/v1/bucket/alias/local", self.handle_alias_local)
+        app.router.add_delete(
+            "/v1/bucket/alias/local", self.handle_unalias_local)
         app.router.add_get("/check", self.handle_check_domain)
+        # v0 compat surface (ref api/admin/router_v0.rs:88-122): thin
+        # aliases onto the v1 handlers — upstream v0 and v1 share their
+        # request/response shapes for these routes (key.rs serves both);
+        # the one behavioral difference is GetKeyInfo's secret default
+        # (v0 always returned it; handle_key_get_v0 restores that).
+        app.router.add_get("/v0/status", self.handle_status)
+        app.router.add_get("/v0/health", self.handle_health_detailed)
+        app.router.add_post("/v0/connect", self.handle_connect)
+        app.router.add_get("/v0/layout", self.handle_layout_get)
+        app.router.add_post("/v0/layout", self.handle_layout_update)
+        app.router.add_post("/v0/layout/apply", self.handle_layout_apply)
+        app.router.add_post("/v0/layout/revert", self.handle_layout_revert)
+        app.router.add_get("/v0/bucket", self.handle_bucket_get)
+        app.router.add_post("/v0/bucket", self.handle_bucket_create)
+        app.router.add_delete("/v0/bucket", self.handle_bucket_delete)
+        app.router.add_put("/v0/bucket", self.handle_bucket_update)
+        app.router.add_post("/v0/bucket/allow", self.handle_bucket_allow)
+        app.router.add_post("/v0/bucket/deny", self.handle_bucket_deny)
+        app.router.add_put("/v0/bucket/alias/global", self.handle_alias_global)
+        app.router.add_delete(
+            "/v0/bucket/alias/global", self.handle_unalias_global)
+        app.router.add_put("/v0/bucket/alias/local", self.handle_alias_local)
+        app.router.add_delete(
+            "/v0/bucket/alias/local", self.handle_unalias_local)
+        app.router.add_get("/v0/key", self.handle_key_get_v0)
+        app.router.add_post("/v0/key", self.handle_key_post)
+        app.router.add_post("/v0/key/import", self.handle_key_import)
+        app.router.add_delete("/v0/key", self.handle_key_delete)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         self._site = await start_site(self._runner, bind_addr)
@@ -336,6 +374,79 @@ class AdminApiServer:
         self._admin(request)
         return await self._rpc_json(self._rpc._cmd_bucket_unalias, {
             "alias": request.query["alias"],
+        })
+
+    async def handle_alias_local(self, request) -> web.Response:
+        """PUT /v{0,1}/bucket/alias/local?id&accessKeyId&alias — a bucket
+        name visible only through one access key (ref router_v0.rs:121,
+        bucket_alias semantics in the bucket/key tables)."""
+        self._admin(request)
+        from ..utils.data import Uuid
+
+        bid = bytes.fromhex(request.query["id"])
+        kid = request.query["accessKeyId"]
+        alias = request.query["alias"]
+        helper = self.garage.helper()
+        b = await helper.get_existing_bucket(Uuid(bid))
+        key = await self.garage.key_table.get(kid, "")
+        if key is None or key.is_deleted():
+            return web.json_response(
+                {"error": f"no such key {kid!r}"}, status=404)
+        # refuse to repoint an in-use alias (mirror of the global-alias
+        # guard): silently moving it would strand the old bucket's
+        # local_aliases entry, inflating its name count past the
+        # last-alias guard and making the stale entry undeletable
+        cur = key.params().local_aliases.get(alias)
+        if cur is not None and bytes(cur) != bytes(b.id):
+            return web.json_response(
+                {"error": f"alias {alias!r} already in use by this key "
+                          "for another bucket"}, status=400)
+        key.params().local_aliases.update(alias, bytes(b.id))
+        b.params().local_aliases.update((kid, alias), True)
+        await self.garage.key_table.insert(key)
+        await self.garage.bucket_table.insert(b)
+        return web.json_response({"ok": True})
+
+    async def handle_unalias_local(self, request) -> web.Response:
+        self._admin(request)
+        from ..utils.data import Uuid
+
+        bid = bytes.fromhex(request.query["id"])
+        kid = request.query["accessKeyId"]
+        alias = request.query["alias"]
+        helper = self.garage.helper()
+        b = await helper.get_existing_bucket(Uuid(bid))
+        key = await self.garage.key_table.get(kid, "")
+        if key is None or key.is_deleted():
+            return web.json_response(
+                {"error": f"no such key {kid!r}"}, status=404)
+        cur = key.params().local_aliases.get(alias)
+        if cur is None or bytes(cur) != bytes(b.id):
+            return web.json_response(
+                {"error": f"key has no local alias {alias!r} for this "
+                          "bucket"}, status=400)
+        # refuse to strip the bucket's last name (same rule as global
+        # unalias: an unreachable bucket is an operator trap)
+        if helper.bucket_name_count(b) <= 1:
+            return web.json_response(
+                {"error": "cannot remove the last alias of a bucket"},
+                status=400)
+        key.params().local_aliases.update(alias, None)
+        b.params().local_aliases.update((kid, alias), False)
+        await self.garage.key_table.insert(key)
+        await self.garage.bucket_table.insert(b)
+        return web.json_response({"ok": True})
+
+    async def handle_key_get_v0(self, request) -> web.Response:
+        """v0 GetKeyInfo always returned the secret key (v1 gates it
+        behind showSecretKey=true; ref router_v0.rs:101-102)."""
+        self._admin(request)
+        kid = request.query.get("id")
+        search = request.query.get("search")
+        if kid is None and search is None:
+            return await self.handle_key_list(request)
+        return await self._rpc_json(self._rpc._cmd_key_info, {
+            "key": kid or search, "show_secret": True,
         })
 
     async def handle_key_get(self, request) -> web.Response:

@@ -489,6 +489,83 @@ class BlockManager:
                 and not self.is_block_present(h)
                 and self.is_assigned(h))
 
+    async def sweep_get_block(self, h: Hash,
+                              try_ring: bool = True) -> Optional[bytes]:
+        """Migration-aware block fetch: own store → ring placement →
+        EVERY other alive peer.  Returns verified plain bytes or None.
+
+        After an abrupt layout change the sole copy of a block (data
+        replication "none") can sit on a node the NEW ring no longer
+        lists for it, while the holder's rc is still positive (its
+        block_ref partition hasn't offloaded yet) so the holder won't
+        push either — the ring fetch alone would deadlock availability
+        until the metadata migration completes.  The reference sidesteps
+        this by draining removed nodes before they leave; here layout
+        changes are instant and the PULLER does the finding.  O(cluster)
+        worst case — callers are repair paths, where completeness beats
+        elegance.  Liveness ORDERS the sweep (likely-up peers first) but
+        never vetoes it: is_up is a stale hint, and skipping a reachable
+        holder turns recoverable data into loss."""
+        from ..utils.data import block_hash
+
+        raw = None
+        if self.is_block_present(h):
+            try:
+                block = await self.read_block(h)
+                raw = await asyncio.to_thread(block.decompressed)
+            except Exception:
+                raw = None
+        if raw is not None and bytes(
+                block_hash(raw, self.hash_algo)) == bytes(h):
+            return raw
+        raw = None
+        try:
+            if not try_ring:
+                # caller just failed a full ring fetch (resync fallback);
+                # re-paying that timeout chain per missing block would
+                # double degraded-repair latency
+                raise GarageError("ring fetch skipped by caller")
+            raw = await self.rpc_get_block(h)
+        except Exception as ring_err:
+            ring_nodes = {bytes(x) for x in self.replication.read_nodes(h)}
+            tried = []
+            peers = sorted(
+                self.system.peering.peers.items(),
+                key=lambda kv: not kv[1].is_up,
+            )
+            for nid, _st in peers:
+                if bytes(nid) in ring_nodes:
+                    continue
+                try:
+                    resp, stream = await self.endpoint.call_streaming(
+                        nid, {"t": "get_block", "h": bytes(h)},
+                        timeout=30.0,
+                    )
+                    if resp.get("err") or stream is None:
+                        tried.append(f"{bytes(nid).hex()[:8]}:miss")
+                        continue
+                    from .block import DataBlock, DataBlockHeader
+
+                    hdr = DataBlockHeader.unpack(resp["hdr"])
+                    raw = DataBlock(
+                        await stream.read_all(),
+                        hdr.compressed).decompressed()
+                    break
+                except Exception as e:
+                    tried.append(f"{bytes(nid).hex()[:8]}:{type(e).__name__}")
+                    continue
+            if raw is None:
+                logger.info(
+                    "sweep fetch of %s failed everywhere: ring=%s; "
+                    "sweep=%s", bytes(h).hex()[:12], ring_err, tried)
+        if raw is None:
+            return None
+        if bytes(block_hash(raw, self.hash_algo)) != bytes(h):
+            logger.warning("sweep fetch of %s: hash mismatch",
+                           bytes(h).hex()[:12])
+            return None
+        return raw
+
     async def drop_stray_copy(self, h: Hash) -> None:
         """Physically delete a local copy this node is NOT assigned —
         migration cleanup, called by resync only after every assigned

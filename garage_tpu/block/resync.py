@@ -284,23 +284,35 @@ class BlockResyncManager:
             try:
                 block = await mgr.rpc_get_raw_block(h, for_storage=True)
             except Exception:
-                # every replica is unreachable or damaged: last line of
-                # defense is DISTRIBUTED parity — fetch ≥ k surviving
-                # codeword pieces from across the cluster and decode the
-                # missing row (survives whole-node loss, which local
-                # sidecars cannot; the reference's only answer here is
-                # replication, resync.rs:457-468)
-                if mgr.parity_reconstructor is None:
-                    raise
-                data = await mgr.parity_reconstructor(h)
+                # Replicas unreachable or damaged.  Next: the
+                # migration-aware peer sweep — after an abrupt layout
+                # change the sole copy can sit on a node outside the new
+                # ring whose rc hasn't migrated yet (so it won't push,
+                # and the ring fetch above can't see it); the puller
+                # must find it (sweep_get_block docstring).  Last line:
+                # DISTRIBUTED parity — fetch ≥ k surviving codeword
+                # pieces cluster-wide and decode the missing row
+                # (survives whole-node loss, which neither fetch can;
+                # the reference's only answer here is replication,
+                # resync.rs:457-468).
+                data = await mgr.sweep_get_block(h, try_ring=False)
+                swept = data is not None
+                if data is None:
+                    if mgr.parity_reconstructor is None:
+                        raise
+                    data = await mgr.parity_reconstructor(h)
                 if data is None:
                     raise
                 from .block import DataBlock
 
                 await mgr.write_block(h, DataBlock.plain(data))
-                mgr.blocks_reconstructed += 1
-                logger.info("reconstructed block %s from DISTRIBUTED parity",
-                            bytes(h).hex()[:16])
+                if swept:
+                    logger.info("fetched displaced block %s via peer "
+                                "sweep", bytes(h).hex()[:16])
+                else:
+                    mgr.blocks_reconstructed += 1
+                    logger.info("reconstructed block %s from DISTRIBUTED "
+                                "parity", bytes(h).hex()[:16])
                 return
             await mgr.write_block(h, block, is_parity=block.parity)
             logger.info("resynced missing block %s", bytes(h).hex()[:16])

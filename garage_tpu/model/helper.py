@@ -149,7 +149,10 @@ class GarageHelper:
         return key
 
     async def delete_key(self, key: Key) -> None:
-        """Revoke from all buckets then tombstone (ref helper/key.rs)."""
+        """Revoke from all buckets then tombstone (ref helper/key.rs).
+        Also clears the bucket-side (key_id, alias) local-alias mirrors:
+        a stale mirror inflates bucket_name_count and lets the last-alias
+        guard approve removing a bucket's last USABLE name."""
         params = key.params()
         if params is not None:
             for bid in list(params.authorized_buckets.items.keys()):
@@ -159,10 +162,31 @@ class GarageHelper:
                         key.key_id, BucketKeyPerm()
                     )
                     await self.garage.bucket_table.insert(bucket)
+            for alias, lww in list(params.local_aliases.items.items()):
+                if not lww.value:
+                    continue
+                bucket = await self.garage.bucket_table.get(
+                    Uuid(lww.value), "")
+                if bucket is not None and not bucket.is_deleted():
+                    bucket.params().local_aliases.update(
+                        (key.key_id, alias), False)
+                    await self.garage.bucket_table.insert(bucket)
         from ..utils.crdt import Deletable
 
         key.state = Deletable.delete()
         await self.garage.key_table.insert(key)
+
+    @staticmethod
+    def bucket_name_count(bucket: Bucket) -> int:
+        """How many live names (global + key-local aliases) the bucket
+        has — the single source for every last-alias guard (HTTP admin
+        and RPC admin must enforce the same invariant)."""
+        p = bucket.params()
+        return sum(
+            1 for _n, l in p.aliases.items.items() if l.value
+        ) + sum(
+            1 for _k, l in p.local_aliases.items.items() if l.value
+        )
 
     async def list_buckets(self, limit: int = 1000) -> List[Bucket]:
         """All non-deleted buckets (full-copy table → local range reads,

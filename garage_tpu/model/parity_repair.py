@@ -313,72 +313,10 @@ def make_parity_reconstructor(garage):
 
 async def _fetch_verified(garage, mh: bytes) -> Optional[bytes]:
     """A codeword piece (member or parity block), verified against its
-    content hash.  Tries the ring placement first; if that misses —
-    mid-migration after a layout change, the piece may still sit on a
-    node the NEW ring no longer lists for it — falls back to asking
-    every other alive peer.  O(cluster) worst case, but this only runs
-    during disaster repair, where completeness beats elegance."""
-    mgr = garage.block_manager
-    h = Hash(mh)
-    raw = None
-    # the repairing node's OWN store first: after a layout change the
-    # new ring may route a piece elsewhere while this node still holds
-    # the only live copy (observed: repair stalled on pieces sitting in
-    # the repairer's own block dir)
-    if mgr.is_block_present(h):
-        try:
-            block = await mgr.read_block(h)
-            raw = await asyncio.to_thread(block.decompressed)
-        except Exception:
-            raw = None
-    if raw is not None:
-        if bytes(block_hash(raw, mgr.hash_algo)) == bytes(mh):
-            return raw
-        raw = None
-    try:
-        raw = await mgr.rpc_get_block(h)
-    except Exception as ring_err:
-        ring_nodes = {bytes(x) for x in mgr.replication.read_nodes(h)}
-        tried = []
-        # liveness ORDERS the sweep (likely-up peers first) but never
-        # vetoes it: is_up is a stale hint (ping cadence), and skipping a
-        # reachable holder during disaster repair turns a recoverable
-        # codeword into data loss — a dead peer just fails fast instead
-        peers = sorted(
-            garage.system.peering.peers.items(),
-            key=lambda kv: not kv[1].is_up,
-        )
-        for nid, st in peers:
-            if bytes(nid) in ring_nodes:
-                continue
-            try:
-                resp, stream = await mgr.endpoint.call_streaming(
-                    nid, {"t": "get_block", "h": bytes(h)},
-                    timeout=30.0,
-                )
-                if resp.get("err") or stream is None:
-                    tried.append(f"{bytes(nid).hex()[:8]}:miss")
-                    continue
-                from ..block.block import DataBlock, DataBlockHeader
-
-                hdr = DataBlockHeader.unpack(resp["hdr"])
-                raw = DataBlock(
-                    await stream.read_all(), hdr.compressed).decompressed()
-                break
-            except Exception as e:
-                tried.append(f"{bytes(nid).hex()[:8]}:{type(e).__name__}")
-                continue
-        if raw is None:
-            logger.info(
-                "repair fetch of piece %s failed everywhere: ring=%s; "
-                "sweep=%s", bytes(mh).hex()[:12], ring_err, tried)
-    if raw is None:
-        return None
-    if bytes(block_hash(raw, mgr.hash_algo)) != bytes(mh):
-        logger.warning("repair fetch of piece %s: hash mismatch",
-                       bytes(mh).hex()[:12])
-        return None
-    return raw
+    content hash — own store → ring placement → every alive peer (the
+    migration-aware sweep lives on the block manager, shared with the
+    resync fallback chain: block/manager.py sweep_get_block)."""
+    return await garage.block_manager.sweep_get_block(Hash(mh))
 
 
 async def _try_codeword(garage, h: Hash, ent) -> Optional[bytes]:

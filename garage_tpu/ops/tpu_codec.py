@@ -153,6 +153,24 @@ def scrub_step_kernel(data_u8, lengths, expected, K_enc, k: int):
 # --- codec ------------------------------------------------------------------
 
 
+# Pallas demotion policy: errors matching these markers mean the backend
+# simply cannot run Mosaic kernels — retrying is pointless.  Anything
+# else (tunnel UNAVAILABLE, DEADLINE_EXCEEDED, connection reset) is
+# transient and only demotes after this many CONSECUTIVE failures.
+PALLAS_MAX_TRANSIENT_FAILS = 5
+_PALLAS_PERMANENT_MARKERS = (
+    "mosaic", "not implemented", "unimplemented", "unsupported",
+    "no registered", "cannot lower", "interpret mode",
+)
+
+
+def _pallas_error_is_permanent(e: BaseException) -> bool:
+    msg = f"{type(e).__name__}: {e}".lower()
+    if isinstance(e, NotImplementedError):
+        return True
+    return any(s in msg for s in _PALLAS_PERMANENT_MARKERS)
+
+
 class TpuCodec(BlockCodec):
     def __init__(self, params: CodecParams, devices: Optional[list] = None):
         super().__init__(params)
@@ -172,6 +190,7 @@ class TpuCodec(BlockCodec):
         # permanently falls back to the XLA kernel.
         self._pallas_cache = {}
         self._pallas_ok = True
+        self._pallas_transient_fails = 0
         self.mesh = None
         if params.shard_mesh > 1:
             devs = (devices or jax.devices())[: params.shard_mesh]
@@ -324,14 +343,39 @@ class TpuCodec(BlockCodec):
             if pg is not None:
                 try:
                     out = u32_view_bytes(pg(u32))
+                    self._pallas_transient_fails = 0
                     return np.asarray(out)[..., :s]
-                except Exception:
+                except Exception as e:
                     import logging
 
-                    logging.getLogger("garage_tpu.ops").warning(
-                        "pallas GF kernel unavailable on this backend; "
-                        "using the XLA kernel", exc_info=True)
-                    self._pallas_ok = False
+                    log = logging.getLogger("garage_tpu.ops")
+                    # Latch OFF only for errors that cannot heal: a
+                    # backend without Mosaic support will never grow it,
+                    # but a flaky tunnel (UNAVAILABLE / DEADLINE / RESET)
+                    # recovers — permanently demoting the north-star
+                    # kernel on one transient hiccup wasted the rest of
+                    # the process lifetime (advisor r3 / VERDICT #8).
+                    if _pallas_error_is_permanent(e):
+                        log.warning(
+                            "pallas GF kernel unsupported on this backend "
+                            "(permanent); using the XLA kernel",
+                            exc_info=True)
+                        self._pallas_ok = False
+                    else:
+                        self._pallas_transient_fails += 1
+                        if (self._pallas_transient_fails
+                                >= PALLAS_MAX_TRANSIENT_FAILS):
+                            log.warning(
+                                "pallas GF kernel failed %d consecutive "
+                                "times; demoting to the XLA kernel",
+                                self._pallas_transient_fails, exc_info=True)
+                            self._pallas_ok = False
+                        else:
+                            log.warning(
+                                "pallas GF kernel transient failure "
+                                "(%d/%d); will retry",
+                                self._pallas_transient_fails,
+                                PALLAS_MAX_TRANSIENT_FAILS, exc_info=True)
         out = u32_view_bytes(self._gf_jit(u32, K))
         return np.asarray(out)[..., :s]
 

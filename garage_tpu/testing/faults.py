@@ -1,0 +1,143 @@
+"""Reusable fault injector (VERDICT r3 #7; SURVEY §5 aux subsystem).
+
+The reference validates durability claims with cluster benchmarks under
+"2 simulated node failures" (ref doc/book/design/benchmarks) but ships
+no reusable rig; here the rig is in-tree: one object that can crash and
+revive nodes of an in-process cluster and drop/corrupt chosen blocks on
+disk, used by tests (generalizing the ad-hoc node kills in
+tests/test_integration.py) and by bench.py's degraded-mode phase.
+
+Crash semantics: `crash()` is abrupt — transport closed and workers
+cancelled with NO graceful drains (a dying node doesn't flush its
+write-time parity accumulator).  `revive()` rebuilds a Garage from the
+same config/dirs, the crash-consistency path real restarts take —
+meaningful only for persistent db engines (sqlite/native), not
+"memory".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..utils.data import Hash
+
+
+class FaultInjector:
+    """Faults over a list of in-process Garage nodes."""
+
+    def __init__(self, garages: List, configs: Optional[List] = None):
+        self.garages = list(garages)
+        self.configs = list(configs) if configs else [
+            g.config for g in garages]
+        self.dead: set = set()
+
+    # --- node faults ---
+
+    async def crash(self, i: int) -> None:
+        """Abrupt node death: close the transport and cancel workers,
+        skipping every graceful-drain step of Garage.shutdown()."""
+        g = self.garages[i]
+        await g.bg.shutdown(timeout=0.5)
+        await g.system.shutdown()
+        if g._owns_db:
+            g.db.close()
+        self.dead.add(i)
+
+    async def revive(self, i: int, peers: Optional[List[str]] = None):
+        """Restart node i from its on-disk state; returns the new Garage.
+        `peers` = "host:port" addresses to reconnect to (defaults to the
+        rpc_public_addr of every live node)."""
+        from ..model import Garage
+
+        assert i in self.dead, f"node {i} is not dead"
+        g = Garage(self.configs[i])
+        await g.system.netapp.listen(self.configs[i].rpc_bind_addr)
+        port = g.system.netapp._server.sockets[0].getsockname()[1]
+        g.config.rpc_public_addr = f"127.0.0.1:{port}"
+        if peers is None:
+            peers = [
+                self.garages[j].config.rpc_public_addr
+                for j in range(len(self.garages))
+                if j != i and j not in self.dead
+            ]
+        for addr in peers:
+            try:
+                await g.system.netapp.connect(addr)
+            except Exception:
+                pass  # peer may be down; the peering loop keeps trying
+        for j, other in enumerate(self.garages):
+            if j != i and j not in self.dead:
+                other.system.peering.add_peer(
+                    g.config.rpc_public_addr, g.system.id)
+                g.system.peering.add_peer(
+                    other.config.rpc_public_addr, other.system.id)
+        # adopt the cluster's layout from any live node
+        for j, other in enumerate(self.garages):
+            if j != i and j not in self.dead:
+                from ..rpc.layout import ClusterLayout
+
+                g.system.layout = ClusterLayout.decode(
+                    other.system.layout.encode())
+                g.system._rebuild_ring()
+                break
+        g.spawn_workers()
+        g.system.peering.start()
+        self.garages[i] = g
+        self.dead.discard(i)
+        return g
+
+    # --- block faults ---
+
+    def _block_files(self, i: int) -> List[str]:
+        dd = self.configs[i].data_dir  # [{"path": ..., ...}, ...]
+        roots = [d["path"] if isinstance(d, dict) else str(d) for d in dd] \
+            if isinstance(dd, list) else [str(dd)]
+        out = []
+        for root in roots:
+            for dirpath, _dirs, files in os.walk(root):
+                if "parity" in dirpath.split(os.sep):
+                    continue
+                for f in files:
+                    if not f.endswith((".par", ".tmp", ".corrupted")):
+                        out.append(os.path.join(dirpath, f))
+        return out
+
+    def list_blocks(self, i: int) -> List[Hash]:
+        out = []
+        for p in self._block_files(i):
+            name = os.path.basename(p).split(".")[0]
+            try:
+                out.append(Hash(bytes.fromhex(name)))
+            except ValueError:
+                continue
+        return out
+
+    def _find(self, i: int, h: Hash) -> Optional[str]:
+        want = bytes(h).hex()
+        for p in self._block_files(i):
+            if os.path.basename(p).startswith(want):
+                return p
+        return None
+
+    def drop_block(self, i: int, h: Hash) -> bool:
+        """Silently delete a block file (disk losing data without the
+        node noticing — the scrub/resync machinery must detect it)."""
+        p = self._find(i, h)
+        if p is None:
+            return False
+        os.remove(p)
+        return True
+
+    def corrupt_block(self, i: int, h: Hash, at: int = 100) -> bool:
+        """Flip one byte of a stored block (silent bitrot; scrub must
+        catch it by content hash, never serve it)."""
+        p = self._find(i, h)
+        if p is None:
+            return False
+        with open(p, "r+b") as f:
+            f.seek(at)
+            b = f.read(1)
+            f.seek(at)
+            f.write(bytes([(b[0] if b else 0) ^ 0xFF]))
+        return True

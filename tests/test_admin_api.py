@@ -308,3 +308,79 @@ async def test_bucket_cleanup_incomplete_uploads(tmp_path):
             {"buckets": ["nope"], "older_than": "1h"})
     await srv.stop()
     await g.shutdown()
+
+
+async def test_admin_v0_compat_and_local_alias(tmp_path):
+    """v0 compat routes (ref api/admin/router_v0.rs:88-122) are thin
+    aliases onto the v1 handlers, with v0's always-show-secret GetKeyInfo
+    default; plus the local bucket alias endpoints on both versions."""
+    g, srv = await make_admin(tmp_path)
+    try:
+        c = AdminClient(srv.port)
+
+        # v0 status/health/layout answer like v1
+        st, body = await c.req("GET", "/v0/status")
+        assert st == 200 and "node" in json.dumps(body).lower()
+        st, body = await c.req("GET", "/v0/health")
+        assert st == 200 and body["status"] in ("healthy", "degraded")
+        st, body = await c.req("GET", "/v0/layout")
+        assert st == 200
+
+        # create a key + bucket through v0
+        st, key = await c.req("POST", "/v0/key", body={"name": "v0key"})
+        assert st == 200, key
+        kid = key["accessKeyId"]
+        # v0 GetKeyInfo returns the secret WITHOUT showSecretKey=true
+        st, info = await c.req("GET", "/v0/key", query={"id": kid})
+        assert st == 200 and info.get("secret"), info
+        # v1 hides it by default
+        st, info1 = await c.req("GET", "/v1/key", query={"id": kid})
+        assert st == 200 and not info1.get("secret")
+
+        st, bkt = await c.req("POST", "/v0/bucket",
+                              body={"globalAlias": "v0bkt"})
+        assert st == 200, bkt
+        bid = bkt["id"]
+
+        # local alias: only visible through this key
+        st, r = await c.req(
+            "PUT", "/v0/bucket/alias/local",
+            query={"id": bid, "accessKeyId": kid, "alias": "mylocal"})
+        assert st == 200, r
+        key_row = await g.key_table.get(kid, "")
+        assert bytes(key_row.params().local_aliases.get("mylocal")) == \
+            bytes.fromhex(bid)
+        b_row = await g.bucket_table.get(bytes.fromhex(bid), "")
+        assert b_row.params().local_aliases.get((kid, "mylocal")) is True
+
+        # resolution through the helper (the S3 path's view)
+        resolved = await g.helper().resolve_bucket("mylocal", key_row)
+        assert bytes(resolved) == bytes.fromhex(bid)
+
+        # dropping the GLOBAL alias is refused only when it is the last
+        # name; with the local alias present it succeeds
+        st, r = await c.req(
+            "DELETE", "/v0/bucket/alias/global",
+            query={"id": bid, "alias": "v0bkt"})
+        assert st == 200, r
+
+        # now the local alias is the last name → refuse
+        st, r = await c.req(
+            "DELETE", "/v0/bucket/alias/local",
+            query={"id": bid, "accessKeyId": kid, "alias": "mylocal"})
+        assert st == 400 and "last alias" in json.dumps(r)
+
+        # re-add a global name, then local unalias works
+        st, r = await c.req(
+            "PUT", "/v0/bucket/alias/global",
+            query={"id": bid, "alias": "v0bkt2"})
+        assert st == 200, r
+        st, r = await c.req(
+            "DELETE", "/v0/bucket/alias/local",
+            query={"id": bid, "accessKeyId": kid, "alias": "mylocal"})
+        assert st == 200, r
+        key_row = await g.key_table.get(kid, "")
+        assert key_row.params().local_aliases.get("mylocal") is None
+    finally:
+        await srv.stop()
+        await g.shutdown()

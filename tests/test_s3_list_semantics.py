@@ -1,0 +1,294 @@
+"""The reference's full list-semantics corpus, ported onto the real API
+server (ref src/garage/tests/s3/list.rs, all 615 LoC of pagination edge
+cases): ListObjectsV2 (continuation × delimiter × prefix × start-after),
+ListObjectsV1 (marker semantics, repeated common prefixes per AWS spec),
+and ListMultipartUploads (key+upload-id marker pairs × delimiter).
+
+VERDICT r3 #6: api/s3/list.py implements all four endpoints but only a
+handful of edge cases were tested; this file is the matrix."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from test_s3_api import make_api_cluster, stop_all
+from garage_tpu.api.signature import uri_encode
+
+pytestmark = pytest.mark.asyncio
+
+# ref list.rs:3-4
+KEYS = ["a", "a/a", "a/b", "a/c", "a/d/a", "a/é", "b", "c"]
+KEYS_MULTIPART = ["a", "a", "c", "c/a", "c/b"]
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _strip_ns(root):
+    for el in root.iter():
+        if el.tag.startswith("{"):
+            el.tag = el.tag.split("}", 1)[1]
+    return root
+
+
+def parse_list(body: bytes) -> dict:
+    """Common fields of v1/v2/multipart list responses."""
+    root = _strip_ns(ET.fromstring(body))
+    out = {
+        "keys": [c.findtext("Key") for c in root.findall("Contents")],
+        "prefixes": [p.findtext("Prefix")
+                     for p in root.findall("CommonPrefixes")],
+        "uploads": [(u.findtext("Key"), u.findtext("UploadId"))
+                    for u in root.findall("Upload")],
+        "truncated": root.findtext("IsTruncated") == "true",
+        "next_token": root.findtext("NextContinuationToken"),
+        "next_marker": root.findtext("NextMarker"),
+        "next_key_marker": root.findtext("NextKeyMarker"),
+        "next_upload_id_marker": root.findtext("NextUploadIdMarker"),
+    }
+    return out
+
+
+async def _fill_bucket(client, bucket):
+    st, _h, _b = await client.req("PUT", f"/{bucket}")
+    assert st == 200
+    for k in KEYS:
+        st, _h, _b = await client.req(
+            "PUT", f"/{bucket}/{uri_encode(k, encode_slash=False)}",
+            body=b"x")
+        assert st == 200, k
+
+
+async def _list_v2(client, bucket, **q):
+    query = [(k.replace("_", "-"), v) for k, v in q.items()
+             if v is not None]
+    query.insert(0, ("list-type", "2"))
+    st, _h, body = await client.req("GET", f"/{bucket}", query=query)
+    assert st == 200, body[:300]
+    return parse_list(body)
+
+
+async def _list_v1(client, bucket, **q):
+    query = [(k.replace("_", "-"), v) for k, v in q.items()
+             if v is not None]
+    st, _h, body = await client.req("GET", f"/{bucket}", query=query)
+    assert st == 200, body[:300]
+    return parse_list(body)
+
+
+async def test_listobjectsv2_matrix(tmp_path):
+    """ref list.rs:6-220 test_listobjectsv2."""
+    garages, server, client, _key = await make_api_cluster(tmp_path)
+    try:
+        await _fill_bucket(client, "lv2")
+
+        r = await _list_v2(client, "lv2")
+        assert len(r["keys"]) == 8 and not r["prefixes"]
+
+        # max-keys=2 truncates with a continuation token
+        r = await _list_v2(client, "lv2", max_keys="2")
+        assert len(r["keys"]) == 2 and not r["prefixes"]
+        assert r["truncated"] and r["next_token"]
+
+        # page through everything one key at a time
+        cnt, nxt = 0, None
+        for i in range(len(KEYS)):
+            r = await _list_v2(client, "lv2", max_keys="1",
+                               continuation_token=nxt)
+            cnt += 1
+            nxt = r["next_token"]
+            assert len(r["keys"]) == 1 and not r["prefixes"]
+            if i != len(KEYS) - 1:
+                assert nxt
+        assert cnt == len(KEYS)
+        assert nxt is None
+
+        # delimiter folds a/* into one common prefix
+        r = await _list_v2(client, "lv2", delimiter="/")
+        assert len(r["keys"]) == 3 and len(r["prefixes"]) == 1
+
+        # delimiter × pagination: each page has exactly one key OR one
+        # prefix; totals must match (ref list.rs:104-132)
+        cnt_key = cnt_pfx = 0
+        nxt = None
+        for _ in range(len(KEYS)):
+            r = await _list_v2(client, "lv2", delimiter="/", max_keys="1",
+                               continuation_token=nxt)
+            nxt = r["next_token"]
+            if len(r["keys"]) == 1 and not r["prefixes"]:
+                cnt_key += 1
+            elif len(r["prefixes"]) == 1 and not r["keys"]:
+                cnt_pfx += 1
+            else:
+                raise AssertionError((r["keys"], r["prefixes"]))
+            if nxt is None:
+                break
+        assert cnt_key == 3 and cnt_pfx == 1
+
+        # prefix alone
+        r = await _list_v2(client, "lv2", prefix="a/")
+        assert len(r["keys"]) == 5 and not r["prefixes"]
+
+        # prefix + delimiter
+        r = await _list_v2(client, "lv2", prefix="a/", delimiter="/")
+        assert len(r["keys"]) == 4 and len(r["prefixes"]) == 1
+
+        # prefix + delimiter + max-keys → exactly "a/a"
+        r = await _list_v2(client, "lv2", prefix="a/", delimiter="/",
+                           max_keys="1")
+        assert r["keys"] == ["a/a"] and not r["prefixes"]
+
+        # start-after before all keys → everything
+        r = await _list_v2(client, "lv2", start_after="Z")
+        assert len(r["keys"]) == 8 and not r["prefixes"]
+
+        # start-after at the last key → empty
+        r = await _list_v2(client, "lv2", start_after="c")
+        assert not r["keys"] and not r["prefixes"]
+    finally:
+        await stop_all(garages, server)
+
+
+async def test_listobjectsv1_matrix(tmp_path):
+    """ref list.rs:222-433 test_listobjectsv1."""
+    garages, server, client, _key = await make_api_cluster(tmp_path)
+    try:
+        await _fill_bucket(client, "lv1")
+
+        r = await _list_v1(client, "lv1")
+        assert len(r["keys"]) == 8 and not r["prefixes"]
+
+        r = await _list_v1(client, "lv1", max_keys="2")
+        assert len(r["keys"]) == 2 and not r["prefixes"]
+        assert r["truncated"] and r["next_marker"]
+
+        # pagination by marker
+        cnt, nxt = 0, None
+        for i in range(len(KEYS)):
+            r = await _list_v1(client, "lv1", max_keys="1", marker=nxt)
+            cnt += 1
+            nxt = r["next_marker"]
+            assert len(r["keys"]) == 1 and not r["prefixes"]
+            if i != len(KEYS) - 1:
+                assert nxt
+        assert cnt == len(KEYS)
+
+        r = await _list_v1(client, "lv1", delimiter="/")
+        assert len(r["keys"]) == 3 and len(r["prefixes"]) == 1
+
+        # delimiter × pagination.  The reference has no whole-prefix
+        # skip on v1 and re-emits "a/" once per element inside it (5×,
+        # clients dedup; ref list.rs:306-341 and its comment).  This
+        # implementation rolls the prefix up ONCE and continues after it
+        # — real AWS v1 semantics (NextMarker = the rolled-up prefix) —
+        # so the invariants are: every page is exactly one key or one
+        # prefix, all 3 top-level keys arrive, the a/ prefix arrives at
+        # least once and dedups to exactly {a/}, and pagination
+        # terminates.
+        cnt_key = cnt_pfx = 0
+        seen_pfx = set()
+        nxt = None
+        for _ in range(len(KEYS)):
+            r = await _list_v1(client, "lv1", delimiter="/", max_keys="1",
+                               marker=nxt)
+            nxt = r["next_marker"]
+            if len(r["keys"]) == 1 and not r["prefixes"]:
+                cnt_key += 1
+            elif len(r["prefixes"]) == 1 and not r["keys"]:
+                cnt_pfx += 1
+                seen_pfx.add(r["prefixes"][0])
+            else:
+                raise AssertionError((r["keys"], r["prefixes"]))
+            if nxt is None:
+                break
+        assert cnt_key == 3 and cnt_pfx >= 1
+        assert seen_pfx == {"a/"}
+
+        r = await _list_v1(client, "lv1", prefix="a/")
+        assert len(r["keys"]) == 5 and not r["prefixes"]
+
+        r = await _list_v1(client, "lv1", prefix="a/", delimiter="/")
+        assert len(r["keys"]) == 4 and len(r["prefixes"]) == 1
+
+        r = await _list_v1(client, "lv1", prefix="a/", delimiter="/",
+                           max_keys="1")
+        assert r["keys"] == ["a/a"] and not r["prefixes"]
+
+        r = await _list_v1(client, "lv1", marker="Z")
+        assert len(r["keys"]) == 8 and not r["prefixes"]
+
+        r = await _list_v1(client, "lv1", marker="c")
+        assert not r["keys"] and not r["prefixes"]
+    finally:
+        await stop_all(garages, server)
+
+
+async def test_listmultipart_matrix(tmp_path):
+    """ref list.rs:435-615 test_listmultipart."""
+    garages, server, client, _key = await make_api_cluster(tmp_path)
+    try:
+        st, _h, _b = await client.req("PUT", "/lmp")
+        assert st == 200
+        for k in KEYS_MULTIPART:
+            st, _h, body = await client.req(
+                "POST", f"/lmp/{uri_encode(k, encode_slash=False)}",
+                query=[("uploads", "")])
+            assert st == 200, body[:300]
+
+        async def list_mpu(**q):
+            query = [("uploads", "")] + [
+                (k.replace("_", "-"), v) for k, v in q.items()
+                if v is not None]
+            st, _h, body = await client.req("GET", "/lmp", query=query)
+            assert st == 200, body[:300]
+            return parse_list(body)
+
+        r = await list_mpu()
+        assert len(r["uploads"]) == 5 and not r["prefixes"]
+
+        # pagination by (key-marker, upload-id-marker)
+        nxt = upnxt = None
+        for i in range(len(KEYS_MULTIPART)):
+            r = await list_mpu(max_uploads="1", key_marker=nxt,
+                               upload_id_marker=upnxt)
+            nxt = r["next_key_marker"]
+            upnxt = r["next_upload_id_marker"]
+            assert len(r["uploads"]) == 1 and not r["prefixes"]
+            if i != len(KEYS_MULTIPART) - 1:
+                assert nxt
+        # delimiter folds c/* into one prefix
+        r = await list_mpu(delimiter="/")
+        assert len(r["uploads"]) == 3 and len(r["prefixes"]) == 1
+
+        # delimiter × pagination: each page is one upload or one prefix
+        nxt = upnxt = None
+        upcnt = pfxcnt = loopcnt = 0
+        while loopcnt < len(KEYS_MULTIPART):
+            r = await list_mpu(delimiter="/", max_uploads="1",
+                               key_marker=nxt, upload_id_marker=upnxt)
+            nxt = r["next_key_marker"]
+            upnxt = r["next_upload_id_marker"]
+            loopcnt += 1
+            upcnt += len(r["uploads"])
+            pfxcnt += len(r["prefixes"])
+            if nxt is None:
+                break
+        assert upcnt + pfxcnt == loopcnt
+        assert upcnt == 3 and pfxcnt == 1
+
+        r = await list_mpu(prefix="c")
+        assert len(r["uploads"]) == 3 and not r["prefixes"]
+
+        r = await list_mpu(prefix="c", delimiter="/")
+        assert len(r["uploads"]) == 1 and len(r["prefixes"]) == 1
+
+        r = await list_mpu(prefix="c", delimiter="/", max_uploads="1")
+        assert len(r["uploads"]) == 1 and not r["prefixes"]
+
+        # marker before / after everything
+        r = await list_mpu(key_marker="ZZZZZ")
+        assert len(r["uploads"]) == 5 and not r["prefixes"]
+
+        r = await list_mpu(key_marker="d")
+        assert not r["uploads"] and not r["prefixes"]
+    finally:
+        await stop_all(garages, server)
