@@ -219,9 +219,15 @@ class TpuCodec(BlockCodec):
             self._gf_jit = jax.jit(
                 gf_apply, in_shardings=(batch, repl), out_shardings=batch
             )
+            # static k passed POSITIONALLY: pjit rejects kwargs when
+            # in_shardings is given, so static_argnums — not
+            # static_argnames — is the only shape that works on both the
+            # sharded and single-device builds (caught by the daemon-
+            # level sharded scrub test; the kwarg form compiled fine
+            # single-device and exploded only on a real mesh)
             self._scrub_jit = jax.jit(
                 scrub_step_kernel,
-                static_argnames=("k",),
+                static_argnums=(4,),
                 in_shardings=(batch, batch, batch, repl),
                 out_shardings=(batch, batch, repl, batch),
             )
@@ -229,7 +235,7 @@ class TpuCodec(BlockCodec):
             self._hash_jit = jax.jit(blake2s_batch)
             self._verify_jit = jax.jit(verify_kernel)
             self._gf_jit = jax.jit(gf_apply)
-            self._scrub_jit = jax.jit(scrub_step_kernel, static_argnames=("k",))
+            self._scrub_jit = jax.jit(scrub_step_kernel, static_argnums=(4,))
 
     # --- hashing ---
     @staticmethod
@@ -242,6 +248,15 @@ class TpuCodec(BlockCodec):
         while b < n:
             b <<= 1
         return b
+
+    def _lane_align(self) -> int:
+        """Lane-count divisor shared by _pad_group and warm_scrub: whole
+        codewords (k), and — when sharded — whole codewords per device
+        (k × mesh, so the fused kernel's parity output dim B//k divides
+        over the mesh).  One helper so the AOT-warmed shape can never
+        drift from the dispatched one."""
+        k = max(1, self.params.rs_data)
+        return k * (self.mesh.size if self.mesh is not None else 1)
 
     def _batch_size(self, n: int) -> int:
         bsz = self._bucket(n, 8)
@@ -413,7 +428,13 @@ class TpuCodec(BlockCodec):
 
         arr, lengths = self._pad_batch(blocks)
         k = self.params.rs_data
-        pad_lanes = (-arr.shape[0]) % k
+        # lanes align to k (whole codewords) AND, when sharded, to
+        # k × mesh — the fused kernel's PARITY output has leading dim
+        # B//k, and its out_sharding over the mesh needs that divisible
+        # by the device count (caught by the daemon-level sharded-scrub
+        # test: lanes alone being mesh-divisible is not enough)
+        align = self._lane_align()
+        pad_lanes = (-arr.shape[0]) % align
         if pad_lanes:
             arr = np.pad(arr, [(0, pad_lanes), (0, 0)])
             lengths = np.pad(lengths, (0, pad_lanes))
@@ -445,7 +466,7 @@ class TpuCodec(BlockCodec):
         bandwidth-metered, so warmup must not spend bytes)."""
         k = self.params.rs_data
         bsz = self._batch_size(max(nblocks, 1))
-        bsz += (-bsz) % k
+        bsz += (-bsz) % self._lane_align()
         padded = self._bucket(max(nbytes, 1))
         shapes = (
             jax.ShapeDtypeStruct((bsz, padded), jnp.uint8),
@@ -453,7 +474,7 @@ class TpuCodec(BlockCodec):
             jax.ShapeDtypeStruct((bsz, 8), jnp.uint32),
             jax.ShapeDtypeStruct(self._K_enc.shape, self._K_enc.dtype),
         )
-        self._scrub_jit.lower(*shapes, k=k).compile()
+        self._scrub_jit.lower(*shapes, k).compile()
 
     def scrub_encode_submit(self, arr: np.ndarray, lengths: np.ndarray,
                             expected: np.ndarray):
@@ -465,7 +486,7 @@ class TpuCodec(BlockCodec):
         assert arr.shape[1] % 4 == 0
         return self._scrub_jit(
             jnp.asarray(arr), jnp.asarray(lengths), jnp.asarray(expected),
-            self._K_enc, k=self.params.rs_data,
+            self._K_enc, self.params.rs_data,
         )
 
     def scrub_encode_batch(self, blocks: Sequence[bytes], hashes: Sequence[Hash],

@@ -75,3 +75,117 @@ def test_codec_shard_mesh_from_config(mesh):
 
     cpu = make_codec("cpu")
     assert np.array_equal(parity, cpu.rs_encode(data))
+
+
+async def test_daemon_scrub_end_to_end_on_sharded_codec(tmp_path):
+    """VERDICT r3 #4: codec.shard_mesh through the PRODUCT path, not the
+    codec.  A full Garage daemon configured with backend="tpu" +
+    shard_mesh=8 runs its real ScrubWorker over the virtual 8-device CPU
+    mesh: the fused verify rides the mesh-jitted executable (corruption
+    counts psum-reduced across devices), planted corruptions are found
+    exactly, the sidecar parity written by the sharded pass reconstructs
+    a lost block bit-identically, and the parity bytes equal the CPU
+    codec's RS encode of the same codeword."""
+    import asyncio
+    import os
+
+    from garage_tpu.block.repair import ScrubWorker
+    from garage_tpu.model import Garage
+    from garage_tpu.ops.codec import CodecParams
+    from garage_tpu.ops.cpu_codec import CpuCodec
+    from garage_tpu.rpc.layout import ClusterLayout, NodeRole
+    from garage_tpu.utils.config import config_from_dict
+    from garage_tpu.utils.data import Hash, blake2s_sum
+
+    g = Garage(config_from_dict({
+        "metadata_dir": str(tmp_path / "meta"),
+        "data_dir": str(tmp_path / "data"),
+        "replication_mode": "none",
+        "rpc_bind_addr": "127.0.0.1:0",
+        "rpc_secret": "shard-scrub",
+        "db_engine": "memory",
+        "bootstrap_peers": [],
+        "codec": {
+            "backend": "tpu", "shard_mesh": 8,
+            "rs_data": 4, "rs_parity": 2,
+            "store_parity": True, "batch_blocks": 16,
+        },
+    }))
+    try:
+        await g.system.netapp.listen("127.0.0.1:0")
+        lay = g.system.layout
+        lay.stage_role(bytes(g.system.id), NodeRole("dc1", 1000))
+        lay.apply_staged_changes()
+        g.system.layout = ClusterLayout.decode(lay.encode())
+        g.system._rebuild_ring()
+
+        codec = g.block_manager.codec
+        # the daemon config actually sharded the codec over the mesh
+        assert codec.mesh is not None and codec.mesh.devices.size == 8
+
+        from garage_tpu.block.block import DataBlock
+
+        # small blocks: XLA CPU compile time explodes on big graphs
+        datas = [os.urandom(6_000 + 37 * i) for i in range(24)]
+        hashes = [blake2s_sum(d) for d in datas]
+        for h, d in zip(hashes, datas):
+            await g.block_manager.write_block(h, DataBlock.plain(d))
+
+        # silent corruption on 3 blocks
+        for h in hashes[:3]:
+            path, _ = g.block_manager.find_block(h)
+            with open(path, "r+b") as f:
+                f.seek(16)
+                f.write(b"\xde\xad\xbe\xef")
+
+        scrub = ScrubWorker(g.block_manager)
+        scrub.send_command("start")
+        while (await scrub.work()).name in ("BUSY", "THROTTLED"):
+            pass
+        # psum-reduced corruption count, surfaced by the product worker
+        assert scrub.state.corruptions == 3
+        assert g.block_manager.resync.queue_len() >= 3
+
+        # the sharded pass wrote RS(4,2) sidecars for the clean blocks:
+        # lose one member entirely and reconstruct it locally
+        store = g.block_manager.parity_store
+        assert store is not None and store.stats()["indexed_blocks"] > 0
+        victim = None
+        for h in hashes[3:]:
+            if store.coverage(h):
+                victim = h
+                break
+        assert victim is not None, "no scrubbed block is parity-indexed"
+        man = store._load_manifest(victim)
+        path, _ = g.block_manager.find_block(victim)
+        os.remove(path)
+        rec = await asyncio.to_thread(store.try_reconstruct, victim)
+        want = datas[hashes.index(victim)]
+        assert rec == want, "sharded-pass parity failed to reconstruct"
+
+        # bit-identity of the mesh parity vs the CPU codec, daemon data:
+        # re-derive the victim's codeword from its manifest and compare
+        cpu = CpuCodec(CodecParams(rs_data=4, rs_parity=2))
+        import numpy as np
+
+        members = []
+        for mh in man["hashes"]:
+            if bytes(mh) == bytes(victim):
+                raw = want
+            else:
+                blk = await g.block_manager.read_block(Hash(bytes(mh)))
+                raw = blk.decompressed()
+            pad = np.zeros(man["maxlen"], dtype=np.uint8)
+            pad[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+            members.append(pad)
+        while len(members) < man["k"]:  # partial codeword: zero shards
+            members.append(np.zeros(man["maxlen"], dtype=np.uint8))
+        shards = np.stack(members)[None, :, :]
+        expect_parity = cpu.rs_encode(shards)[0]
+        got_parity = np.stack([
+            np.frombuffer(s, dtype=np.uint8) for s in man["parity"]
+        ])
+        assert np.array_equal(got_parity, expect_parity), \
+            "mesh-sharded parity differs from CPU RS encode"
+    finally:
+        await g.shutdown()
