@@ -352,6 +352,46 @@ class ParityDistributor:
         self.table = parity_index_table
         self.codewords_distributed = 0
 
+    def holds_index_for(self, h: Hash) -> bool:
+        """Is this node an index replica for member `h`?  locally_covered
+        is only authoritative on such nodes — with data factor > meta
+        factor a storing node may NOT hold the index partition, and a
+        local miss there means nothing (refreshing from it would mint a
+        fresh codeword every scrub pass, forever)."""
+        from ..table.schema import hash_partition_key
+
+        me = bytes(self.manager.system.id)
+        ph = hash_partition_key(bytes(h))
+        return any(bytes(n) == me
+                   for n in self.table.replication.read_nodes(ph))
+
+    def locally_covered(self, h: Hash) -> bool:
+        """Any live parity-index row for member `h` in the LOCAL store.
+        The index is sharded by member hash with the same ring walk as
+        block placement, so (when data factor ≤ meta factor) a node
+        storing the block also holds its index rows — a local read is
+        authoritative once table sync has converged.  Used by the scrub
+        worker's coverage refresh: blocks that lost distributed coverage
+        (failed distribution, a wrongly-tombstoned codeword, pre-EC
+        data) are re-fed to the write accumulator, making coverage
+        CONVERGENT instead of write-time-or-never.  Callers must gate on
+        holds_index_for (see its docstring) and run this off-loop for
+        batches (synchronous DB iteration)."""
+        from ..table.schema import hash_partition_key
+
+        data = self.table.data
+        prefix = bytes(hash_partition_key(bytes(h)))
+        for k, raw in data.store.items(prefix, None):
+            if k[:32] != prefix:
+                break
+            try:
+                ent = data.decode_entry(raw)
+            except Exception:
+                continue
+            if not ent.is_tombstone():
+                return True
+        return False
+
     def _salted(self, shard: bytes, taken: set) -> tuple:
         """(blob, hash) for the first salt whose placement avoids nodes
         already carrying a piece of this codeword; best-effort after

@@ -185,6 +185,7 @@ class ScrubWorker(Worker):
                 self._roots(), self.state.position
             )
         self.tranquilizer = Tranquilizer()
+        self.coverage_refreshed = 0  # blocks re-fed to the EC accumulator
         self._last_checkpoint = time.monotonic()
         self._cmd: asyncio.Queue = asyncio.Queue()
         self._wake = asyncio.Event()
@@ -383,6 +384,48 @@ class ScrubWorker(Worker):
                 if not good:
                     h, path, _ = batch[plain_idx[j]]
                     await self._quarantine(h, path)
+            # Coverage refresh: verified blocks with NO live distributed
+            # codeword (distribution failed at write time, coverage was
+            # wrongly tombstoned, or the data predates EC) re-enter the
+            # write-side accumulator — scrub makes erasure coverage
+            # convergent, mirroring how it refreshes local sidecars.
+            #
+            # Because every refreshed block is stored on THIS node, the
+            # accumulator's distinct-primary invariant flushes per block
+            # and the refresh emits 1-member partial codewords.  That is
+            # the intended SAFE shape for a single-node stream: the k−1
+            # implicit zero shards are always-available pieces, so the
+            # member survives the loss of up to m of its parity nodes —
+            # full m-loss tolerance at m×(block size) overhead, paid only
+            # for refreshed blocks (rewritten objects regroup at k).
+            acc = mgr.ec_accumulator
+            if acc is not None and acc.distributor is not None:
+                from .block import DataBlock
+
+                cand = []
+                for j, good in enumerate(ok[nc:]):
+                    h = all_h[nc + j]
+                    # NOT gated on acc.recently_added: that LRU remembers
+                    # the WRITE-time add, which is exactly the add whose
+                    # coverage may have been lost — locally_covered is
+                    # the authoritative duplicate guard, and a rare
+                    # double codeword (add raced an in-flight flush) is
+                    # benign extra parity, reclaimed by normal GC
+                    if good and not mgr.is_parity_block(h):
+                        cand.append((h, all_b[nc + j]))
+
+                def _uncovered():
+                    # one off-loop hop for the whole batch: the per-hash
+                    # index probes are synchronous DB iteration
+                    d = acc.distributor
+                    return [
+                        (h, b) for h, b in cand
+                        if d.holds_index_for(h) and not d.locally_covered(h)
+                    ]
+
+                for h, b in await asyncio.to_thread(_uncovered):
+                    self.coverage_refreshed += 1
+                    acc.add(h, DataBlock.plain(b))
             if want_parity and parity is not None:
                 # persist RS sidecars for every COMPLETE codeword whose
                 # members all verified — this is what makes a later
