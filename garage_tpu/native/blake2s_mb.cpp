@@ -1,10 +1,12 @@
-// Multi-buffer BLAKE2s-256: hash 8 independent byte streams in the 8
-// uint32 lanes of one AVX2 register file (lane-major, the same layout
-// ops/tpu_blake2s.py uses on the TPU VPU).  This is the CPU-floor answer
-// to the reference's strictly sequential per-block scrub hashing
+// Multi-buffer BLAKE2s-256: hash independent byte streams in the uint32
+// lanes of one SIMD register file (lane-major, the same layout
+// ops/tpu_blake2s.py uses on the TPU VPU) — 16 lanes on AVX-512 (native
+// vprord rotates), 8 on AVX2, runtime-dispatched.  This is the CPU-floor
+// answer to the reference's strictly sequential per-block scrub hashing
 // (ref src/block/repair.rs:438-490 → block.rs:66-78 verify): on the
 // 1-core hosts this framework targets, thread pools cannot add
-// parallelism, but 8 SIMD lanes can.
+// parallelism, but SIMD lanes can (~2.9 GiB/s 16-lane vs 0.38 hashlib
+// on the dev host).
 //
 // RFC 7693 exactly (digest_size=32, no key, no salt/personal);
 // bit-identity against hashlib.blake2s is enforced by
@@ -237,17 +239,197 @@ B2_TARGET void hash8(const uint8_t *const ptrs[8], const uint64_t lens[8],
     }
 }
 
-}  // namespace
+// ---------------------------------------------------------------------------
+// 16-lane AVX-512 path.  Same lane-major design, double the width, and the
+// ISA gives native 32-bit rotates (vprord) so the G function drops the
+// shuffle-based rotate emulation entirely.
+// ---------------------------------------------------------------------------
 
-// Runtime support probe: the Python wrapper must call this before using
-// blake2s256_multi and treat 0 as "kernel unavailable" (hashlib fallback).
-extern "C" int blake2s_mb_supported() {
-    return __builtin_cpu_supports("avx2") ? 1 : 0;
+#define B2_TARGET512 __attribute__((target("avx512f,avx512bw")))
+
+// Transpose 16 lanes × 16 consecutive uint32 (one 64-byte chunk per lane)
+// into word-major vectors m[w]: lane l of m[w] = word w of stream l.
+// Classic 4-stage 16x16: epi32 unpack, epi64 unpack, then two rounds of
+// 128-bit block shuffles (shuffle_i32x4).
+B2_TARGET512 inline void transpose16x16(const uint8_t *const ptrs[16],
+                                        __m512i m[16]) {
+    __m512i r[16], t[16], u[16];
+    for (int l = 0; l < 16; ++l)
+        r[l] = _mm512_loadu_si512((const void *)ptrs[l]);
+    for (int i = 0; i < 8; ++i) {
+        t[2 * i] = _mm512_unpacklo_epi32(r[2 * i], r[2 * i + 1]);
+        t[2 * i + 1] = _mm512_unpackhi_epi32(r[2 * i], r[2 * i + 1]);
+    }
+    for (int i = 0; i < 4; ++i) {
+        u[4 * i + 0] = _mm512_unpacklo_epi64(t[4 * i + 0], t[4 * i + 2]);
+        u[4 * i + 1] = _mm512_unpackhi_epi64(t[4 * i + 0], t[4 * i + 2]);
+        u[4 * i + 2] = _mm512_unpacklo_epi64(t[4 * i + 1], t[4 * i + 3]);
+        u[4 * i + 3] = _mm512_unpackhi_epi64(t[4 * i + 1], t[4 * i + 3]);
+    }
+    // u[g*4+k] now holds, for the 4 streams of group g (lanes 4g..4g+3),
+    // words {k of sub-block j} across its four 128-bit sub-blocks j.
+    // Gather equal 128-bit sub-blocks across groups:
+    __m512i v[16];
+    for (int k = 0; k < 4; ++k) {
+        v[k + 0] = _mm512_shuffle_i32x4(u[k], u[4 + k], 0x88);      // j=0,2
+        v[k + 4] = _mm512_shuffle_i32x4(u[8 + k], u[12 + k], 0x88); // j=0,2
+        v[k + 8] = _mm512_shuffle_i32x4(u[k], u[4 + k], 0xDD);      // j=1,3
+        v[k + 12] = _mm512_shuffle_i32x4(u[8 + k], u[12 + k], 0xDD);
+    }
+    for (int k = 0; k < 4; ++k) {
+        m[k + 0] = _mm512_shuffle_i32x4(v[k + 0], v[k + 4], 0x88);   // j=0
+        m[k + 8] = _mm512_shuffle_i32x4(v[k + 0], v[k + 4], 0xDD);   // j=2
+        m[k + 4] = _mm512_shuffle_i32x4(v[k + 8], v[k + 12], 0x88);  // j=1
+        m[k + 12] = _mm512_shuffle_i32x4(v[k + 8], v[k + 12], 0xDD); // j=3
+    }
 }
 
-extern "C" B2_TARGET void blake2s256_multi(const uint8_t *const *ptrs,
-                                           const uint64_t *lens, uint8_t *out,
-                                           int64_t n) {
+#define G16(r, i, a, b, c, d)                                  \
+    do {                                                       \
+        a = _mm512_add_epi32(_mm512_add_epi32(a, b),           \
+                             m[SIGMA[r][2 * (i)]]);            \
+        d = _mm512_ror_epi32(_mm512_xor_si512(d, a), 16);      \
+        c = _mm512_add_epi32(c, d);                            \
+        b = _mm512_ror_epi32(_mm512_xor_si512(b, c), 12);      \
+        a = _mm512_add_epi32(_mm512_add_epi32(a, b),           \
+                             m[SIGMA[r][2 * (i) + 1]]);        \
+        d = _mm512_ror_epi32(_mm512_xor_si512(d, a), 8);       \
+        c = _mm512_add_epi32(c, d);                            \
+        b = _mm512_ror_epi32(_mm512_xor_si512(b, c), 7);       \
+    } while (0)
+
+B2_TARGET512 inline void compress16(__m512i h[8],
+                                    const uint8_t *const chunk[16],
+                                    __m512i t_lo, __m512i t_hi, __m512i f0) {
+    __m512i m[16];
+    transpose16x16(chunk, m);
+    __m512i v0 = h[0], v1 = h[1], v2 = h[2], v3 = h[3];
+    __m512i v4 = h[4], v5 = h[5], v6 = h[6], v7 = h[7];
+    __m512i v8 = _mm512_set1_epi32((int)IV[0]);
+    __m512i v9 = _mm512_set1_epi32((int)IV[1]);
+    __m512i v10 = _mm512_set1_epi32((int)IV[2]);
+    __m512i v11 = _mm512_set1_epi32((int)IV[3]);
+    __m512i v12 = _mm512_xor_si512(_mm512_set1_epi32((int)IV[4]), t_lo);
+    __m512i v13 = _mm512_xor_si512(_mm512_set1_epi32((int)IV[5]), t_hi);
+    __m512i v14 = _mm512_xor_si512(_mm512_set1_epi32((int)IV[6]), f0);
+    __m512i v15 = _mm512_set1_epi32((int)IV[7]);
+    for (int r = 0; r < 10; ++r) {
+        G16(r, 0, v0, v4, v8, v12);
+        G16(r, 1, v1, v5, v9, v13);
+        G16(r, 2, v2, v6, v10, v14);
+        G16(r, 3, v3, v7, v11, v15);
+        G16(r, 4, v0, v5, v10, v15);
+        G16(r, 5, v1, v6, v11, v12);
+        G16(r, 6, v2, v7, v8, v13);
+        G16(r, 7, v3, v4, v9, v14);
+    }
+    h[0] = _mm512_xor_si512(h[0], _mm512_xor_si512(v0, v8));
+    h[1] = _mm512_xor_si512(h[1], _mm512_xor_si512(v1, v9));
+    h[2] = _mm512_xor_si512(h[2], _mm512_xor_si512(v2, v10));
+    h[3] = _mm512_xor_si512(h[3], _mm512_xor_si512(v3, v11));
+    h[4] = _mm512_xor_si512(h[4], _mm512_xor_si512(v4, v12));
+    h[5] = _mm512_xor_si512(h[5], _mm512_xor_si512(v5, v13));
+    h[6] = _mm512_xor_si512(h[6], _mm512_xor_si512(v6, v14));
+    h[7] = _mm512_xor_si512(h[7], _mm512_xor_si512(v7, v15));
+}
+
+B2_TARGET512 void hash16(const uint8_t *const ptrs[16],
+                         const uint64_t lens[16], uint8_t *const outs[16]) {
+    __m512i h[8];
+    h[0] = _mm512_set1_epi32((int)(IV[0] ^ 0x01010020u));
+    for (int i = 1; i < 8; ++i) h[i] = _mm512_set1_epi32((int)IV[i]);
+
+    uint64_t chunks[16], min_interior = UINT64_MAX, max_chunks = 0;
+    for (int l = 0; l < 16; ++l) {
+        chunks[l] = lens[l] == 0 ? 1 : (lens[l] + 63) / 64;
+        uint64_t interior = lens[l] == 0 ? 0 : (lens[l] - 1) / 64;
+        if (interior < min_interior) min_interior = interior;
+        if (chunks[l] > max_chunks) max_chunks = chunks[l];
+    }
+
+    uint64_t c = 0;
+    for (; c < min_interior; ++c) {
+        const uint8_t *cp[16];
+        for (int l = 0; l < 16; ++l) cp[l] = ptrs[l] + c * 64;
+        uint64_t t = (c + 1) * 64;
+        compress16(h, cp, _mm512_set1_epi32((int)(uint32_t)t),
+                   _mm512_set1_epi32((int)(uint32_t)(t >> 32)),
+                   _mm512_setzero_si512());
+    }
+
+    alignas(64) uint8_t padbuf[16][64];
+    static const uint8_t zeros[64] = {0};
+    for (; c < max_chunks; ++c) {
+        const uint8_t *cp[16];
+        alignas(64) uint32_t tl[16], th[16], fl[16];
+        uint16_t act = 0;
+        for (int l = 0; l < 16; ++l) {
+            if (c >= chunks[l]) {
+                cp[l] = zeros;
+                tl[l] = th[l] = fl[l] = 0;
+                continue;
+            }
+            act |= (uint16_t)(1u << l);
+            uint64_t off = c * 64;
+            uint64_t remain = lens[l] - off;
+            bool final_chunk = (c == chunks[l] - 1);
+            if (remain >= 64) {
+                cp[l] = ptrs[l] + off;
+            } else {
+                memset(padbuf[l], 0, 64);
+                if (remain) memcpy(padbuf[l], ptrs[l] + off, remain);
+                cp[l] = padbuf[l];
+            }
+            uint64_t t = final_chunk ? lens[l] : off + 64;
+            tl[l] = (uint32_t)t;
+            th[l] = (uint32_t)(t >> 32);
+            fl[l] = final_chunk ? 0xFFFFFFFFu : 0;
+        }
+        __m512i hold[8];
+        for (int i = 0; i < 8; ++i) hold[i] = h[i];
+        compress16(h, cp, _mm512_load_si512((const void *)tl),
+                   _mm512_load_si512((const void *)th),
+                   _mm512_load_si512((const void *)fl));
+        for (int i = 0; i < 8; ++i)  // finished lanes keep frozen state
+            h[i] = _mm512_mask_blend_epi32((__mmask16)act, hold[i], h[i]);
+    }
+
+    alignas(64) uint32_t words[8][16];
+    for (int i = 0; i < 8; ++i)
+        _mm512_store_si512((void *)words[i], h[i]);
+    for (int l = 0; l < 16; ++l) {
+        uint32_t d[8];
+        for (int w = 0; w < 8; ++w) d[w] = words[w][l];
+        memcpy(outs[l], d, 32);
+    }
+}
+
+B2_TARGET512 void multi16(const uint8_t *const *ptrs, const uint64_t *lens,
+                          uint8_t *out, int64_t n) {
+    for (int64_t i = 0; i < n; i += 16) {
+        const uint8_t *p[16];
+        uint64_t L[16];
+        uint8_t *o[16];
+        uint8_t scratch[16][32];
+        int64_t last = (i + 15 < n ? i + 15 : n - 1);
+        for (int l = 0; l < 16; ++l) {
+            int64_t j = i + l;
+            if (j < n) {
+                p[l] = ptrs[j];
+                L[l] = lens[j];
+                o[l] = out + j * 32;
+            } else {  // pad lane: replay the last real stream (see multi8)
+                p[l] = ptrs[last];
+                L[l] = lens[last];
+                o[l] = scratch[l];
+            }
+        }
+        hash16(p, L, o);
+    }
+}
+
+B2_TARGET void multi8(const uint8_t *const *ptrs, const uint64_t *lens,
+                      uint8_t *out, int64_t n) {
     for (int64_t i = 0; i < n; i += 8) {
         const uint8_t *p[8];
         uint64_t L[8];
@@ -275,4 +457,27 @@ extern "C" B2_TARGET void blake2s256_multi(const uint8_t *const *ptrs,
         }
         hash8(p, L, o);
     }
+}
+
+}  // namespace
+
+// Runtime support probe: the Python wrapper must call this before using
+// blake2s256_multi and treat 0 as "kernel unavailable" (hashlib fallback).
+// Returns the SIMD lane count (16 = AVX-512, 8 = AVX2); callers need only
+// truthiness — blake2s256_multi dispatches on width internally.
+extern "C" int blake2s_mb_supported() {
+    if (__builtin_cpu_supports("avx512f") &&
+        __builtin_cpu_supports("avx512bw"))
+        return 16;
+    return __builtin_cpu_supports("avx2") ? 8 : 0;
+}
+
+extern "C" void blake2s256_multi(const uint8_t *const *ptrs,
+                                 const uint64_t *lens, uint8_t *out,
+                                 int64_t n) {
+    static const int lanes = blake2s_mb_supported();
+    if (lanes == 16 && n > 8)
+        multi16(ptrs, lens, out, n);
+    else
+        multi8(ptrs, lens, out, n);
 }

@@ -2,7 +2,8 @@
 
 Two kernels live here:
   - gf256.cpp      → libgf256.so      (AVX2 split-nibble GF(2^8) matmul)
-  - blake2s_mb.cpp → libblake2smb.so  (AVX2 8-way multi-buffer BLAKE2s-256)
+  - blake2s_mb.cpp → libblake2smb.so  (multi-buffer BLAKE2s-256;
+    16-lane AVX-512 / 8-lane AVX2, runtime-dispatched inside the kernel)
 
 Resolved lazily on first use (not import — short CLI invocations must not
 pay for a compiler run); a failed build is cached on disk against the
@@ -167,8 +168,9 @@ _b2_fn: Optional[Callable[[Sequence[bytes]], List[bytes]]] = None
 
 
 def get_native_blake2s_multi() -> Optional[Callable[[Sequence[bytes]], List[bytes]]]:
-    """Batch BLAKE2s-256 over the AVX2 8-way kernel, or None (hashlib
-    fallback).  Returns a callable blocks → [32-byte digest per block].
+    """Batch BLAKE2s-256 over the multi-buffer SIMD kernel (16-lane
+    AVX-512 or 8-lane AVX2, dispatched inside blake2s256_multi), or None
+    (hashlib fallback).  Returns a callable blocks → [32-byte digests].
 
     The wrapper sorts the batch by length before dispatch: lanes in one
     SIMD group advance in lock-step, so grouping similar lengths minimises
