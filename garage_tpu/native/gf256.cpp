@@ -134,6 +134,19 @@ static uint64_t gf_affine_matrix(uint8_t c) {
   return A;
 }
 
+// Register-blocking cap: preloading each 64-byte input column ONCE and
+// keeping all k lanes live in zmm registers cuts loads r-fold (the
+// column was re-read per output row).  k ≤ 16 covers every supported RS
+// geometry with registers to spare (16 inputs + r accumulators < 32
+// zmm); larger k falls back to the unblocked loop.
+//
+// NOTE the per-row accumulate (coef==0 skip / coef==1 xor / affine)
+// appears FOUR times below — blocked+fallback in both the packed and
+// the ptrs kernel.  Deliberate: the fallbacks are the pre-blocking
+// loops kept verbatim, and templating target-attributed functions
+// risks codegen drift.  A change to the GF math must touch all four.
+#define GF_KMAX 16
+
 __attribute__((target("gfni,avx512f,avx512bw"))) static void gf_matmul_gfni(
     const uint8_t* mat, const uint64_t* affine, const uint8_t* shards,
     uint8_t* out, int64_t batch, int64_t r, int64_t k, int64_t s) {
@@ -142,22 +155,45 @@ __attribute__((target("gfni,avx512f,avx512bw"))) static void gf_matmul_gfni(
   for (int64_t b = 0; b < batch; b++) {
     const uint8_t* in_b = shards + b * k * s;
     uint8_t* out_b = out + b * r * s;
-    for (int64_t v = 0; v < svec; v += 64) {
-      for (int64_t i = 0; i < r; i++) {
-        __m512i acc = _mm512_setzero_si512();
-        for (int64_t j = 0; j < k; j++) {
-          uint8_t coef = mat[i * k + j];
-          if (coef == 0) continue;
-          __m512i x = _mm512_loadu_si512((const void*)(in_b + j * s + v));
-          if (coef == 1) {
-            acc = _mm512_xor_si512(acc, x);
-            continue;
+    if (k <= GF_KMAX) {
+      for (int64_t v = 0; v < svec; v += 64) {
+        __m512i x[GF_KMAX];
+        for (int64_t j = 0; j < k; j++)
+          x[j] = _mm512_loadu_si512((const void*)(in_b + j * s + v));
+        for (int64_t i = 0; i < r; i++) {
+          __m512i acc = _mm512_setzero_si512();
+          for (int64_t j = 0; j < k; j++) {
+            uint8_t coef = mat[i * k + j];
+            if (coef == 0) continue;
+            if (coef == 1) {
+              acc = _mm512_xor_si512(acc, x[j]);
+              continue;
+            }
+            __m512i A = _mm512_set1_epi64((long long)affine[i * k + j]);
+            acc = _mm512_xor_si512(
+                acc, _mm512_gf2p8affine_epi64_epi8(x[j], A, 0));
           }
-          __m512i A = _mm512_set1_epi64((long long)affine[i * k + j]);
-          acc = _mm512_xor_si512(acc,
-                                 _mm512_gf2p8affine_epi64_epi8(x, A, 0));
+          _mm512_storeu_si512((void*)(out_b + i * s + v), acc);
         }
-        _mm512_storeu_si512((void*)(out_b + i * s + v), acc);
+      }
+    } else {
+      for (int64_t v = 0; v < svec; v += 64) {
+        for (int64_t i = 0; i < r; i++) {
+          __m512i acc = _mm512_setzero_si512();
+          for (int64_t j = 0; j < k; j++) {
+            uint8_t coef = mat[i * k + j];
+            if (coef == 0) continue;
+            __m512i x = _mm512_loadu_si512((const void*)(in_b + j * s + v));
+            if (coef == 1) {
+              acc = _mm512_xor_si512(acc, x);
+              continue;
+            }
+            __m512i A = _mm512_set1_epi64((long long)affine[i * k + j]);
+            acc = _mm512_xor_si512(acc,
+                                   _mm512_gf2p8affine_epi64_epi8(x, A, 0));
+          }
+          _mm512_storeu_si512((void*)(out_b + i * s + v), acc);
+        }
       }
     }
     for (int64_t v = svec; v < s; v++) {
@@ -200,6 +236,42 @@ __attribute__((target("gfni,avx512f,avx512bw"))) static void gf_matmul_ptrs_gfni
       int64_t w = s - v < 64 ? s - v : 64;
       __mmask64 outmask =
           w == 64 ? ~(__mmask64)0 : ((((__mmask64)1) << w) - 1);
+      if (k <= GF_KMAX) {
+        // register-blocked: each (masked) input column loaded once,
+        // reused across all r output rows
+        __m512i x[GF_KMAX];
+        bool zero[GF_KMAX];
+        for (int64_t j = 0; j < k; j++) {
+          uint64_t len = in_l[j];
+          if ((uint64_t)v >= len) {  // zero-extended region
+            zero[j] = true;
+            continue;
+          }
+          zero[j] = false;
+          uint64_t avail = len - (uint64_t)v;
+          x[j] = avail >= 64
+                     ? _mm512_loadu_si512((const void*)(in_p[j] + v))
+                     : _mm512_maskz_loadu_epi8(
+                           ((((__mmask64)1) << avail) - 1),
+                           (const void*)(in_p[j] + v));
+        }
+        for (int64_t i = 0; i < r; i++) {
+          __m512i acc = _mm512_setzero_si512();
+          for (int64_t j = 0; j < k; j++) {
+            uint8_t coef = mat[i * k + j];
+            if (coef == 0 || zero[j]) continue;
+            if (coef == 1) {
+              acc = _mm512_xor_si512(acc, x[j]);
+            } else {
+              __m512i A = _mm512_set1_epi64((long long)affine[i * k + j]);
+              acc = _mm512_xor_si512(
+                  acc, _mm512_gf2p8affine_epi64_epi8(x[j], A, 0));
+            }
+          }
+          _mm512_mask_storeu_epi8((void*)(out_b + i * s + v), outmask, acc);
+        }
+        continue;
+      }
       for (int64_t i = 0; i < r; i++) {
         __m512i acc = _mm512_setzero_si512();
         for (int64_t j = 0; j < k; j++) {
