@@ -196,7 +196,7 @@ async def handle_upload_part_copy(ctx) -> web.Response:
 
     rng_header = ctx.request.headers.get("x-amz-copy-source-range")
     if rng_header is not None:
-        r = parse_range(rng_header, size)
+        r = parse_range(rng_header, size, clamp=False)
         if r is None:
             raise BadRequestError(f"bad x-amz-copy-source-range {rng_header!r}")
         begin, end = r

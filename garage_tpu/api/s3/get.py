@@ -81,11 +81,19 @@ def check_conditions(ctx, version, meta) -> Optional[int]:
     return None
 
 
-def parse_range(header: str, size: int) -> Optional[Tuple[int, int]]:
+def parse_range(header: str, size: int,
+                clamp: bool = True) -> Optional[Tuple[int, int]]:
     """'bytes=a-b' → (begin, end_exclusive).  Returns None for a
     syntactically malformed header (S3 ignores those and serves the full
-    object); raises InvalidRangeError (416) only for unsatisfiable
-    in-bounds syntax (ref get.rs range parsing)."""
+    object); raises InvalidRangeError (416) for unsatisfiable ranges.
+
+    clamp=True (the GET path): RFC 7233 §2.1 — an end past the object
+    clamps to the last byte, and only a start beyond the object (or an
+    inverted range) is unsatisfiable; "bytes=50-200" on a 62-byte object
+    serves bytes 50-61, not 416 (caught porting ref objects.rs's range
+    matrix).  clamp=False (UploadPartCopy's x-amz-copy-source-range):
+    AWS REJECTS out-of-bounds copy ranges — silently truncating would
+    hand the client a short part and a wrong multipart object."""
     if not header.startswith("bytes="):
         return None
     spec = header[len("bytes="):]
@@ -96,14 +104,24 @@ def parse_range(header: str, size: int) -> Optional[Tuple[int, int]]:
         if a == "":
             # suffix range: last N bytes
             n = int(b)
+            if n < 0:
+                return None  # "bytes=--5": malformed, serve full object
             if n == 0:
                 raise InvalidRangeError("zero suffix range")
-            return max(0, size - n), size
-        begin = int(a)
-        end = int(b) + 1 if b != "" else size
+            begin, end = max(0, size - n), size
+        else:
+            begin = int(a)
+            end = int(b) + 1 if b != "" else size
+            if clamp:
+                end = min(end, size)
+            elif end > size:
+                raise InvalidRangeError(
+                    f"range {header} out of bounds for size {size}")
     except ValueError:
         return None
-    if begin >= size or end > size or begin >= end:
+    # common validation — the suffix branch flows through too, so a
+    # suffix on an empty object is 416, never a (0, 0) degenerate range
+    if begin >= size or begin >= end:
         raise InvalidRangeError(f"range {header} out of bounds for size {size}")
     return begin, end
 

@@ -51,20 +51,11 @@ def main() -> None:
     })
 
     # one small hybrid window (256 MiB) for a live tpu_frac sample —
-    # enough to show the work-stealing split without hours of quota
+    # enough to show the work-stealing split without hours of quota;
+    # same generator as the bench so the workloads are identical
     import numpy as np
 
-    rng = np.random.default_rng(0)
-    batches = []
-    arr = rng.integers(0, 256, (bench.BATCH, bench.BLOCK), dtype=np.uint8)
-    blocks = [arr[i].tobytes() for i in range(bench.BATCH)]
-    import hashlib
-
-    from garage_tpu.utils.data import Hash
-
-    hashes = [Hash(hashlib.blake2s(b, digest_size=32).digest())
-              for b in blocks]
-    batches = [(blocks, hashes)]
+    batches = bench.make_batches(np.random.default_rng(0))[:1]
     codec.pop_stats()
     t0 = time.perf_counter()
     out = codec.scrub_many(batches, fetch_parity=False)
