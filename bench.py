@@ -177,13 +177,57 @@ def make_batches(rng):
     return batches
 
 
-def bench_device_resident(codec) -> float:
-    """Device-only compute rate of the fused verify+encode kernel with the
-    batch already resident in HBM — isolates the chip's kernel rate from
-    the (metered) host→device link, so 'the link, not the kernel, is the
-    bottleneck' is a measurement rather than an inference.  Stages one
-    32-block group over the link once, then times repeated executions on
-    the resident arrays."""
+def _slope_rate(fn_of_reps, r1: int, r2: int, bytes_per_rep: int,
+                tries: int = 3, min_signal_s: float = 0.2,
+                r2_cap: int = 8200) -> float:
+    """Kernel GiB/s from the SLOPE between two in-dispatch rep counts:
+    (r2-r1)*bytes/(T2-T1), min-of-`tries` at each count.
+
+    Two axon-tunnel failure modes this cancels (both observed):
+      - a large, time-varying fixed cost per invocation (queueing on the
+        shared remote TPU server, 10-100 ms) that flattens naive rep
+        loops to the overhead rate;
+      - block_until_ready returning at ENQUEUE time under fresh burst
+        quota, inflating naive numbers to impossible values (522 GiB/s >
+        HBM roofline).  fn_of_reps must therefore return a SMALL array
+        whose np.asarray (device→host fetch) is the sync point — d2h is
+        the only operation this backend reliably blocks on.
+    If the measured delta is under `min_signal_s` (noise ±30 ms), r2
+    escalates 4× until the signal clears it or hits r2_cap."""
+    times = {}
+
+    def measure(r):
+        _ = np.asarray(fn_of_reps(r))  # compile + warm + sync
+        best = float("inf")
+        for _ in range(tries):
+            t0 = time.perf_counter()
+            _ = np.asarray(fn_of_reps(r))
+            best = min(best, time.perf_counter() - t0)
+        times[r] = best
+
+    measure(r1)
+    while True:
+        measure(r2)
+        dt = times[r2] - times[r1]
+        if dt >= min_signal_s or r2 >= r2_cap:
+            break
+        r2 = min(r2 * 4, r2_cap)
+    if dt <= 0:
+        return 0.0
+    return (r2 - r1) * bytes_per_rep / dt / 2**30
+
+
+def bench_device_resident(codec):
+    """Device-only compute rates with the batch already resident in HBM —
+    isolates the chip's kernel rate from the (metered) host→device link,
+    so 'the link, not the kernel, is the bottleneck' is a measurement
+    rather than an inference.  Stages one BATCH-block group (256 MiB —
+    the production scrub submission width; blake2s rate is a strong
+    function of lane count) over the link once, then measures via
+    in-dispatch rep chains (see _slope_rate).
+    Returns (fused_scrub, pallas_gf, xla_gf) GiB/s."""
+    import functools
+
     import jax
     import jax.numpy as jnp
 
@@ -191,11 +235,22 @@ def bench_device_resident(codec) -> float:
     if tpu is None:
         return 0.0, 0.0, 0.0
     try:
-        n = 32
-        rng = np.random.default_rng(7)
-        arr = rng.integers(0, 256, (n, BLOCK), dtype=np.uint8)
+        from garage_tpu.ops import gf256
+        from garage_tpu.ops.pallas_gf import PallasGf
+        from garage_tpu.ops.tpu_codec import (bytes_view_u32, gf_apply,
+                                              scrub_step_kernel)
         from garage_tpu.utils.data import Hash
 
+        k = codec.params.rs_data
+        rng = np.random.default_rng(7)
+
+        # fused scrub at the PRODUCTION device batch width (BATCH lanes):
+        # blake2s is one VPU lane per block, so the fused rate is a
+        # strong function of batch width (measured v5e: 0.18 GiB/s at 16
+        # lanes, 1.5 at 256, 3.8 at 1024) — quoting it at the width the
+        # scrub worker actually submits is the honest number.
+        n = BATCH
+        arr = rng.integers(0, 256, (n, BLOCK), dtype=np.uint8)
         blocks = [arr[i].tobytes() for i in range(n)]
         hashes = [
             Hash(hashlib.blake2s(b, digest_size=32).digest()) for b in blocks
@@ -205,46 +260,74 @@ def bench_device_resident(codec) -> float:
         dl = jax.device_put(jnp.asarray(lengths))
         de = jax.device_put(jnp.asarray(expected))
         jax.block_until_ready((da, dl, de))
-        k = codec.params.rs_data
-        out = tpu._scrub_jit(da, dl, de, tpu._K_enc, k=k)  # compile+warm
-        jax.block_until_ready(out)
-        reps = 4
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = tpu._scrub_jit(da, dl, de, tpu._K_enc, k=k)
-        jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
-        fused = reps * n * BLOCK / dt / 2**30
+        group_bytes = n * BLOCK
+
+        # correctness once, then rep-chained timing.  Each iteration
+        # perturbs the data with the previous digests so the kernel call
+        # is loop-variant (XLA cannot hoist it); only iteration 0's `ok`
+        # is meaningful, asserted via the single warm call.
+        h, ok, bad, _par = tpu._scrub_jit(da, dl, de, tpu._K_enc, k)
+        assert bool(np.asarray(jnp.all(ok))), "clean batch reported corrupt"
+
+        @functools.partial(jax.jit, static_argnames=("reps",))
+        def scrub_reps(da, dl, de, Kc, reps):
+            def body(_i, carry):
+                da, acc = carry
+                h, _ok, bad, _p = scrub_step_kernel(da, dl, de, Kc, k)
+                da = da.at[0, 0].set(da[0, 0] ^ h[0, 0].astype(jnp.uint8))
+                return da, acc + bad
+            _da, acc = jax.lax.fori_loop(
+                0, reps, body, (da, jnp.int32(0)))
+            return acc
+
+        fused = _slope_rate(
+            lambda r: scrub_reps(da, dl, de, tpu._K_enc, r),
+            2, 10, group_bytes, r2_cap=160)
 
         # north-star comparison: HBM-resident GF apply, Pallas kernel vs
-        # the XLA mask-XOR formulation, same data
+        # the XLA mask-XOR formulation, same data (one 32 MiB slab).
+        # Staging failures here must not discard the fused measurement.
         pallas_gibs = xla_gf_gibs = 0.0
-        try:
-            from garage_tpu.ops.pallas_gf import PallasGf
-            from garage_tpu.ops.tpu_codec import bytes_view_u32
-            from garage_tpu.ops import gf256
 
+        def gf_reps_fn(apply_fn):
+            """In-dispatch rep chain for a GF apply: perturbs row 0 with
+            the previous parity so the call is loop-variant, returns a
+            scalar checksum (d2h of the sync point stays tiny)."""
+            @functools.partial(jax.jit, static_argnames=("reps",))
+            def reps_fn(u32, reps):
+                def body(_i, carry):
+                    u32, acc = carry
+                    out = apply_fn(u32)
+                    u32 = u32.at[:, 0].set(u32[:, 0] ^ out[:, 0])
+                    return u32, acc ^ jnp.sum(out, dtype=jnp.uint32)
+                _u, acc = jax.lax.fori_loop(
+                    0, reps, body, (u32, jnp.uint32(0)))
+                return acc
+            return reps_fn
+
+        try:
+            ngf = 32 - (32 % k) or k
+            gf_bytes = ngf * BLOCK
             u32 = jax.device_put(
-                bytes_view_u32(jnp.asarray(parr)).reshape(n // k, k, -1))
+                bytes_view_u32(jnp.asarray(parr[:ngf])).reshape(
+                    ngf // k, k, -1))
             jax.block_until_ready(u32)
+        except Exception:
+            traceback.print_exc()
+            return fused, 0.0, 0.0
+        try:
             mat = gf256.rs_parity_matrix(k, codec.params.rs_parity)
             pg = PallasGf(mat)
-            jax.block_until_ready(pg(u32))  # compile
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                o = pg(u32)
-            jax.block_until_ready(o)
-            pallas_gibs = reps * n * BLOCK / (time.perf_counter() - t0) / 2**30
+            reps_fn = gf_reps_fn(pg)
+            pallas_gibs = _slope_rate(
+                lambda r: reps_fn(u32, r), 8, 520, gf_bytes)
         except Exception:
             print("# pallas GF kernel unavailable on device",
                   file=sys.stderr)
         try:
-            jax.block_until_ready(tpu._gf_jit(u32, tpu._K_enc))
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                o = tpu._gf_jit(u32, tpu._K_enc)
-            jax.block_until_ready(o)
-            xla_gf_gibs = reps * n * BLOCK / (time.perf_counter() - t0) / 2**30
+            reps_fn = gf_reps_fn(lambda u: gf_apply(u, tpu._K_enc))
+            xla_gf_gibs = _slope_rate(
+                lambda r: reps_fn(u32, r), 8, 520, gf_bytes)
         except Exception:
             traceback.print_exc()
         return fused, pallas_gibs, xla_gf_gibs

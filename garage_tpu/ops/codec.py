@@ -43,7 +43,19 @@ class CodecParams:
     # staying coarse enough that per-group device transfer overhead stays
     # negligible.
     hybrid_group_blocks: int = 16
-    hybrid_window: int = 1          # device in-flight groups (hybrid backend)
+    # Device in-flight MERGED SUBMISSIONS (hybrid backend): each may span
+    # up to batch_blocks blocks (the feeder merges deque groups into wide
+    # submissions), so window+1 bounds in-flight claim at
+    # (window+1)×batch_blocks blocks of host staging + device HBM.
+    hybrid_window: int = 1
+    # Minimum measured host→device round-trip rate for the hybrid feeder
+    # to claim any work.  Staging a submission costs ~3-5% of a CPU
+    # verify for the same bytes, and a claimed-but-undelivered group is
+    # redone by the tail hedge — so a link below ~5% of the CPU floor
+    # (~1.4 GiB/s on the 1-core host) is strictly net-negative.  The
+    # probe forces a real transfer round-trip, so it is immune to the
+    # enqueue-time "completion" some remote backends report.
+    hybrid_min_link_gibs: float = 0.07
 
 
 class BlockCodec:

@@ -1,0 +1,238 @@
+"""On-device Pallas GF kernel tuning sweep (run only when the tunnel is up).
+
+DEVICE_CAPTURE r4 measured the Pallas GF kernel at 79.6 GiB/s vs the XLA
+mask-XOR formulation's 522 GiB/s.  A first sweep attempt showed that
+naive rep-loop timing through the axon tunnel is quota-dependent: with
+burst quota drained, per-dispatch overhead (~10 ms RPC) flattens every
+variant to ~2 GiB/s.  So this sweep folds R kernel applications into ONE
+dispatch via lax.fori_loop (with a cheap cross-iteration dependency so
+XLA cannot hoist the loop-invariant call) — the on-chip loop is immune
+to tunnel throttling and measures the kernel itself.
+
+Variants: loop order (orig = all 64 masks live across the output loop;
+acc = masks consumed immediately by r accumulators) x tile size.  The
+XLA gf_apply is measured the same way as the roofline reference.  Bit-
+identity vs the numpy oracle is asserted for every variant.  Prints one
+JSON line; the winner gets folded back into ops/pallas_gf.py.
+"""
+
+import functools
+import json
+import sys
+import time
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/garage_tpu_jax_cache")
+
+from garage_tpu.ops import gf256
+from garage_tpu.ops.pallas_gf import reference_apply
+from garage_tpu.ops.tpu_codec import gf_apply, gf_mask_consts
+
+K, M = 8, 4
+BLOCK = 1 << 20
+N = 32          # blocks resident in HBM (one 32 MiB group)
+# Two in-dispatch rep counts: the reported rate is the SLOPE
+# (R2-R1)*bytes/(T2-T1), which cancels the tunnel's fixed per-invocation
+# overhead (queueing on the shared remote TPU server, observed 50-100 ms
+# and time-varying) that flattened absolute single-R measurements.
+R1, R2 = 16, 144
+TRIES = 3       # min-of over timing repeats (queueing noise)
+
+
+def _kernel_orig(k, r, x_ref, consts_ref, o_ref):
+    one = jnp.uint32(0x01010101)
+    ff = jnp.uint32(0xFF)
+    x = x_ref[...]
+    masks = []
+    for i in range(k):
+        xi = x[i]
+        masks.append([((xi >> jnp.uint32(b)) & one) * ff for b in range(8)])
+    for p in range(r):
+        acc = jnp.zeros_like(x[0])
+        for i in range(k):
+            for b in range(8):
+                acc = acc ^ (masks[i][b] & consts_ref[p, i, b])
+        o_ref[p, ...] = acc
+
+
+def _kernel_acc(k, r, x_ref, consts_ref, o_ref):
+    """Masks computed once per (i, b) and consumed immediately by all r
+    accumulators — r+1 live vectors instead of 64."""
+    one = jnp.uint32(0x01010101)
+    ff = jnp.uint32(0xFF)
+    accs = [jnp.zeros_like(x_ref[0, ...]) for _ in range(r)]
+    for i in range(k):
+        xi = x_ref[i, ...]
+        for b in range(8):
+            m = ((xi >> jnp.uint32(b)) & one) * ff
+            for p in range(r):
+                accs[p] = accs[p] ^ (m & consts_ref[p, i, b])
+    for p in range(r):
+        o_ref[p, ...] = accs[p]
+
+
+def _kernel_accs(k, r, x_ref, consts_ref, o_ref):
+    """acc loop order with a multiply-free mask: (m << 8) - m == m * 0xFF
+    for m in {0,1} per byte (shift+sub instead of u32 multiply)."""
+    one = jnp.uint32(0x01010101)
+    accs = [jnp.zeros_like(x_ref[0, ...]) for _ in range(r)]
+    for i in range(k):
+        xi = x_ref[i, ...]
+        for b in range(8):
+            m1 = (xi >> jnp.uint32(b)) & one
+            m = (m1 << jnp.uint32(8)) - m1
+            for p in range(r):
+                accs[p] = accs[p] ^ (m & consts_ref[p, i, b])
+    for p in range(r):
+        o_ref[p, ...] = accs[p]
+
+
+def _pallas_once(x, consts, k, r, tile, kernel):
+    from jax.experimental import pallas as pl
+
+    n = x.shape[-1]
+    kern = {"orig": _kernel_orig, "acc": _kernel_acc,
+            "accs": _kernel_accs}[kernel]
+    return pl.pallas_call(
+        functools.partial(kern, k, r),
+        grid=(n // tile,),
+        in_specs=[
+            pl.BlockSpec((k, tile), lambda j: (0, j)),
+            pl.BlockSpec((r, k, 8), lambda j: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((r, tile), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((r, n), jnp.uint32),
+    )(x, consts)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "r", "tile", "kernel", "reps"))
+def _pallas_reps(x, consts, k, r, tile, kernel, reps):
+    """`reps` applications chained inside one dispatch: each iteration
+    perturbs row 0 with the previous parity row so the pallas call is
+    loop-variant (cannot be hoisted) — the extra traffic is 2 rows per
+    iter vs k read + r written."""
+    def body(_i, carry):
+        x, acc = carry
+        out = _pallas_once(x, consts, k, r, tile, kernel)
+        x = x.at[0].set(x[0] ^ out[0])
+        return x, acc ^ out[0]
+    x, acc = jax.lax.fori_loop(0, reps, body, (x, jnp.zeros_like(x[0])))
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("reps",))
+def _xla_reps(u32, Kc, reps):
+    def body(_i, carry):
+        u32, acc = carry
+        out = gf_apply(u32, Kc)
+        u32 = u32.at[:, 0].set(u32[:, 0] ^ out[:, 0])
+        return u32, acc ^ out[:, 0]
+    u32, acc = jax.lax.fori_loop(
+        0, reps, body, (u32, jnp.zeros_like(u32[:, 0])))
+    return acc
+
+
+def _slope_rate(fn_of_reps) -> float:
+    """min-of-TRIES times at R1 and R2 reps; returns GiB/s from the
+    slope.  fn_of_reps(r) must return a device array to block on."""
+    times = {}
+    for r in (R1, R2):
+        jax.block_until_ready(fn_of_reps(r))  # compile + warm
+        best = float("inf")
+        for _ in range(TRIES):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn_of_reps(r))
+            best = min(best, time.perf_counter() - t0)
+        times[r] = best
+    dt = times[R2] - times[R1]
+    if dt <= 0:
+        return 0.0
+    return (R2 - R1) * N * BLOCK / dt / 2**30
+
+
+def main():
+    devs = jax.devices()
+    rec = {"device": str(devs[0])}
+    rng = np.random.default_rng(7)
+
+    # --- tunnel state context: RTT + link bandwidth --------------------
+    x = jax.device_put(jnp.zeros((8, 128), jnp.uint32))
+    jax.block_until_ready(x + 1)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        jax.block_until_ready(x + 1)
+    rec["dispatch_rtt_ms"] = round(
+        (time.perf_counter() - t0) / 5 * 1000, 2)
+    arr = rng.integers(0, 256, (64 << 20,), dtype=np.uint8)
+    t0 = time.perf_counter()
+    d = jax.device_put(arr)
+    jax.block_until_ready(d)
+    rec["link_h2d_gibs"] = round(
+        arr.nbytes / (time.perf_counter() - t0) / 2**30, 4)
+    del d, arr
+    print(f"# rtt {rec['dispatch_rtt_ms']} ms, "
+          f"h2d {rec['link_h2d_gibs']} GiB/s", file=sys.stderr, flush=True)
+
+    # --- stage one 32 MiB group in HBM ---------------------------------
+    data = rng.integers(0, 256, (N, BLOCK), dtype=np.uint8)
+    u32 = np.ascontiguousarray(
+        data.reshape(N // K, K, BLOCK)).view("<u4").reshape(N // K, K, -1)
+    mat = gf256.rs_parity_matrix(K, M)
+    consts = jnp.asarray(gf_mask_consts(mat))
+    want = reference_apply(mat, u32[:1])
+
+    s4 = u32.shape[-1]
+    b = u32.shape[0]
+    xflat = jax.device_put(
+        jnp.asarray(np.swapaxes(u32, 0, 1).reshape(K, -1)))
+    du32 = jax.device_put(jnp.asarray(u32))
+    jax.block_until_ready((xflat, du32))
+
+    results = {}
+    best = (0.0, None)
+    for kernel in ("acc", "accs"):
+        for tile in (4096, 8192, 16384):
+            tag = f"{kernel}/t{tile}"
+            try:
+                # correctness: single application vs oracle
+                one = jax.jit(_pallas_once, static_argnames=(
+                    "k", "r", "tile", "kernel"))(
+                        xflat, consts, K, M, tile, kernel)
+                got = np.swapaxes(
+                    np.asarray(one).reshape(M, b, s4), 0, 1)[:1]
+                assert (got == want).all(), f"{tag}: WRONG RESULT"
+                gibs = _slope_rate(lambda r: _pallas_reps(
+                    xflat, consts, K, M, tile, kernel, r))
+                results[tag] = round(gibs, 1)
+                if gibs > best[0]:
+                    best = (gibs, tag)
+                print(f"# {tag}: {gibs:.1f} GiB/s", file=sys.stderr,
+                      flush=True)
+            except Exception as e:
+                results[tag] = f"ERR {type(e).__name__}: {str(e)[:100]}"
+                print(f"# {tag}: {results[tag]}", file=sys.stderr,
+                      flush=True)
+
+    # XLA roofline reference, same slope methodology
+    try:
+        results["xla_gf"] = round(_slope_rate(
+            lambda r: _xla_reps(du32, consts, r)), 1)
+        print(f"# xla_gf: {results['xla_gf']} GiB/s", file=sys.stderr,
+              flush=True)
+    except Exception as e:
+        results["xla_gf"] = f"ERR {type(e).__name__}: {str(e)[:100]}"
+
+    rec["sweep"] = results
+    rec["best"] = {"tag": best[1], "gibs": round(best[0], 1)}
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -253,3 +253,88 @@ def test_hybrid_concurrent_calls_thread_safety():
     for t in ts:
         t.join()
     assert out["a"].all() and out["b"].all()
+
+
+class _RecordingDevice(_FakeDevice):
+    """FakeDevice that records each submission's block count."""
+
+    def __init__(self, params, **kw):
+        super().__init__(params, **kw)
+        self.widths = []
+
+    def scrub_submit(self, blocks, hashes):
+        self.widths.append(len(blocks))
+        return super().scrub_submit(blocks, hashes)
+
+
+def test_hybrid_feeder_merges_groups_into_wide_submissions():
+    # The device hash kernel is one VPU lane per block, so the feeder must
+    # submit MERGED multi-group batches (device_batch_blocks wide), not the
+    # CPU-cache-sized stealing quantum.  A slow-ish device ensures the
+    # deque is deep when the feeder grabs its first merge.
+    p = _params(batch_blocks=32)          # group=8 → merges up to 4 groups
+    dev = _RecordingDevice(p, delay=0.02)
+    hy = HybridCodec(p, device_codec=dev)
+    assert hy.device_batch_blocks == 32
+    blocks, hashes = _mk_blocks(160, seed=11)
+    ok, parity = hy.scrub_encode_batch(blocks, hashes)
+    assert ok.all()
+    assert np.array_equal(parity, _cpu_reference_parity(blocks))
+    assert dev.widths, "device never participated"
+    # first submission: deque has 20 groups → steal-half = 10 groups,
+    # capped by the 32-block device batch → 4 groups merged
+    assert max(dev.widths) > p.hybrid_group_blocks, \
+        f"no merging happened: {dev.widths}"
+    assert max(dev.widths) <= 32
+
+
+def test_hybrid_merged_split_with_corruption_and_unaligned_tail():
+    # Per-group result splitting of a merged submission: corruption flags
+    # must land on the right blocks and parity must stay per-batch even
+    # when the final group is not k-aligned (18 = 4 full groups of 4 + 2).
+    p = _params(hybrid_group_blocks=4, batch_blocks=16)
+    dev = _RecordingDevice(p, delay=0.01)
+    hy = HybridCodec(p, device_codec=dev)
+    blocks, hashes = _mk_blocks(18, seed=12)
+    blocks[3] = b"\x00" * len(blocks[3])
+    blocks[17] = blocks[17][:-1] + b"\x7f"
+    ok, parity = hy.scrub_encode_batch(blocks, hashes)
+    expect_ok = CpuCodec(p).batch_verify(blocks, hashes)
+    assert np.array_equal(ok, expect_ok)
+    assert not ok[3] and not ok[17]
+    assert ok.sum() == 16
+    assert np.array_equal(parity, _cpu_reference_parity(blocks))
+
+
+def test_hybrid_merge_respects_scrub_many_batch_cuts():
+    # Merged device submissions must never let an RS codeword straddle a
+    # scrub_many batch edge: per-batch parity equals each batch's own
+    # CPU reference even with non-aligned batch lengths.
+    p = _params(hybrid_group_blocks=4, batch_blocks=64)
+    dev = _RecordingDevice(p, delay=0.01)
+    hy = HybridCodec(p, device_codec=dev)
+    b0, h0 = _mk_blocks(14, seed=13)   # non-aligned tail (14 % 4 != 0)
+    b1, h1 = _mk_blocks(22, seed=14)   # non-aligned tail
+    out = hy.scrub_many([(b0, h0), (b1, h1)], fetch_parity=True)
+    assert len(out) == 2
+    assert out[0][0].all() and out[1][0].all()
+    assert np.array_equal(out[0][1], _cpu_reference_parity(b0))
+    assert np.array_equal(out[1][1], _cpu_reference_parity(b1))
+
+
+def test_hybrid_link_gate_cedes_to_cpu_when_probe_below_threshold():
+    # With the threshold set impossibly high, the feeder must claim
+    # nothing (probe gate) and the pass still completes correctly on CPU.
+    hy = make_codec("hybrid", **vars(_params(hybrid_min_link_gibs=1e9)))
+    for _ in range(200):
+        if hy.tpu is not None:
+            break
+        time.sleep(0.05)
+    assert hy.tpu is not None
+    blocks, hashes = _mk_blocks(64, seed=21)
+    ok, parity = hy.scrub_encode_batch(blocks, hashes)
+    assert ok.all()
+    assert np.array_equal(parity, _cpu_reference_parity(blocks))
+    bytes_cpu, bytes_tpu = hy.pop_stats()
+    assert bytes_tpu == 0, "feeder claimed work through a gated link"
+    assert bytes_cpu == sum(len(b) for b in blocks)
