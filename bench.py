@@ -914,20 +914,21 @@ async def _degraded_phase_async() -> dict:
             g.system._rebuild_ring()
 
         t0 = time.perf_counter()
-        # kick resync for every block on its new primary (what `repair
-        # blocks` phase 1 does, compressed: the refs already point there)
+        # No manual resync kick: the ring change above fires each
+        # survivor's automatic refs-only layout sweep (model/garage.py
+        # on_ring_change), which is the product's own healing path —
+        # this phase measures IT.  Only the worker count is raised.
         for i, g in enumerate(garages):
             if i in victims:
                 continue
             g.block_resync.set_n_workers(4)
-            for key, _v in g.block_manager.rc.items(b""):
-                g.block_manager.resync.put_to_resync(Hash(key[:32]), 0.0)
 
         async with aiohttp.ClientSession() as session:
             s3 = _S3(session, port, kid, secret)
             pending = dict(bodies)
             deadline = time.perf_counter() + 600
             last_kick = time.perf_counter()
+            pending_at_kick = len(pending)
             while pending and time.perf_counter() < deadline:
                 for name in list(pending):
                     try:
@@ -941,18 +942,23 @@ async def _degraded_phase_async() -> dict:
                     # the poll itself competes with repair for the one
                     # core — probe sparsely
                     await asyncio.sleep(5.0)
-                    # periodic `repair blocks`-style passes: block_ref
-                    # rows keep migrating to the post-failure owners via
-                    # table sync, so newly-arrived refs need a fresh
-                    # resync kick (production runs RepairWorker for this)
-                    if time.perf_counter() - last_kick > 45:
+                    # FALLBACK only (the automatic layout sweep + the
+                    # 0→1 incref hooks on migrated refs are the product
+                    # paths being measured): kick a refs-only sweep
+                    # through the product worker ONLY if no object healed
+                    # for 60 s, so a stall degrades the number instead of
+                    # zeroing it without contaminating normal runs
+                    if len(pending) != pending_at_kick:
+                        pending_at_kick = len(pending)
                         last_kick = time.perf_counter()
+                    elif time.perf_counter() - last_kick > 60:
+                        last_kick = time.perf_counter()
+                        from garage_tpu.block.repair import RepairWorker
                         for i, g in enumerate(garages):
                             if i in victims:
                                 continue
-                            for key, _v in g.block_manager.rc.items(b""):
-                                g.block_manager.resync.put_to_resync(
-                                    Hash(key[:32]), 0.0)
+                            g.bg.spawn(RepairWorker(
+                                g.block_manager, refs_only=True))
         heal_s = time.perf_counter() - t0
         out = {
             "degraded_gibs": round(lost / heal_s / 2**30, 4),

@@ -483,23 +483,81 @@ class ScrubWorker(Worker):
             pass
 
 
+class LayoutSweepMarker(Migrated):
+    """Ring-assignment digest persisted AFTER a layout sweep completes: a
+    node that crashed mid-sweep (or was down for the layout change
+    entirely) finds a stale digest at startup and re-sweeps — without
+    this, gained assignments would hold holes until the next unrelated
+    ring change."""
+
+    VERSION_MARKER = b"GT01lsweep"
+
+    def __init__(self, digest: bytes = b""):
+        self.digest = digest
+
+    def fields(self):
+        return [self.digest]
+
+    @classmethod
+    def from_fields(cls, body):
+        return cls(bytes(body[0]))
+
+
 class RepairWorker(Worker):
     """One-shot consistency repair (ref repair.rs:35-155): phase 1 enqueues
     every referenced hash to resync; phase 2 walks the disk and enqueues
-    every found block (catches rc=0 leftovers)."""
+    every found block (catches rc=0 leftovers).
 
-    def __init__(self, manager):
+    refs_only=True runs phase 1 alone — the shape used by the automatic
+    layout-change sweep (spawned on every ring change): a ring change by
+    itself fires no table hook, so a node that GAINED the assignment for
+    an already-referenced block (rc>0, no 0→1 incref) would otherwise
+    hold a hole until an operator ran `repair blocks`.  The reference
+    leaves this to the operator; the sweep makes post-failure healing
+    self-driven.  restart() rewinds a still-running sweep instead of
+    stacking a second one (ring changes arrive in bursts as a layout
+    propagates); on_done fires once when the sweep completes (the model
+    layer persists the swept ring digest there)."""
+
+    def __init__(self, manager, refs_only: bool = False, on_done=None):
         self.manager = manager
+        self.refs_only = refs_only
+        self.on_done = on_done
         self.phase = 1
         self.cursor: Optional[bytes] = b""
         self.iterator: Optional[BlockStoreIterator] = None
+        self.finished = False
+
+    def restart(self) -> None:
+        self.phase = 1
+        self.cursor = b""
+        self.iterator = None
+
+    def _done(self) -> WorkerState:
+        self.finished = True
+        if self.on_done is not None:
+            try:
+                self.on_done()
+            except Exception:
+                # e.g. marker persistence hitting disk-full: the sweep
+                # itself succeeded, but the node will re-sweep at next
+                # boot — say so instead of hiding the degradation
+                logger.warning("repair worker on_done callback failed",
+                               exc_info=True)
+        return WorkerState.DONE
 
     def name(self) -> str:
-        return "Block repair worker"
+        return "Block layout sweep" if self.refs_only else "Block repair worker"
 
     async def work(self) -> WorkerState:
         mgr = self.manager
         if self.phase == 1:
+            # phase 1 is pure CPU (db iteration) and the worker runner
+            # re-invokes BUSY workers back-to-back: yield the event loop
+            # once per batch or a large rc table freezes RPC/S3 handling
+            # for the whole scan — worst exactly when a layout change
+            # just made the cluster fragile
+            await asyncio.sleep(0)
             batch = 0
             while batch < REPAIR_BATCH:
                 nxt = (
@@ -508,6 +566,8 @@ class RepairWorker(Worker):
                     else mgr.rc.get_gt(self.cursor)
                 )
                 if nxt is None:
+                    if self.refs_only:
+                        return self._done()
                     self.phase = 2
                     self.iterator = BlockStoreIterator(
                         [d.path for d in mgr.data_layout.data_dirs]
@@ -521,7 +581,7 @@ class RepairWorker(Worker):
             return WorkerState.BUSY
         batch = await asyncio.to_thread(self.iterator.next_prefix)
         if batch is None:
-            return WorkerState.DONE
+            return self._done()
         for h, _path, _c in batch:
             mgr.resync.put_to_resync(h, 0.0)
         self.status().progress = f"phase 2: {self.iterator.progress() * 100:.1f}%"

@@ -283,6 +283,45 @@ class Garage:
             ),
         )
         self.bg.spawn(self.scrub_worker)
+        # Automatic post-layout-change block sweep: a ring change fires no
+        # table hook, so a node that gained the data assignment for an
+        # already-referenced block (rc>0 — no 0→1 incref will ever come)
+        # would hold a hole until an operator ran `repair blocks`.  The
+        # refs-only RepairWorker re-enqueues every referenced hash; the
+        # resync logic then fetches gained blocks / offloads lost ones.
+        # Debounced: a sweep still in flight is rewound, not duplicated
+        # (layout propagation delivers several ring changes in a burst).
+        # The swept ring digest persists ON COMPLETION: a node that was
+        # down for the change (its boot merge sees changed=False, so no
+        # callback ever fires) or crashed mid-sweep finds a stale marker
+        # here and re-sweeps at startup.
+        from ..block.repair import LayoutSweepMarker
+
+        self._layout_sweep = None
+        self._layout_sweep_wid = None
+        self._sweep_persister = Persister(
+            self.config.metadata_dir, "layout_sweep", LayoutSweepMarker)
+
+        def _spawn_sweep():
+            if self._layout_sweep is not None and \
+                    not self._layout_sweep.finished:
+                self._layout_sweep.restart()
+                return
+            if self._layout_sweep_wid is not None:
+                # recurring one-shot: drop the previous completed sweep's
+                # registry entry or they accumulate across layout changes
+                self.bg.reap(self._layout_sweep_wid)
+            self._layout_sweep = RepairWorker(
+                self.block_manager, refs_only=True,
+                on_done=lambda: self._sweep_persister.save(
+                    LayoutSweepMarker(self.system.ring.digest())),
+            )
+            self._layout_sweep_wid = self.bg.spawn(self._layout_sweep)
+
+        self.system.on_ring_change(lambda _ring: _spawn_sweep())
+        marker = self._sweep_persister.load()
+        if self.system.ring.digest() != (marker.digest if marker else b""):
+            _spawn_sweep()
         self.bg_vars.register_rw(
             "resync-tranquility",
             lambda: self.block_resync.tranquility,

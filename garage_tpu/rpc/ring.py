@@ -69,6 +69,20 @@ class Ring:
         else:
             self._partitions = [[] for _ in range(N_PARTITIONS)]
 
+    def digest(self) -> bytes:
+        """16-byte digest of the effective partition assignment — stable
+        across re-decodes of the same layout, changed by any assignment
+        change.  Used by the layout-sweep marker (block/repair.py) to
+        detect ring changes a node missed while down."""
+        import hashlib
+
+        h = hashlib.blake2s(digest_size=16)
+        for nodes in self._partitions:
+            for n in nodes:
+                h.update(bytes(n))
+            h.update(b"|")
+        return h.digest()
+
     @property
     def ready(self) -> bool:
         return bool(self._partitions[0])

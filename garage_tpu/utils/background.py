@@ -108,6 +108,18 @@ class BackgroundRunner:
         )
         return wid
 
+    def reap(self, wid: int) -> bool:
+        """Drop a COMPLETED worker's registry entries.  Recurring one-shot
+        spawns (the automatic layout sweep respawns on every ring change)
+        would otherwise accumulate dead workers/tasks for the daemon's
+        lifetime.  Refuses while the task is still running."""
+        t = self.tasks.get(wid)
+        if t is not None and not t.done():
+            return False
+        self.tasks.pop(wid, None)
+        self.workers.pop(wid, None)
+        return True
+
     async def _run_worker(self, wid: int, worker: Worker) -> None:
         status = worker.status()
         while not self.stopping.is_set():

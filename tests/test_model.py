@@ -1211,3 +1211,157 @@ async def test_scrub_refreshes_lost_distributed_coverage(tmp_path):
         assert rec == datas[0]
     finally:
         await shutdown(garages)
+
+
+async def test_ring_change_sweep_heals_gained_assignment(tmp_path):
+    """A node that GAINS the data assignment for a block whose refs it
+    ALREADY holds (rc>0 — no 0→1 incref will ever fire, and no table row
+    changes on it) must fetch the block automatically after a layout
+    change.  With the previous holder CRASHED there is no pusher either:
+    the refs-only layout sweep spawned by on_ring_change
+    (model/garage.py spawn_workers) is the only trigger.  Before the
+    sweep existed this healed only via operator `repair blocks` (the
+    bench's degraded phase papered over it with manual resync kicks)."""
+    from garage_tpu.rpc.layout import ClusterLayout, NodeRole
+
+    # 4 nodes: meta "3" (ref rows live on 3 of 4 nodes), data "2"
+    garages = []
+    for i in range(4):
+        cfg = config_from_dict({
+            "metadata_dir": str(tmp_path / f"n{i}" / "meta"),
+            "data_dir": str(tmp_path / f"n{i}" / "data"),
+            "replication_mode": "3",
+            "data_replication_mode": "2",
+            "rpc_bind_addr": "127.0.0.1:0",
+            "rpc_secret": "sweep-test",
+            "db_engine": "memory",
+            "bootstrap_peers": [],
+        })
+        g = Garage(cfg)
+        await g.system.netapp.listen("127.0.0.1:0")
+        garages.append(g)
+    ports = [g.system.netapp._server.sockets[0].getsockname()[1]
+             for g in garages]
+    for i, a in enumerate(garages):
+        for j, b in enumerate(garages):
+            if i < j:
+                await a.system.netapp.connect(
+                    f"127.0.0.1:{ports[j]}", expected_id=b.system.id)
+        a.config.rpc_public_addr = f"127.0.0.1:{ports[i]}"
+    lay = garages[0].system.layout
+    for g in garages:
+        lay.stage_role(bytes(g.system.id), NodeRole("dc1", 1000))
+    lay.apply_staged_changes()
+    enc = lay.encode()
+    for g in garages:
+        g.system.layout = ClusterLayout.decode(enc)
+        g.system._rebuild_ring()
+    for g in garages:
+        g.spawn_workers()
+
+    ids = [bytes(g.system.id) for g in garages]
+    dead: set = set()
+
+    def by_id(nid):
+        return garages[ids.index(bytes(nid))]
+
+    try:
+        await _sweep_heal_body(garages, ids, by_id, dead)
+    finally:
+        for i, g in enumerate(garages):
+            if i not in dead:
+                try:
+                    await g.shutdown()
+                except Exception:
+                    pass
+
+
+async def _sweep_heal_body(garages, ids, by_id, dead):
+    import os as _os
+
+    from garage_tpu.rpc.layout import ClusterLayout
+    from garage_tpu.testing.faults import FaultInjector
+
+    # find a block + victim choice where, after the victim's removal,
+    # some node GAINS the data assignment while already holding the refs
+    ring0 = garages[0].system.ring
+    pick = None
+    for seed in range(64):
+        data = bytes([seed]) + _os.urandom(4999)
+        h = Hash(blake2s_sum(data))
+        pre = [bytes(n) for n in ring0.get_nodes(h, 2)]
+        meta = [bytes(n) for n in ring0.get_nodes(h, 3)]
+        victim_id = pre[0]
+        lay2 = ClusterLayout.decode(garages[0].system.layout.encode())
+        lay2.stage_role(victim_id, None)
+        lay2.apply_staged_changes()
+        from garage_tpu.rpc.ring import Ring
+        post = [bytes(n) for n in Ring(lay2).get_nodes(h, 2)]
+        gained = [n for n in post if n not in pre]
+        # beneficiary must have held the refs BEFORE the change
+        if gained and gained[0] in meta and gained[0] != victim_id:
+            pick = (data, h, victim_id, gained[0], lay2.encode())
+            break
+    assert pick is not None, "no suitable (block, victim) found in 64 tries"
+    data, h, victim_id, gain_id, new_layout = pick
+
+    # seed object/version/refs through node 0 (hook chain populates
+    # block_ref + rc on the meta replicas)
+    await garages[0].block_manager.rpc_put_block(h, data)
+    bucket_id = gen_uuid()
+    vu = gen_uuid()
+    ver = Version.new(vu, bytes(bucket_id), "obj")
+    ver.add_block(0, 0, bytes(h), len(data))
+    await garages[0].version_table.insert(ver)
+    await garages[0].object_table.insert(
+        Object(bucket_id, "obj", [complete_version(vu, 100, b"x")]))
+
+    gainer = by_id(gain_id)
+    for _ in range(100):
+        rc = gainer.block_manager.rc.get(h)
+        if rc is not None and rc.is_needed():
+            break
+        await asyncio.sleep(0.1)
+    rc = gainer.block_manager.rc.get(h)
+    assert rc is not None and rc.is_needed(), \
+        "precondition: beneficiary must hold refs before the layout change"
+    assert not gainer.block_manager.is_block_present(h), \
+        "precondition: beneficiary must not hold the block yet"
+
+    # Drain the beneficiary's seed-time resync entry (the 0→1 incref
+    # queued a 2 s check; while unassigned it is a dropped no-op) BEFORE
+    # the layout change — otherwise that timer, not the sweep, heals the
+    # block and this test would pass with the sweep disabled.
+    for _ in range(100):
+        if gainer.block_resync.queue_len() == 0 and \
+                not gainer.block_resync.busy_set:
+            break
+        await asyncio.sleep(0.25)
+    await asyncio.sleep(3.0)
+    for _ in range(100):
+        if gainer.block_resync.queue_len() == 0 and \
+                not gainer.block_resync.busy_set:
+            break
+        await asyncio.sleep(0.25)
+    assert gainer.block_resync.queue_len() == 0
+    assert not gainer.block_manager.is_block_present(h), \
+        "block appeared before the layout change?!"
+
+    # crash the victim (abrupt — no pusher), then apply the new layout
+    inj = FaultInjector(garages)
+    await inj.crash(ids.index(victim_id))
+    dead.update(inj.dead)
+    for i, g in enumerate(garages):
+        if i == ids.index(victim_id):
+            continue
+        g.system.layout = ClusterLayout.decode(new_layout)
+        g.system._rebuild_ring()  # fires the refs-only layout sweep
+
+    # the sweep + resync must fetch the block from the surviving holder
+    # with NO manual resync kick
+    for _ in range(240):
+        if gainer.block_manager.is_block_present(h):
+            break
+        await asyncio.sleep(0.25)
+    assert gainer.block_manager.is_block_present(h), \
+        "layout sweep did not heal the gained assignment"
