@@ -299,6 +299,7 @@ class Garage:
 
         self._layout_sweep = None
         self._layout_sweep_wid = None
+        self._sweep_reap_backlog: list = []
         self._sweep_persister = Persister(
             self.config.metadata_dir, "layout_sweep", LayoutSweepMarker)
 
@@ -309,8 +310,17 @@ class Garage:
                 return
             if self._layout_sweep_wid is not None:
                 # recurring one-shot: drop the previous completed sweep's
-                # registry entry or they accumulate across layout changes
-                self.bg.reap(self._layout_sweep_wid)
+                # registry entry or they accumulate across layout changes.
+                # reap() can refuse in the narrow window where the sweep
+                # set finished=True but its runner task hasn't returned
+                # yet (advisor r4) — keep refused wids in a backlog and
+                # retry them on every later spawn instead of leaking.
+                self._sweep_reap_backlog.append(self._layout_sweep_wid)
+                self._layout_sweep_wid = None
+            self._sweep_reap_backlog = [
+                wid for wid in self._sweep_reap_backlog
+                if not self.bg.reap(wid)
+            ]
             self._layout_sweep = RepairWorker(
                 self.block_manager, refs_only=True,
                 on_done=lambda: self._sweep_persister.save(

@@ -191,6 +191,15 @@ class TpuCodec(BlockCodec):
         self._pallas_cache = {}
         self._pallas_ok = True
         self._pallas_transient_fails = 0
+        # Pallas fused scrub (blake2s hash state resident in VMEM across
+        # chunks + Pallas GF parity): 117 GiB/s at 1024 lanes on v5e vs
+        # the XLA scan's 4.3 (scripts/blake2s_tune.py, slope-timed on
+        # the real chip) — the scan was bound by per-chunk state
+        # round-trips through HBM.  Separate latch from the GF kernel;
+        # same permanent/transient demotion policy.
+        self._pallas_fused_ok = True
+        self._pallas_fused_fails = 0
+        self._scrub_pallas_jit = None
         self.mesh = None
         if params.shard_mesh > 1:
             devs = (devices or jax.devices())[: params.shard_mesh]
@@ -447,6 +456,60 @@ class TpuCodec(BlockCodec):
         )
         return arr, lengths, expected
 
+    def _scrub_pallas(self):
+        """The fused scrub jit with BOTH hot ops as Pallas kernels: the
+        VMEM-resident blake2s (pallas_blake2s.py) and the GF mask-XOR
+        apply (pallas_gf.py) when the latter's latch is up."""
+        if self._scrub_pallas_jit is None:
+            from .pallas_blake2s import blake2s_batch_pallas
+
+            pg = self._pallas_for(self._enc_mat)
+
+            def fused(data_u8, lengths, expected, K_enc, k):
+                h = blake2s_batch_pallas(data_u8, lengths)
+                ok = jnp.all(h == expected, axis=-1)
+                bad = jnp.sum(~ok, dtype=jnp.int32)
+                u32 = bytes_view_u32(data_u8)
+                groups = u32.reshape(u32.shape[0] // k, k, u32.shape[-1])
+                if pg is not None:
+                    parity = u32_view_bytes(pg(groups))
+                else:
+                    parity = u32_view_bytes(gf_apply(groups, K_enc))
+                return h, ok, bad, parity
+
+            self._scrub_pallas_jit = jax.jit(fused, static_argnums=(4,))
+        return self._scrub_pallas_jit
+
+    def _use_pallas_scrub(self, nlanes: int) -> bool:
+        """The Pallas fused scrub wants whole (…,128)-lane tiles; smaller
+        padded batches (deque tails) run the XLA variant instead of
+        paying a 2-16x lane pad across a metered link."""
+        return (self._pallas_fused_ok and self.mesh is None
+                and nlanes % 128 == 0)
+
+    def _note_fused_failure(self, e: BaseException) -> None:
+        import logging
+
+        log = logging.getLogger("garage_tpu.ops")
+        if _pallas_error_is_permanent(e):
+            log.warning(
+                "pallas fused scrub unsupported on this backend "
+                "(permanent); using the XLA kernels", exc_info=True)
+            self._pallas_fused_ok = False
+        else:
+            self._pallas_fused_fails += 1
+            if self._pallas_fused_fails >= PALLAS_MAX_TRANSIENT_FAILS:
+                log.warning(
+                    "pallas fused scrub failed %d consecutive times; "
+                    "demoting to the XLA kernels",
+                    self._pallas_fused_fails, exc_info=True)
+                self._pallas_fused_ok = False
+            else:
+                log.warning(
+                    "pallas fused scrub transient failure (%d/%d); "
+                    "will retry", self._pallas_fused_fails,
+                    PALLAS_MAX_TRANSIENT_FAILS, exc_info=True)
+
     def scrub_submit(self, blocks: Sequence[bytes], hashes: Sequence[Hash]):
         """Enqueue one group's fused verify+encode WITHOUT synchronizing.
 
@@ -474,6 +537,15 @@ class TpuCodec(BlockCodec):
             jax.ShapeDtypeStruct((bsz, 8), jnp.uint32),
             jax.ShapeDtypeStruct(self._K_enc.shape, self._K_enc.dtype),
         )
+        if self._use_pallas_scrub(bsz):
+            try:
+                self._scrub_pallas().lower(*shapes, k).compile()
+            except Exception as e:
+                self._note_fused_failure(e)
+        # ALWAYS warm the XLA variant too: it is the runtime fallback
+        # when a Pallas dispatch fails transiently, and a cold fallback
+        # means a multi-second mid-pass compile on a remote backend —
+        # exactly what warm() exists to prevent
         self._scrub_jit.lower(*shapes, k).compile()
 
     def scrub_encode_submit(self, arr: np.ndarray, lengths: np.ndarray,
@@ -484,6 +556,17 @@ class TpuCodec(BlockCodec):
         the accelerator sits behind a high-latency tunnel)."""
         assert arr.shape[0] % self.params.rs_data == 0
         assert arr.shape[1] % 4 == 0
+        if self._use_pallas_scrub(arr.shape[0]):
+            try:
+                out = self._scrub_pallas()(
+                    jnp.asarray(arr), jnp.asarray(lengths),
+                    jnp.asarray(expected), self._K_enc,
+                    self.params.rs_data,
+                )
+                self._pallas_fused_fails = 0
+                return out
+            except Exception as e:
+                self._note_fused_failure(e)
         return self._scrub_jit(
             jnp.asarray(arr), jnp.asarray(lengths), jnp.asarray(expected),
             self._K_enc, self.params.rs_data,

@@ -77,12 +77,7 @@ def main() -> None:
     params = CodecParams(rs_data=8, rs_parity=4, batch_blocks=bench.BATCH)
     codec = HybridCodec(params)  # sync build: the caller just probed OK
     codec.warm(bench.BLOCK)
-    device_gibs, pallas_gibs, xla_gibs = bench.bench_device_resident(codec)
-    rec.update({
-        "device_gibs": round(device_gibs, 4),
-        "pallas_gf_gibs": round(pallas_gibs, 4),
-        "xla_gf_gibs": round(xla_gibs, 4),
-    })
+    rec.update(bench.bench_device_resident(codec))
 
     # hybrid window for a live tpu_frac sample: the full 2 GiB bench
     # stream — short windows (256 MiB, ~0.2 s) end before the device

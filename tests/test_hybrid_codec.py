@@ -272,7 +272,7 @@ def test_hybrid_feeder_merges_groups_into_wide_submissions():
     # submit MERGED multi-group batches (device_batch_blocks wide), not the
     # CPU-cache-sized stealing quantum.  A slow-ish device ensures the
     # deque is deep when the feeder grabs its first merge.
-    p = _params(batch_blocks=32)          # group=8 → merges up to 4 groups
+    p = _params(device_batch_blocks=32)   # group=8 → merges up to 4 groups
     dev = _RecordingDevice(p, delay=0.02)
     hy = HybridCodec(p, device_codec=dev)
     assert hy.device_batch_blocks == 32
