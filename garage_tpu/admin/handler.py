@@ -10,6 +10,7 @@ msgpack dicts {"cmd": ..., ...} on the "garage/admin" endpoint.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 from ..model.permission import BucketKeyPerm
@@ -390,6 +391,43 @@ class AdminRpcHandler:
             st = w.status()
             out.append({"id": wid, "name": w.name(), **st.to_dict()})
         return out
+
+    async def _cmd_worker_info(self, msg) -> Dict:
+        """Single-worker drill-down (ref src/garage/admin/mod.rs:47-66
+        WorkerInfo + cli worker info): full status incl. last error with
+        its timestamp, queue depth, progress, and the runtime-tunable
+        values that apply to this worker."""
+        wid = int(msg["id"])
+        w = self.garage.bg.workers.get(wid)
+        if w is None:
+            raise GarageError(f"no worker with id {wid}")
+        st = w.status()
+        task = self.garage.bg.tasks.get(wid)
+        vars_all = self.garage.bg_vars.all()
+        name_l = w.name().lower()
+        # tunables whose name shares a DISTINCTIVE word with the
+        # worker's name (e.g. scrub-tranquility for the scrub worker) —
+        # the reference shows the worker's parameter set in `worker
+        # info`.  Generic tokens are excluded: 'worker' appears in
+        # every worker's name and would attach e.g.
+        # resync-worker-count to all of them.
+        generic = {"worker", "workers", "count", "n", "max", "min"}
+        related = {
+            k: v for k, v in vars_all.items()
+            if any(part and part not in generic and part in name_l
+                   for part in k.split("-"))
+        }
+        return {
+            "id": wid,
+            "name": w.name(),
+            "alive": task is not None and not task.done(),
+            **st.to_dict(),
+            "last_error_time": st.last_error_time or None,
+            "last_error_ago_s": (
+                round(time.time() - st.last_error_time, 1)
+                if st.last_error_time else None),
+            "tunables": related,
+        }
 
     async def _cmd_worker_get_var(self, msg) -> Dict:
         if msg.get("var"):

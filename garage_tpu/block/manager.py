@@ -29,6 +29,7 @@ from ..db import Db
 from ..net.frame import PRIO_BACKGROUND, PRIO_NORMAL
 from ..rpc.system import System
 from ..utils.data import Hash, block_hash
+from ..utils.direct_io import write_file_direct
 from ..utils.error import CorruptData, GarageError, NoSuchBlock
 from ..utils.metrics import maybe_time
 from ..utils.persister import Persister
@@ -217,11 +218,12 @@ class BlockManager:
         d = os.path.dirname(final)
         os.makedirs(d, exist_ok=True)
         tmp = final + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data.inner)
-            if self.data_fsync:
-                f.flush()
-                os.fsync(f.fileno())
+        # O_DIRECT (buffered fallback inside): ~4x less CPU than the
+        # page-cache copy and immune to dirty-page throttling, so
+        # concurrent puts overlap their writes on a 1-core host; the
+        # bulk of the block is on media at return even with
+        # data_fsync=false (see utils/direct_io.py)
+        write_file_direct(tmp, data.inner, fsync=self.data_fsync)
         os.replace(tmp, final)
         if self.data_fsync:
             # fsync the directory so the rename is durable (manager.rs:760-775)
@@ -475,6 +477,34 @@ class BlockManager:
                 errors.append(f"{bytes(node).hex()[:8]}: {e}")
                 if meta_out is not None and delivered > 0:
                     meta_out["raw_chunks"] = None  # stitched: frames mixed
+        # LAST RESORT, only from a clean start (stitching decoded bytes
+        # after a partial replica stream would need offset bookkeeping
+        # for no real case): every replica failed — decode the block
+        # from the distributed RS parity RIGHT NOW so the client's read
+        # succeeds, and requeue a resync so the copy is re-materialized
+        # (the reference's only answer here is "another replica",
+        # ref manager.rs:231-317; erasure coverage is this framework's
+        # addition)
+        if delivered == 0 and self.parity_reconstructor is not None:
+            try:
+                data = await self.parity_reconstructor(h)
+            except Exception as e:  # noqa: BLE001 — degraded decode
+                errors.append(f"parity-decode: {e}")
+                data = None
+            if data is not None:
+                logger.info("served block %s via distributed RS decode "
+                            "(all replicas failed)", bytes(h).hex()[:16])
+                self.blocks_reconstructed += 1
+                if meta_out is not None:
+                    meta_out["parity"] = False
+                    meta_out["compressed"] = False
+                    meta_out["raw_chunks"] = None
+                if self.resync is not None:
+                    self.resync.put_to_resync(h, 0.0)
+                self.bytes_read += len(data)
+                for i in range(0, len(data), STREAM_CHUNK):
+                    yield data[i:i + STREAM_CHUNK]
+                return
         raise GarageError(
             f"could not stream block {bytes(h).hex()[:16]} from any node "
             f"(delivered {delivered} bytes): {errors}"
@@ -604,6 +634,18 @@ class BlockManager:
             try:
                 block = await self.read_block(h)
             except (NoSuchBlock, CorruptData) as e:
+                # a serving miss is a REPAIR SIGNAL: if this node is
+                # assigned the block and its refs say it should exist, a
+                # silently-vanished file (disk mishap — nothing walked
+                # it since the scrub walker only sees files that exist)
+                # would otherwise stay lost until the next offline
+                # repair; the resync chain (replica fetch → peer sweep →
+                # RS decode) knows how to rebuild it
+                if (self.resync is not None
+                        and self.rc.get(h).is_needed()
+                        and self.is_assigned(h)
+                        and not self.is_block_present(h)):
+                    self.resync.put_to_resync(h, 0.0)
                 return {"err": str(e)}, None
             hdr = {"hdr": block.header().pack()}
             if self.is_parity_block(h):

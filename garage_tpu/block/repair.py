@@ -619,11 +619,13 @@ class RebalanceWorker(Worker):
 
 
 def _try_read(path: str) -> Optional[bytes]:
-    try:
-        with open(path, "rb") as f:
-            return f.read()
-    except OSError:
-        return None
+    """Scrub read: O_DIRECT (buffered fallback inside) — the buffered
+    path is kernel-CPU-bound on 1-core hosts (reads would steal the
+    core from the verify codec) and scrubbing through the page cache
+    evicts the GET path's working set.  See utils/direct_io.py."""
+    from ..utils.direct_io import try_read_direct
+
+    return try_read_direct(path)
 
 
 def _try_decompress(raw: bytes) -> Optional[bytes]:

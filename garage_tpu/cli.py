@@ -151,6 +151,8 @@ def _build_parser() -> argparse.ArgumentParser:
     pw = sub.add_parser("worker", help="background worker operations")
     ws = pw.add_subparsers(dest="worker_cmd", required=True)
     ws.add_parser("list")
+    wi = ws.add_parser("info", help="single-worker drill-down")
+    wi.add_argument("id", type=int)
     wg = ws.add_parser("get")
     wg.add_argument("var", nargs="?", default=None)
     wst = ws.add_parser("set")
@@ -460,6 +462,21 @@ async def _amain(args) -> None:
                     f"\t{w['queue_length'] if w['queue_length'] is not None else '-'}"
                     f"\t{w['progress'] or '-'}"
                 )
+            print(format_table(rows))
+        elif wc == "info":
+            w = await client.call({"cmd": "worker_info", "id": args.id})
+            rows = ["FIELD\tVALUE"]
+            order = ["id", "name", "alive", "state", "errors",
+                     "consecutive_errors", "last_error",
+                     "last_error_ago_s", "tranquility", "progress",
+                     "queue_length", "persistent_errors"]
+            for k in order:
+                v = w.get(k)
+                rows.append(f"{k}\t{v if v is not None else '-'}")
+            for line in w.get("freeform") or []:
+                rows.append(f"note\t{line}")
+            for k, v in (w.get("tunables") or {}).items():
+                rows.append(f"tunable:{k}\t{v}")
             print(format_table(rows))
         elif wc == "get":
             print(json.dumps(await client.call(

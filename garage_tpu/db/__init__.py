@@ -220,7 +220,9 @@ def open_db(engine: str, path: Optional[str] = None, **kw) -> Db:
     if engine in ("memory", "mem"):
         from .memory_adapter import MemoryDb
 
-        return Db(MemoryDb())
+        # with a path: the durable third engine (snapshot + WAL, the
+        # reference's sled slot); without: RAM-only (tests/ephemeral)
+        return Db(MemoryDb(path=path, **kw))
     if engine in ("native", "logdb"):
         from .native_adapter import NativeDb
 

@@ -28,7 +28,11 @@ from . import IDb, Transaction, TxAbort
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(__file__)), "native"
 )
-_SO_PATH = os.path.join(_NATIVE_DIR, "liblogdb.so")
+# GARAGE_NATIVE_SUFFIX=.asan/.tsan → sanitizer-instrumented variant
+# (make asan/tsan in native/; run under the matching LD_PRELOAD)
+_SO_NAME = "liblogdb{}.so".format(
+    os.environ.get("GARAGE_NATIVE_SUFFIX", ""))
+_SO_PATH = os.path.join(_NATIVE_DIR, _SO_NAME)
 
 _lib = None
 _lib_err: Optional[str] = None
@@ -46,8 +50,11 @@ def _load() -> ctypes.CDLL:
         # stale or missing binary (e.g. built on another host with
         # -march=native): one rebuild attempt
         try:
+            target = ("asan" if _SO_NAME.endswith(".asan.so")
+                      else "tsan" if _SO_NAME.endswith(".tsan.so")
+                      else "liblogdb.so")
             subprocess.run(
-                ["make", "-C", _NATIVE_DIR, "-s", "liblogdb.so"],
+                ["make", "-C", _NATIVE_DIR, "-s", target],
                 check=True, capture_output=True, timeout=120,
             )
             lib = ctypes.CDLL(_SO_PATH)

@@ -74,13 +74,18 @@ class Garage:
             self.db = db
         else:
             is_native = config.db_engine in ("native", "logdb")
-            kw = {"fsync": config.metadata_fsync} if is_native else {}
+            is_memory = config.db_engine in ("memory", "mem")
+            kw = ({"fsync": config.metadata_fsync}
+                  if (is_native or is_memory) else {})
+            # the memory engine is DURABLE when the daemon opens it
+            # (snapshot + WAL under metadata_dir — the sled slot);
+            # RAM-only remains available to tests via open_db("memory")
+            # with no path
+            fname = ("db.logdb" if is_native
+                     else "db.mem" if is_memory else "db.sqlite")
             self.db = open_db(
                 config.db_engine,
-                path=os.path.join(
-                    config.metadata_dir,
-                    "db.logdb" if is_native else "db.sqlite",
-                ),
+                path=os.path.join(config.metadata_dir, fname),
                 **kw,
             )
 

@@ -19,6 +19,7 @@ the reference's 3× storage tolerating 2.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 import time
 from typing import Optional
@@ -27,6 +28,12 @@ import numpy as np
 
 from ..utils.background import Worker
 from ..utils.data import Hash, block_hash
+
+# True while the current task context is inside a distributed RS decode
+# — piece fetches must not recurse into another decode (see
+# make_parity_reconstructor)
+IN_PARITY_DECODE: contextvars.ContextVar = contextvars.ContextVar(
+    "garage_tpu_in_parity_decode", default=False)
 
 logger = logging.getLogger("garage_tpu.model.parity_repair")
 
@@ -293,6 +300,24 @@ def make_parity_reconstructor(garage):
     manager as `parity_reconstructor`)."""
 
     async def reconstruct(h: Hash) -> Optional[bytes]:
+        # Reentrancy guard: fetching codeword PIECES goes through the
+        # same block-read paths that fall back to THIS reconstructor
+        # when all replicas fail (block/manager.py streaming read).
+        # Without the guard a cluster missing several pieces recurses
+        # decode→fetch→decode→… until RecursionError (caught by the
+        # chaos soak at ~640 frames).  contextvars propagate into tasks
+        # spawned by the decode's gathers, so the ENTIRE fetch subtree
+        # of one decode skips further decode attempts; sibling decodes
+        # in other request contexts are unaffected.
+        if IN_PARITY_DECODE.get():
+            return None
+        token = IN_PARITY_DECODE.set(True)
+        try:
+            return await _reconstruct_inner(h)
+        finally:
+            IN_PARITY_DECODE.reset(token)
+
+    async def _reconstruct_inner(h: Hash) -> Optional[bytes]:
         try:
             entries = await garage.parity_index_table.get_range(
                 bytes(h), None, limit=INDEX_SCAN_LIMIT)

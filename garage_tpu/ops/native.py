@@ -35,9 +35,21 @@ def _load_or_build(so_name: str, src_name: str) -> Optional[ctypes.CDLL]:
     host — the Makefile uses -march=native) triggers one clean rebuild.
     A failed build writes a marker keyed on the source mtime so this exact
     source is never re-attempted."""
+    # GARAGE_NATIVE_SUFFIX=.asan/.tsan selects the sanitizer-
+    # instrumented variants built by `make asan`/`make tsan` (run the
+    # tests under the matching LD_PRELOAD — see native/Makefile)
+    suffix = os.environ.get("GARAGE_NATIVE_SUFFIX", "")
+    # sanitizer variants are built by the PHONY asan/tsan targets (the
+    # per-.so rules only exist for the plain builds), and their build
+    # failures must not poison the plain build's marker (or vice versa)
+    make_target = so_name
+    if suffix:
+        so_name = so_name.replace(".so", f"{suffix}.so")
+        make_target = suffix.lstrip(".")
     so_path = os.path.join(_NATIVE_DIR, so_name)
     src_path = os.path.join(_NATIVE_DIR, src_name)
-    fail_marker = os.path.join(_NATIVE_DIR, f".build_failed_{src_name}")
+    fail_marker = os.path.join(_NATIVE_DIR,
+                               f".build_failed_{src_name}{suffix}")
     with _BUILD_LOCK:
         src_mtime = os.path.getmtime(src_path)
         fresh = os.path.exists(so_path) and os.path.getmtime(so_path) >= src_mtime
@@ -52,7 +64,7 @@ def _load_or_build(so_name: str, src_name: str) -> Optional[ctypes.CDLL]:
             return None
         try:
             subprocess.run(
-                ["make", "-C", _NATIVE_DIR, "-s", "-B", so_name],
+                ["make", "-C", _NATIVE_DIR, "-s", "-B", make_target],
                 check=True, capture_output=True, timeout=120,
             )
             return ctypes.CDLL(so_path)

@@ -113,6 +113,43 @@ def _apply_flat(x, consts, k: int, r: int, tile: int, interpret: bool):
     )(x, consts)
 
 
+def _kernel_batched(k: int, r: int, x_ref, consts_ref, o_ref):
+    """Batched variant of _kernel: blocks carry a leading size-1
+    codeword axis so the grid walks codewords DIRECTLY in the (B, k,
+    S4) layout — a codeword's k rows are contiguous there, eliminating
+    the fold-into-columns transpose of the flat path (which cost a full
+    HBM round-trip of the batch on the fused scrub path)."""
+    one = jnp.uint32(0x01010101)
+    accs = [jnp.zeros_like(x_ref[0, 0, ...]) for _ in range(r)]
+    for i in range(k):
+        xi = x_ref[0, i, ...]
+        for b in range(8):
+            m1 = (xi >> jnp.uint32(b)) & one
+            for p in range(r):
+                accs[p] = accs[p] ^ (m1 * consts_ref[p, i, b])
+    for p in range(r):
+        o_ref[0, p, ...] = accs[p]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "r", "tile", "interpret"))
+def _apply_batched(x, consts, k: int, r: int, tile: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    b, _k, s4 = x.shape
+    grid = (b, s4 // tile)
+    return pl.pallas_call(
+        functools.partial(_kernel_batched, k, r),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, k, tile), lambda c, j: (c, 0, j)),
+            pl.BlockSpec((r, k, 8), lambda c, j: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, r, tile), lambda c, j: (c, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((b, r, s4), jnp.uint32),
+        interpret=interpret,
+    )(x, consts)
+
+
 class PallasGf:
     """Callable (B, k, S4) uint32 → (B, r, S4) uint32, same contract as
     tpu_codec.gf_apply, but one VMEM-resident Pallas dispatch per
@@ -140,9 +177,17 @@ class PallasGf:
         pad = (-s4) % tile
         if pad:
             shards_u32 = jnp.pad(shards_u32, ((0, 0), (0, 0), (0, pad)))
-        # fold the batch into the column axis: codewords are independent,
-        # and tile-aligned concatenation keeps each grid step inside one
-        # codeword's columns
+        if s4 + pad >= 2048:
+            # wide shards (the scrub/batch path): walk codewords in
+            # place — their k rows are contiguous in (B, k, S4), so no
+            # transpose touches HBM (the fold path's swapaxes cost a
+            # full round-trip of the batch)
+            out = _apply_batched(shards_u32, self.consts, self.k,
+                                 self.r, tile, self.interpret)
+            return out[..., :s4]
+        # narrow shards: fold the batch into the column axis so tiles
+        # stay full; codewords are independent, and tile-aligned
+        # concatenation keeps each grid step inside one codeword
         x = jnp.swapaxes(shards_u32, 0, 1).reshape(self.k, -1)
         out = _apply_flat(x, self.consts, self.k, self.r, tile,
                           self.interpret)

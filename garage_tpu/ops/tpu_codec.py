@@ -116,13 +116,20 @@ def gf_apply(shards_u32: jax.Array, K: jax.Array) -> jax.Array:
 
 def bytes_view_u32(x_u8: jax.Array) -> jax.Array:
     """uint8 (..., 4n) → uint32 (..., n) little-endian (byte j of each lane
-    = input byte 4i+j, matching pack order in u32_view_bytes)."""
-    b = x_u8.astype(jnp.uint32).reshape(x_u8.shape[:-1] + (-1, 4))
-    return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+    = input byte 4i+j, matching pack order in u32_view_bytes).  Bitcast:
+    a relayout, not arithmetic — see tpu_blake2s.bytes_to_words."""
+    from .tpu_blake2s import bytes_to_words
+
+    return bytes_to_words(x_u8)
 
 
 def u32_view_bytes(x_u32: jax.Array) -> jax.Array:
     """Inverse of bytes_view_u32."""
+    from .tpu_blake2s import _BITCAST_PACK
+
+    if _BITCAST_PACK:
+        out = jax.lax.bitcast_convert_type(x_u32, jnp.uint8)
+        return out.reshape(x_u32.shape[:-1] + (-1,))
     parts = jnp.stack(
         [(x_u32 >> jnp.uint32(8 * j)).astype(jnp.uint8) for j in range(4)],
         axis=-1,

@@ -164,7 +164,12 @@ class Config:
     rpc_secret: Optional[str] = None
     bootstrap_peers: List[str] = field(default_factory=list)
     db_engine: str = "sqlite"           # sqlite | native | memory (ref model/garage.rs:114-213)
-    metadata_fsync: bool = True
+    # disabled by default, matching the reference (ref util/config.rs:
+    # 20-25 "disabled by default" for both): commits reach the OS on
+    # ack (kill -9-safe, tests/test_db_torture.py); fsync=true narrows
+    # the power-loss window at ~0.6 ms per metadata commit (measured,
+    # docs/DATAPLANE_PROFILE.md — it was 22% of data-plane CPU)
+    metadata_fsync: bool = False
     data_fsync: bool = False
     s3_api_bind_addr: Optional[str] = "0.0.0.0:3900"
     s3_region: str = "garage"

@@ -1,0 +1,76 @@
+"""Torture-writer subprocess for tests/test_db_torture.py.
+
+Commits an endless stream of deterministic transactions against the
+given engine and prints "C <i>" (flushed) after commit i returns — the
+parent treats a printed line as an ACKNOWLEDGED commit, kills this
+process with SIGKILL at a random moment, and verifies recovered state
+equals state after some exact prefix >= the acked count (atomicity: a
+torn transaction must be all-or-nothing).
+
+Op stream: derived from (seed, i) only, so the parent can re-simulate
+any prefix without communication.  Key space is small (overwrites +
+removes churn dead bytes) so logdb hits compaction and the durable
+memory engine hits snapshot cycles mid-run.
+"""
+
+import random
+import sys
+
+TREES = ("alpha", "beta", "gamma")
+KEYS = 200
+
+
+def ops_for(seed: int, i: int):
+    """Deterministic op list for commit i: (tree_idx, key, value|None)."""
+    rng = random.Random((seed << 20) | i)
+    out = []
+    for _ in range(rng.randint(1, 8)):
+        t = rng.randrange(len(TREES))
+        k = f"k{rng.randrange(KEYS):04d}".encode()
+        if rng.random() < 0.25:
+            out.append((t, k, None))  # remove
+        else:
+            v = (f"v{i}-" + "x" * rng.randrange(0, 300)).encode()
+            out.append((t, k, v))
+    return out
+
+
+def simulate(seed: int, n_commits: int):
+    """State after commits [0, n_commits): list of dicts per tree."""
+    state = [dict() for _ in TREES]
+    for i in range(n_commits):
+        for t, k, v in ops_for(seed, i):
+            if v is None:
+                state[t].pop(k, None)
+            else:
+                state[t][k] = v
+    return state
+
+
+def main():
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from garage_tpu.db import open_db
+
+    engine, path, seed = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    kw = {}
+    if engine == "memory":
+        kw = {"fsync": False, "wal_snapshot_bytes": 64 << 10}
+    elif engine == "native":
+        kw = {"fsync": False}
+    db = open_db(engine, path, **kw)
+    trees = [db.open_tree(n) for n in TREES]
+    i = 0
+    while True:
+        def tx_fn(tx, i=i):
+            for t, k, v in ops_for(seed, i):
+                if v is None:
+                    tx.remove(trees[t], k)
+                else:
+                    tx.insert(trees[t], k, v)
+        db.transaction(tx_fn)
+        print(f"C {i}", flush=True)
+        i += 1
+
+
+if __name__ == "__main__":
+    main()

@@ -384,3 +384,53 @@ async def test_admin_v0_compat_and_local_alias(tmp_path):
     finally:
         await srv.stop()
         await g.shutdown()
+
+
+async def test_worker_info_drilldown(tmp_path):
+    """`worker info <id>` (ref src/garage/admin/mod.rs:47-66 + cli
+    worker info): full per-worker detail — state, error counts, LAST
+    ERROR with age, queue depth, progress, and the worker's related
+    runtime tunables."""
+    from garage_tpu.admin.handler import AdminRpcHandler
+    from garage_tpu.utils.error import GarageError
+
+    g, srv = await make_admin(tmp_path)
+    try:
+        adm = AdminRpcHandler(g, register_endpoint=False)
+        g.spawn_workers()
+        listing = await adm._cmd_worker_list({})
+        assert listing, "no workers spawned"
+        scrub = next(w for w in listing
+                     if w["name"] == "Block scrub worker")
+
+        info = await adm._cmd_worker_info({"id": scrub["id"]})
+        assert info["name"] == "Block scrub worker"
+        assert info["alive"] is True
+        assert info["state"] in ("busy", "idle", "throttled", "done")
+        assert info["errors"] == 0 and info["consecutive_errors"] == 0
+        assert info["last_error"] is None
+        assert info["last_error_ago_s"] is None
+        # ScrubWorker's tunable set includes scrub-tranquility
+        assert "scrub-tranquility" in info["tunables"]
+
+        # plant an error on the status and check the drill-down carries
+        # it with a timestamp age
+        import time as _time
+
+        w = g.bg.workers[scrub["id"]]
+        st = w.status()
+        st.last_error = "synthetic failure"
+        st.last_error_time = _time.time() - 5
+        st.errors = 3
+        info2 = await adm._cmd_worker_info({"id": scrub["id"]})
+        assert info2["last_error"] == "synthetic failure"
+        assert info2["errors"] == 3
+        assert 4 <= info2["last_error_ago_s"] <= 60
+
+        import pytest as _pytest
+
+        with _pytest.raises(GarageError):
+            await adm._cmd_worker_info({"id": 999999})
+    finally:
+        await srv.stop()
+        await g.shutdown()

@@ -12,16 +12,48 @@ from garage_tpu.db import TxAbort, open_db
 from garage_tpu.db.counted_tree import CountedTree
 
 
-@pytest.fixture(params=["memory", "sqlite", "native"])
+@pytest.fixture(params=["memory", "memory-durable", "sqlite", "native"])
 def db(request, tmp_path):
     if request.param == "sqlite":
         d = open_db("sqlite", str(tmp_path / "db.sqlite"))
     elif request.param == "native":
         d = open_db("native", str(tmp_path / "db.logdb"))
+    elif request.param == "memory-durable":
+        d = open_db("memory", str(tmp_path / "db.mem"))
     else:
         d = open_db("memory")
     yield d
     d.close()
+
+
+def test_memory_durable_survives_reopen(tmp_path):
+    # snapshot + WAL: committed state must be identical after close +
+    # reopen, including tree-id assignment and transactional groups
+    p = str(tmp_path / "db.mem")
+    d = open_db("memory", p)
+    ta, tb = d.open_tree("a"), d.open_tree("b")
+    ta.insert(b"k1", b"v1")
+    tb.insert(b"k2", b"v2")
+
+    def tx_ops(tx):
+        tx.insert(d.open_tree("a"), b"k3", b"v3")
+        tx.remove(d.open_tree("b"), b"k2")
+
+    d.transaction(tx_ops)
+    # force a snapshot cycle, then more WAL on top of it
+    d.backend._write_snapshot()
+    ta.insert(b"k4", b"v4")
+    d.close()
+
+    d2 = open_db("memory", p)
+    a2, b2 = d2.open_tree("a"), d2.open_tree("b")
+    assert a2.get(b"k1") == b"v1"
+    assert a2.get(b"k3") == b"v3"
+    assert a2.get(b"k4") == b"v4"
+    assert b2.get(b"k2") is None
+    assert len(b2) == 0 and len(a2) == 3
+    assert sorted(d2.list_trees()) == ["a", "b"]
+    d2.close()
 
 
 def test_get_insert_remove(db):

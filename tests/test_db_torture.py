@@ -1,0 +1,136 @@
+"""kill -9 crash-torture for every durable metadata engine (VERDICT r4
+#5; maturity bar: the reference's LMDB guarantees,
+ref src/db/lmdb_adapter.rs).
+
+Protocol: a writer subprocess (tests/db_torture_writer.py) commits
+deterministic transactions and acknowledges each on stdout; the parent
+SIGKILLs it at a random moment — including mid-commit-append, mid-
+logdb-compaction, and mid-memory-snapshot (the writer's configs force
+frequent compaction/snapshot cycles) — then reopens the database
+in-process and asserts:
+
+  1. no acknowledged commit is lost,
+  2. no torn state: the recovered database equals the simulated state
+     after some EXACT commit prefix (a partially-applied transaction
+     would match no prefix),
+  3. the reopened engine still works (commit one more transaction).
+
+Default 12 kills per engine (~30 s total); set GARAGE_TORTURE_ITERS
+for the hundreds-of-iterations soak (run out-of-band; results recorded
+in docs/ROUND5_NOTES.md).
+"""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from db_torture_writer import TREES, simulate
+
+ITERS = int(os.environ.get("GARAGE_TORTURE_ITERS", "12"))
+_WRITER = os.path.join(os.path.dirname(__file__), "db_torture_writer.py")
+
+
+def _dump(db):
+    out = []
+    for name in TREES:
+        t = db.open_tree(name)
+        out.append(dict(t.items()))
+    return out
+
+
+def _run_one(engine: str, path: str, seed: int, kill_after: float) -> int:
+    """Spawn writer, kill -9 after kill_after seconds, return the
+    number of ACKNOWLEDGED commits."""
+    proc = subprocess.Popen(
+        [sys.executable, _WRITER, engine, path, str(seed)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    time.sleep(kill_after)
+    proc.kill()  # SIGKILL
+    out, err = proc.communicate(timeout=60)
+    acked = 0
+    for line in out.splitlines():
+        if line.startswith("C "):
+            acked = int(line.split()[1]) + 1
+    assert "Traceback" not in err, err[-2000:]
+    return acked
+
+
+def _verify(engine: str, path: str, seed: int, acked: int):
+    from garage_tpu.db import open_db
+
+    db = open_db(engine, path)
+    try:
+        got = _dump(db)
+        # find the exact prefix the recovered state corresponds to
+        state = simulate(seed, acked)
+        j = acked
+        limit = acked + 5000
+        while state != got and j < limit:
+            # extend the simulation one commit at a time (cheap: apply
+            # the next commit's ops to the running state)
+            from db_torture_writer import ops_for
+
+            for t, k, v in ops_for(seed, j):
+                if v is None:
+                    state[t].pop(k, None)
+                else:
+                    state[t][k] = v
+            j += 1
+        assert state == got, (
+            f"{engine}: recovered state matches NO commit prefix in "
+            f"[{acked}, {limit}) — torn or lost transaction "
+            f"(acked={acked})")
+        # the reopened engine must still commit
+        def tx_fn(tx):
+            tx.insert(db.open_tree(TREES[0]), b"post-crash", b"ok")
+        db.transaction(tx_fn)
+        assert db.open_tree(TREES[0]).get(b"post-crash") == b"ok"
+    finally:
+        db.close()
+
+
+@pytest.mark.parametrize("engine", ["native", "sqlite", "memory"])
+def test_kill9_torture(engine, tmp_path):
+    rng = random.Random(f"torture-{engine}")
+    for it in range(ITERS):
+        sub = tmp_path / f"db-{it}"
+        path = str(sub / ("db." + engine))
+        os.makedirs(sub, exist_ok=True)
+        seed = rng.randrange(1 << 30)
+        # bias toward early kills (mid-warmup appends) but include
+        # longer runs that cross compaction/snapshot cycles
+        kill_after = rng.choice((0.05, 0.1, 0.2, 0.4, 0.8))
+        acked = _run_one(engine, path, seed, kill_after)
+        _verify(engine, path, seed, acked)
+
+
+def test_kill9_mid_recovery(tmp_path):
+    """Crash DURING recovery/startup must also be safe: kill a writer,
+    then kill a second writer almost immediately after it starts (it
+    dies mid-recovery or mid-first-commits), then verify."""
+    engine = "native"
+    path = str(tmp_path / "db.native")
+    seed = 424242
+    acked = _run_one(engine, path, seed, 0.4)
+    acked2 = _run_one(engine, path, seed + 1, 0.05)
+    # second run used a different seed: its commits interleave into the
+    # same trees, so only engine-level invariants are checkable — the
+    # db must open, dump, and accept a commit
+    from garage_tpu.db import open_db
+
+    db = open_db(engine, path)
+    try:
+        _dump(db)
+        def tx_fn(tx):
+            tx.insert(db.open_tree(TREES[0]), b"alive", b"1")
+        db.transaction(tx_fn)
+        assert db.open_tree(TREES[0]).get(b"alive") == b"1"
+    finally:
+        db.close()
+    assert acked >= 0 and acked2 >= 0

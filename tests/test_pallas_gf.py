@@ -48,6 +48,19 @@ def test_tile_padding_and_batch_fold():
                 == reference_apply(mat, sh)).all(), (b, s4)
 
 
+def test_wide_shards_batched_no_transpose_path():
+    """s4 >= 2048 takes the batched in-place codeword walk (no fold
+    transpose) — must be bit-identical to the reference, including
+    column padding and multiple codewords."""
+    rng = np.random.default_rng(4)
+    mat = gf256.rs_parity_matrix(4, 2)
+    pg = PallasGf(mat, tile=1024, interpret=True)
+    for b, s4 in [(1, 2048), (3, 2500)]:
+        sh = rng.integers(0, 2**32, (b, 4, s4), dtype=np.uint32)
+        assert (np.asarray(pg(jnp.asarray(sh)))
+                == reference_apply(mat, sh)).all(), (b, s4)
+
+
 def test_pallas_latch_permanent_vs_transient(monkeypatch):
     """VERDICT r3 #8: one transient backend error must NOT permanently
     demote the Pallas kernel; a Mosaic-unsupported error must."""
