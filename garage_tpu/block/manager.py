@@ -92,6 +92,8 @@ class BlockManager:
 
         # attached after construction (circular dep): BlockResyncManager
         self.resync = None
+        self._heal_tasks: set = set()       # post-decode write-backs
+        self._heal_in_flight: set = set()   # hashes with a heal running
         # attached by Garage when RS parity sidecars are enabled
         self.parity_store = None
         # attached by Garage when codec.parity_on_write is also enabled:
@@ -311,8 +313,30 @@ class BlockManager:
 
     # --- RPC client side ---
 
+    async def _heal_after_decode(self, h: Hash, data: bytes) -> None:
+        """Write a decode-recovered block back to its replica set (the
+        read-path RS fallback's repair half).  skip_ec: the block
+        PROVABLY has parity coverage — the decode that produced `data`
+        just consumed it — so re-wrapping it into a fresh codeword
+        would leak duplicate parity on every degraded read."""
+        try:
+            await self.rpc_put_block(h, data, skip_ec=True)
+        except Exception:  # noqa: BLE001 — repair is best-effort
+            logger.warning("post-decode heal of %s failed",
+                           bytes(h).hex()[:16], exc_info=True)
+
+    def drain_heals(self) -> None:
+        """Cancel in-flight post-decode heals (shutdown path: the RPC
+        layer is about to close under them; the resync entry queued
+        alongside each heal is persistent and finishes the job on the
+        next boot)."""
+        for t in list(self._heal_tasks):
+            t.cancel()
+        self._heal_tasks.clear()
+
     async def rpc_put_block(self, h: Hash, data: bytes,
-                            is_parity: bool = False) -> None:
+                            is_parity: bool = False,
+                            skip_ec: bool = False) -> None:
         """Compress + quorum-write to the block's replica set
         (ref manager.rs:356-377).  is_parity marks distributed-parity
         shards so receiving nodes don't wrap them into codewords of
@@ -352,6 +376,7 @@ class BlockManager:
             make_call=send,
         )
         if (self.ec_accumulator is not None and not is_parity
+                and not skip_ec
                 and not self.ec_accumulator.recently_added(h)):
             # writer-side distributed codewords: grouping HERE (not on the
             # storing node) is what spreads a codeword's members across
@@ -501,6 +526,24 @@ class BlockManager:
                     meta_out["raw_chunks"] = None
                 if self.resync is not None:
                     self.resync.put_to_resync(h, 0.0)
+                # re-materialize the lost copy THROUGH THE WRITE PATH in
+                # the background: config-agnostic (in split meta/data
+                # rings the data holder may carry no rc row, so a
+                # resync-side heal has no local signal to act on), and
+                # the normal dedupe makes it idempotent.  One heal per
+                # hash at a time: N concurrent degraded reads of a hot
+                # lost block must not spawn N identical quorum writes.
+                if bytes(h) not in self._heal_in_flight:
+                    self._heal_in_flight.add(bytes(h))
+                    task = asyncio.get_running_loop().create_task(
+                        self._heal_after_decode(h, data))
+                    self._heal_tasks.add(task)
+
+                    def _done(t, hb=bytes(h)):
+                        self._heal_tasks.discard(t)
+                        self._heal_in_flight.discard(hb)
+
+                    task.add_done_callback(_done)
                 self.bytes_read += len(data)
                 for i in range(0, len(data), STREAM_CHUNK):
                     yield data[i:i + STREAM_CHUNK]

@@ -389,6 +389,9 @@ class Garage:
             await self.block_manager.write_parity.drain()
         if self.block_manager.ec_accumulator is not None:
             await self.block_manager.ec_accumulator.drain()
+        # post-decode heals would fail noisily against the closing RPC
+        # layer; their persistent resync entries finish the job later
+        self.block_manager.drain_heals()
         await self.bg.shutdown()
         tracer = getattr(self.system, "tracer", None)
         if tracer is not None:

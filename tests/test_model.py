@@ -1365,3 +1365,72 @@ async def _sweep_heal_body(garages, ids, by_id, dead):
         await asyncio.sleep(0.25)
     assert gainer.block_manager.is_block_present(h), \
         "layout sweep did not heal the gained assignment"
+
+
+async def test_get_survives_silent_sole_copy_loss_via_read_decode(tmp_path):
+    """Round-5 regression test for the chaos-soak finding: a block whose
+    ONLY copy silently vanishes (disk mishap, no node death, no layout
+    change) must still be readable — the GET plane falls back to
+    distributed RS decode after every replica fails — and the serving
+    miss must self-enqueue a resync on the assigned holder so the copy
+    re-materializes (block/manager.py streaming fallback + get_block
+    handler).  The reference has no recourse here at all: with the only
+    replica gone its GET fails until an operator repair
+    (ref src/block/manager.rs:231-317, resync.rs:457-468)."""
+    import os
+
+    from garage_tpu.utils.data import blake2s_sum
+
+    garages = await make_ec_cluster(tmp_path, 5)
+    try:
+        datas = [os.urandom(20_000 + 37 * i) for i in range(12)]
+        hs = [blake2s_sum(d) for d in datas]
+        for h, d in zip(hs, datas):
+            await garages[0].block_manager.rpc_put_block(h, d)
+        # wait for write-time parity coverage of some block
+        covered = None
+        for _ in range(400):
+            for h in hs:
+                ents = await garages[0].parity_index_table.get_range(
+                    bytes(h), None)
+                if any(not e.is_tombstone() for e in ents):
+                    covered = h
+                    break
+            if covered is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert covered is not None, "no block gained parity coverage"
+
+        # silently delete the sole copy from its holder's disk.  Resync
+        # enqueues are NEUTRALIZED on every node so the assertion below
+        # isolates the READ-PATH write-back heal — without it, nothing
+        # re-materializes the copy (the resync chain could also heal
+        # this config, but then the test would pass with the new code
+        # reverted and prove nothing).
+        for g in garages:
+            g.block_resync.put_to_resync = lambda *a, **k: None
+        holder = None
+        for g in garages:
+            found = g.block_manager.find_block(covered)
+            if found is not None:
+                holder = g
+                os.remove(found[0])
+        assert holder is not None, "no node held the block"
+
+        # the GET must succeed NOW via the read-path RS decode
+        got = await garages[0].block_manager.rpc_get_block(covered)
+        assert got == datas[hs.index(covered)], "decode served wrong bytes"
+
+        # ... and the holder self-heals: the serving miss queued a
+        # resync whose fallback chain re-materializes the local file
+        for _ in range(600):
+            if holder.block_manager.is_block_present(covered):
+                break
+            await asyncio.sleep(0.05)
+        assert holder.block_manager.is_block_present(covered), \
+            "holder never re-materialized the lost copy"
+        blk = await holder.block_manager.read_block(covered)
+        assert blk.decompressed() == datas[hs.index(covered)]
+    finally:
+        for g in garages:
+            await g.shutdown()
