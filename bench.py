@@ -523,6 +523,51 @@ def bench_hybrid(batches, tpu_ok: bool):
     return (N_BATCHES * BATCH * BLOCK / dt / 2**30, frac, dev_stats, codec)
 
 
+def bench_synth_crossover(batches) -> dict:
+    """Hybrid crossover demonstration IN the bench JSON (VERDICT r4 #2):
+    the real tunnel has never sustained an above-gate link during a
+    bench window (hybrid_gate/hybrid_link_gibs attribute that), so this
+    phase drives the REAL hybrid engine against the synthetic-link
+    device backend (testing/synthetic_device.py) with the link set to
+    the just-measured CPU rate — steady state should approach
+    cpu + min(link, device) ≈ 2x, with tpu_frac ≈ 0.5.  The full sweep
+    (gate flip, floor safety, bit-identity) lives in
+    tests/test_hybrid_crossover.py; this emits the headline evidence."""
+    from garage_tpu.ops.codec import CodecParams
+    from garage_tpu.ops.hybrid_codec import HybridCodec
+    from garage_tpu.testing.synthetic_device import SyntheticLinkCodec
+
+    params = CodecParams(rs_data=K, rs_parity=M, batch_blocks=BATCH)
+    blocks, hashes = batches[0]
+
+    cpu_only = HybridCodec(params, build_device=False)
+    cpu_only.scrub_many([(blocks[:2 * K], hashes[:2 * K])])  # warm
+    t0 = time.perf_counter()
+    out = cpu_only.scrub_many([batches[0]], fetch_parity=False)
+    cpu_rate = BATCH * BLOCK / (time.perf_counter() - t0) / 2**30
+    assert all(ok.all() for ok, _p in out)
+
+    p2 = CodecParams(rs_data=K, rs_parity=M, batch_blocks=BATCH)
+    dev = SyntheticLinkCodec(p2, link_gibs=cpu_rate)
+    hy = HybridCodec(p2, device_codec=dev)
+    hy.scrub_many([(blocks[:2 * K], hashes[:2 * K])])
+    hy.pop_stats()
+    stream = [batches[i % N_DISTINCT] for i in range(4)]
+    t0 = time.perf_counter()
+    out = hy.scrub_many(stream, fetch_parity=False)
+    rate = 4 * BATCH * BLOCK / (time.perf_counter() - t0) / 2**30
+    assert all(ok.all() for ok, _p in out)
+    cb, tb = hy.pop_stats()
+    total = cb + tb
+    return {
+        "synth_link_gibs": round(cpu_rate, 4),
+        "synth_cpu_gibs": round(cpu_rate, 4),
+        "synth_hybrid_gibs": round(rate, 4),
+        "synth_tpu_frac": round(tb / total, 4) if total else 0.0,
+        "synth_speedup": round(rate / cpu_rate, 3) if cpu_rate else 0.0,
+    }
+
+
 def bench_cpu(batches) -> float:
     """The framework's own CPU floor: the fused CpuCodec scrub path."""
     from garage_tpu.ops import make_codec
@@ -1468,6 +1513,12 @@ def main() -> None:
         # rate that held the gate) — VERDICT r4 #2
         out["hybrid_link_gibs"] = codec.last_link_gibs
         out["hybrid_gate"] = codec.last_gate
+    emit()
+
+    try:
+        out.update(bench_synth_crossover(batches))
+    except Exception:
+        traceback.print_exc()
     emit()
 
     try:

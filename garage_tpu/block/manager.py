@@ -94,6 +94,7 @@ class BlockManager:
         self.resync = None
         self._heal_tasks: set = set()       # post-decode write-backs
         self._heal_in_flight: set = set()   # hashes with a heal running
+        self._heals_closed = False          # set by drain_heals()
         # attached by Garage when RS parity sidecars are enabled
         self.parity_store = None
         # attached by Garage when codec.parity_on_write is also enabled:
@@ -326,10 +327,14 @@ class BlockManager:
                            bytes(h).hex()[:16], exc_info=True)
 
     def drain_heals(self) -> None:
-        """Cancel in-flight post-decode heals (shutdown path: the RPC
-        layer is about to close under them; the resync entry queued
-        alongside each heal is persistent and finishes the job on the
-        next boot)."""
+        """Cancel in-flight post-decode heals and refuse new ones
+        (shutdown path: the RPC layer is about to close under them; the
+        resync entry queued alongside each heal is persistent and
+        finishes the job on the next boot).  The refusal flag closes
+        the window where a GET suspended inside the decode fallback
+        resumes AFTER this drain and would spawn a fresh heal against
+        the closing transport."""
+        self._heals_closed = True
         for t in list(self._heal_tasks):
             t.cancel()
         self._heal_tasks.clear()
@@ -533,7 +538,8 @@ class BlockManager:
                 # the normal dedupe makes it idempotent.  One heal per
                 # hash at a time: N concurrent degraded reads of a hot
                 # lost block must not spawn N identical quorum writes.
-                if bytes(h) not in self._heal_in_flight:
+                if (bytes(h) not in self._heal_in_flight
+                        and not self._heals_closed):
                     self._heal_in_flight.add(bytes(h))
                     task = asyncio.get_running_loop().create_task(
                         self._heal_after_decode(h, data))

@@ -1371,10 +1371,11 @@ async def test_get_survives_silent_sole_copy_loss_via_read_decode(tmp_path):
     """Round-5 regression test for the chaos-soak finding: a block whose
     ONLY copy silently vanishes (disk mishap, no node death, no layout
     change) must still be readable — the GET plane falls back to
-    distributed RS decode after every replica fails — and the serving
-    miss must self-enqueue a resync on the assigned holder so the copy
-    re-materializes (block/manager.py streaming fallback + get_block
-    handler).  The reference has no recourse here at all: with the only
+    distributed RS decode after every replica fails — and the reader's
+    post-decode heal writes the copy back through the put path so it
+    re-materializes (block/manager.py streaming fallback +
+    _heal_after_decode; resync enqueues are neutralized below so this
+    test isolates exactly that write-back).  The reference has no recourse here at all: with the only
     replica gone its GET fails until an operator repair
     (ref src/block/manager.rs:231-317, resync.rs:457-468)."""
     import os
@@ -1421,8 +1422,10 @@ async def test_get_survives_silent_sole_copy_loss_via_read_decode(tmp_path):
         got = await garages[0].block_manager.rpc_get_block(covered)
         assert got == datas[hs.index(covered)], "decode served wrong bytes"
 
-        # ... and the holder self-heals: the serving miss queued a
-        # resync whose fallback chain re-materializes the local file
+        # ... and the copy re-materializes via the reader's post-decode
+        # write-back (resync is stubbed out — only _heal_after_decode
+        # can put the file back; verified by the stub-the-heal negative
+        # control in the commit message)
         for _ in range(600):
             if holder.block_manager.is_block_present(covered):
                 break
