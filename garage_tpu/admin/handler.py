@@ -517,7 +517,7 @@ class AdminRpcHandler:
         for hx in hashes:
             h = self._parse_block_hash(hx)
             resync.clear_backoff(h)
-            resync.put_to_resync(h, 0.0)
+            resync.put_to_resync(h, 0.0, source="admin_retry")
         return f"{len(hashes)} blocks returned in queue for a retry now"
 
     async def _cmd_block_purge(self, msg) -> str:
@@ -587,6 +587,36 @@ class AdminRpcHandler:
         return (f"purged {len(msg.get('blocks') or [])} blocks: "
                 f"{ver_dels} versions, {obj_dels} objects, "
                 f"{mpu_dels} uploads deleted")
+
+    # --- codec observability (the dataplane's "why is tpu_frac 0.0"
+    #     commands; no reference equivalent — the ops/ layer is ours) ---
+
+    async def _cmd_codec_info(self, msg) -> Dict:
+        """Backend, effective params, gate state, byte split and
+        per-stage attribution of the block manager's codec."""
+        out = self.garage.block_manager.codec.info()
+        out["heals"] = dict(self.garage.block_manager.heal_counts)
+        resync = self.garage.block_manager.resync
+        if resync is not None:
+            out["resync_enqueues"] = dict(resync.enqueue_counts)
+        return out
+
+    async def _cmd_codec_events(self, msg) -> List[Dict]:
+        """The bounded gate-decision event ring: every probe result,
+        gate open/hold, ramp step, fused-kernel demotion and sync
+        failure with a reason label, most recent last."""
+        limit = msg.get("limit")
+        return self.garage.block_manager.codec.obs.events_list(
+            int(limit) if limit else None
+        )
+
+    async def _cmd_slow_ops(self, msg) -> List[Dict]:
+        """Top-N slowest spans retained by the always-on slow-op log
+        (works with no trace_sink configured), slowest first."""
+        limit = msg.get("limit")
+        return self.garage.system.tracer.slow.snapshot(
+            int(limit) if limit else None
+        )
 
     async def _cmd_launch_repair(self, msg) -> str:
         what = msg.get("what", "tables")
@@ -823,5 +853,16 @@ class AdminRpcHandler:
                 ),
                 "local_reconstructions":
                     g.block_manager.blocks_reconstructed,
+                "heals": dict(g.block_manager.heal_counts),
+                "resync_enqueues": dict(g.block_resync.enqueue_counts),
+            },
+            "codec": {
+                "backend": type(g.block_manager.codec).__name__,
+                "bytes": dict(g.block_manager.codec.obs.bytes_total),
+                "tpu_frac": round(
+                    g.block_manager.codec.obs.tpu_frac(), 4),
+                "gate": getattr(g.block_manager.codec, "last_gate", None),
+                "link_gibs": getattr(
+                    g.block_manager.codec, "last_link_gibs", None),
             },
         }

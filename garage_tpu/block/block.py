@@ -18,9 +18,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-import zstandard
-
 from ..utils.data import Hash, block_hash
+from ..utils.zstd_compat import zstandard
 from ..utils.error import CorruptData
 
 

@@ -58,7 +58,16 @@ class BlockManager:
         self.db = db
         self.system = system
         self.replication = replication
-        self.codec = codec or config.codec.make(config.compression_level)
+        # the codec gets the System's registry/tracer: per-stage
+        # histograms, bytes-by-side counters and the gate-decision ring
+        # become node-visible (/metrics, admin codec info/events) —
+        # through round 5 the ops/ layer recorded nothing anywhere
+        self.codec = codec or config.codec.make(
+            config.compression_level,
+            metrics=getattr(system, "metrics", None),
+            tracer=getattr(system, "tracer", None),
+            block_size=config.block_size,
+        )
         self.hash_algo = config.codec.hash_algo
         self.compression_level = config.compression_level
         self.data_fsync = config.data_fsync
@@ -112,6 +121,12 @@ class BlockManager:
         self.bytes_read = 0
         self.bytes_written = 0
         self.corruptions = 0
+        # heal attribution (round-5 VERDICT: the claimed heal speedup
+        # turned out to be the bench's own fallback kick — which heal
+        # path actually fired must be a counter, not an inference):
+        # source ∈ {writeback, resync_fetch, peer_sweep,
+        # distributed_decode, local_sidecar}
+        self.heal_counts: dict = {}
         m = getattr(system, "metrics", None)
         if m is not None:
             m.gauge("block_compression_level", "Configured zstd level",
@@ -139,8 +154,36 @@ class BlockManager:
                 "block_read_duration_seconds", "Local block read+verify")
             self.m_write_dur = m.histogram(
                 "block_write_duration_seconds", "Local block write")
+            self.m_heal = m.counter(
+                "block_heal_total",
+                "Blocks re-materialized, by heal source (writeback = "
+                "read-path post-decode write-back; resync_fetch / "
+                "peer_sweep / distributed_decode = resync chain; "
+                "local_sidecar = local RS parity rebuild)")
+            # gate-state gauges read THROUGH self.codec so a codec swap
+            # (tests, future runtime rebuild) keeps /metrics truthful —
+            # fn= observers on the codec itself would both pin the old
+            # instance and keep reporting it after a swap (Gauge dedup
+            # keeps the first registration's observer)
+            m.gauge(
+                "codec_device_attached",
+                "1 when the codec's device side is attached "
+                "(hybrid/tpu backends)",
+                fn=lambda: 1.0 if getattr(self.codec, "tpu", None)
+                is not None else 0.0)
+            m.gauge(
+                "codec_link_gibs",
+                "Last measured host→device link rate (GiB/s; 0 = "
+                "unprobed or failed)",
+                fn=lambda: float(
+                    getattr(self.codec, "last_link_gibs", None) or 0.0))
+            m.gauge(
+                "codec_tpu_frac",
+                "Cumulative fraction of codec bytes processed "
+                "device-side", fn=lambda: self.codec.obs.tpu_frac())
         else:
             self.m_read_dur = self.m_write_dur = None
+            self.m_heal = None
 
     # --- paths ---
 
@@ -174,10 +217,20 @@ class BlockManager:
 
     def _span(self, op: str, h: Hash):
         """Per-block-op tracing span (ref block/manager.rs:492-501);
-        Tracer.span is a shared no-op when tracing is off."""
+        without a trace_sink this is a timing-only lite span feeding the
+        always-on slow-op log."""
         return self.system.tracer.span(
             f"Block {op}", block=bytes(h).hex()[:16], op=op
         )
+
+    def note_heal(self, source: str) -> None:
+        """Record one completed block heal.  Called from every path that
+        re-materializes a lost/corrupt copy; the per-source split is
+        what makes 'which mechanism actually healed it' a measurement
+        (round-5 heal non-repro)."""
+        self.heal_counts[source] = self.heal_counts.get(source, 0) + 1
+        if self.m_heal is not None:
+            self.m_heal.inc(source=source)
 
     def is_parity_block(self, h: Hash) -> bool:
         """Was this hash ever stored here as a distributed-parity shard?"""
@@ -264,7 +317,7 @@ class BlockManager:
             logger.error("corrupted block %s at %s", bytes(h).hex()[:16], path)
             await asyncio.to_thread(_move_corrupted, path)
             if self.resync is not None:
-                self.resync.put_to_resync(h, 0.0)
+                self.resync.put_to_resync(h, 0.0, source="corrupt_read")
             raise
         self.bytes_read += len(raw)
         return block
@@ -292,7 +345,8 @@ class BlockManager:
         if self.rc.block_incref(tx, h):
             # 0→1: we might not have the block yet — check after commit
             if self.resync is not None:
-                tx.on_commit(lambda: self.resync.put_to_resync(h, 2.0))
+                tx.on_commit(lambda: self.resync.put_to_resync(
+                    h, 2.0, source="incref"))
 
     def block_decref(self, tx, h: Hash) -> None:
         if self.rc.block_decref(tx, h):
@@ -310,7 +364,8 @@ class BlockManager:
                 delay = BLOCK_GC_DELAY_MS / 1000.0
                 if not self.is_assigned(h):
                     delay = 2.0
-                tx.on_commit(lambda: self.resync.put_to_resync(h, delay))
+                tx.on_commit(lambda: self.resync.put_to_resync(
+                    h, delay, source="decref"))
 
     # --- RPC client side ---
 
@@ -321,7 +376,11 @@ class BlockManager:
         just consumed it — so re-wrapping it into a fresh codeword
         would leak duplicate parity on every degraded read."""
         try:
-            await self.rpc_put_block(h, data, skip_ec=True)
+            with self.system.tracer.span(
+                "Block heal", block=bytes(h).hex()[:16], source="writeback"
+            ):
+                await self.rpc_put_block(h, data, skip_ec=True)
+            self.note_heal("writeback")
         except Exception:  # noqa: BLE001 — repair is best-effort
             logger.warning("post-decode heal of %s failed",
                            bytes(h).hex()[:16], exc_info=True)
@@ -467,7 +526,7 @@ class BlockManager:
                     meta_out["raw_chunks"] = [] if delivered == 0 else None
                 decomp = None
                 if compressed:
-                    import zstandard
+                    from ..utils.zstd_compat import zstandard
 
                     decomp = zstandard.ZstdDecompressor().decompressobj()
                 skip = delivered
@@ -530,7 +589,8 @@ class BlockManager:
                     meta_out["compressed"] = False
                     meta_out["raw_chunks"] = None
                 if self.resync is not None:
-                    self.resync.put_to_resync(h, 0.0)
+                    self.resync.put_to_resync(h, 0.0,
+                                              source="degraded_read")
                 # re-materialize the lost copy THROUGH THE WRITE PATH in
                 # the background: config-agnostic (in split meta/data
                 # rings the data holder may carry no rc row, so a
@@ -694,7 +754,7 @@ class BlockManager:
                         and self.rc.get(h).is_needed()
                         and self.is_assigned(h)
                         and not self.is_block_present(h)):
-                    self.resync.put_to_resync(h, 0.0)
+                    self.resync.put_to_resync(h, 0.0, source="serve_miss")
                 return {"err": str(e)}, None
             hdr = {"hdr": block.header().pack()}
             if self.is_parity_block(h):

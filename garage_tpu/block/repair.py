@@ -377,9 +377,16 @@ class ScrubWorker(Worker):
             nc = len(carry_b)
             all_b = carry_b + plain_blocks
             all_h = carry_h + plain_hashes
-            ok, parity = await asyncio.to_thread(
-                mgr.codec.scrub_encode_batch, all_b, all_h, want_parity,
-            )
+            # span per fused dispatch: a slow batch (gated link, mid-pass
+            # XLA compile, CPU steal) shows up in the slow-op log even on
+            # nodes with no trace_sink configured
+            with mgr.system.tracer.span(
+                "Scrub batch", blocks=len(all_b),
+                bytes=sum(len(b) for b in all_b),
+            ):
+                ok, parity = await asyncio.to_thread(
+                    mgr.codec.scrub_encode_batch, all_b, all_h, want_parity,
+                )
             for j, good in enumerate(ok[nc:]):
                 if not good:
                     h, path, _ = batch[plain_idx[j]]
@@ -470,9 +477,11 @@ class ScrubWorker(Worker):
 
                 await self.manager.write_block(h, DataBlock.plain(data))
                 self.manager.blocks_reconstructed += 1
+                self.manager.note_heal("local_sidecar")
                 return
         if self.manager.resync is not None:
-            self.manager.resync.put_to_resync(h, 0.0)
+            self.manager.resync.put_to_resync(h, 0.0,
+                                              source="scrub_corrupt")
 
     async def wait_for_work(self) -> None:
         self._wake.clear()
@@ -574,7 +583,10 @@ class RepairWorker(Worker):
                     )
                     return WorkerState.BUSY
                 key, _v = nxt
-                mgr.resync.put_to_resync(Hash(key), 0.0)
+                mgr.resync.put_to_resync(
+                    Hash(key), 0.0,
+                    source="layout_sweep" if self.refs_only
+                    else "repair_sweep")
                 self.cursor = key
                 batch += 1
             self.status().progress = "phase 1"
@@ -583,7 +595,7 @@ class RepairWorker(Worker):
         if batch is None:
             return self._done()
         for h, _path, _c in batch:
-            mgr.resync.put_to_resync(h, 0.0)
+            mgr.resync.put_to_resync(h, 0.0, source="repair_sweep")
         self.status().progress = f"phase 2: {self.iterator.progress() * 100:.1f}%"
         return WorkerState.BUSY
 
@@ -629,7 +641,7 @@ def _try_read(path: str) -> Optional[bytes]:
 
 
 def _try_decompress(raw: bytes) -> Optional[bytes]:
-    import zstandard
+    from ..utils.zstd_compat import zstandard
 
     try:
         return zstandard.ZstdDecompressor().decompress(raw)

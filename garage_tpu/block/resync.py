@@ -93,6 +93,17 @@ class BlockResyncManager:
         self.busy_set: Set[bytes] = set()
         self.notify = asyncio.Event()
         self.persister = persister
+        # enqueue attribution: WHO put work on the resync queue.  The
+        # round-5 heal non-repro was exactly this blind spot — the
+        # bench's fallback kick (a refs-only RepairWorker, source
+        # "layout_sweep") was doing the healing attributed to the decode
+        # path.  Counting at the enqueue seam makes that one scrape.
+        self.enqueue_counts: dict = {}
+        m = getattr(manager.system, "metrics", None)
+        self.m_enqueue = (m.counter(
+            "block_resync_enqueue_total",
+            "Resync queue insertions by originating path",
+        ) if m is not None else None)
         cfg = (persister.load() if persister is not None else None) \
             or ResyncPersistedConfig()
         self.n_workers = cfg.n_workers
@@ -123,7 +134,15 @@ class BlockResyncManager:
 
     # --- queue management (ref resync.rs:88-260) ---
 
-    def put_to_resync(self, h: Hash, delay_secs: float) -> None:
+    def put_to_resync(self, h: Hash, delay_secs: float,
+                      source: str = "other") -> None:
+        """`source` labels the originating path (incref, corrupt_read,
+        degraded_read, serve_miss, scrub_corrupt, layout_sweep, …) for
+        the enqueue-attribution counter; internal requeues/backoffs use
+        put_to_resync_at directly and are deliberately not counted."""
+        self.enqueue_counts[source] = self.enqueue_counts.get(source, 0) + 1
+        if self.m_enqueue is not None:
+            self.m_enqueue.inc(source=source)
         when = now_msec() + int(delay_secs * 1000)
         self.put_to_resync_at(h, when)
 
@@ -254,7 +273,7 @@ class BlockResyncManager:
                 # after the GC timer expires (a backlogged meta sync must
                 # not turn into data loss; the timer's promise is only
                 # valid where the ring still assigns us the block).
-                self.put_to_resync(h, 30.0)
+                self.put_to_resync(h, 30.0, source="migration_retry")
             elif rc.is_deletable():
                 await mgr.delete_if_unneeded(h)
             else:
@@ -280,6 +299,7 @@ class BlockResyncManager:
 
                     await mgr.write_block(h, DataBlock.plain(data))
                     mgr.blocks_reconstructed += 1
+                    mgr.note_heal("local_sidecar")
                     return
             try:
                 block = await mgr.rpc_get_raw_block(h, for_storage=True)
@@ -307,14 +327,17 @@ class BlockResyncManager:
 
                 await mgr.write_block(h, DataBlock.plain(data))
                 if swept:
+                    mgr.note_heal("peer_sweep")
                     logger.info("fetched displaced block %s via peer "
                                 "sweep", bytes(h).hex()[:16])
                 else:
                     mgr.blocks_reconstructed += 1
+                    mgr.note_heal("distributed_decode")
                     logger.info("reconstructed block %s from DISTRIBUTED "
                                 "parity", bytes(h).hex()[:16])
                 return
             await mgr.write_block(h, block, is_parity=block.parity)
+            mgr.note_heal("resync_fetch")
             logger.info("resynced missing block %s", bytes(h).hex()[:16])
 
     async def next_due_in(self) -> float:

@@ -175,6 +175,25 @@ def _build_parser() -> argparse.ArgumentParser:
              "(unrecoverable-block last resort)")
     bp.add_argument("hashes", nargs="+")
     bp.add_argument("--yes", action="store_true")
+
+    pcx = sub.add_parser(
+        "codec",
+        help="dataplane codec observability (gate decisions, stage "
+             "attribution, heal sources)")
+    cxs = pcx.add_subparsers(dest="codec_cmd", required=True)
+    cxs.add_parser("info", help="backend, gate state, bytes-by-side, "
+                                "per-stage attribution, heal counters")
+    cev = cxs.add_parser(
+        "events",
+        help="the gate-decision event ring: why the device side did or "
+             "did not take work (probe rates, gate holds, demotions)")
+    cev.add_argument("-n", "--limit", type=int, default=50)
+
+    pso = sub.add_parser(
+        "slow-ops",
+        help="top-N slowest operations retained by the always-on "
+             "slow-op log (no trace_sink needed)")
+    pso.add_argument("-n", "--limit", type=int, default=20)
     return p
 
 
@@ -517,6 +536,34 @@ async def _amain(args) -> None:
                 "cmd": "block_purge", "yes": args.yes,
                 "blocks": args.hashes,
             }))
+        return
+
+    if args.command == "codec":
+        if args.codec_cmd == "info":
+            print(json.dumps(await client.call({"cmd": "codec_info"}),
+                             indent=2))
+        elif args.codec_cmd == "events":
+            rows = ["SEQ\tKIND\tREASON\tDETAIL"]
+            for e in await client.call(
+                {"cmd": "codec_events", "limit": args.limit}
+            ):
+                detail = ", ".join(
+                    f"{k}={v}" for k, v in e.items()
+                    if k not in ("seq", "ts", "kind", "reason")
+                )
+                rows.append(f"{e['seq']}\t{e['kind']}\t"
+                            f"{e.get('reason') or '-'}\t{detail or '-'}")
+            print(format_table(rows))
+        return
+
+    if args.command == "slow-ops":
+        rows = ["SECONDS\tOP\tATTRS"]
+        for o in await client.call({"cmd": "slow_ops",
+                                    "limit": args.limit}):
+            attrs = ", ".join(f"{k}={v}" for k, v in
+                              (o.get("attrs") or {}).items())
+            rows.append(f"{o['seconds']:.3f}\t{o['name']}\t{attrs or '-'}")
+        print(format_table(rows))
         return
 
 

@@ -25,6 +25,7 @@ same kill -9 torture harness (tests/test_db_torture.py).
 from __future__ import annotations
 
 import bisect
+import logging
 import os
 import struct
 import threading
@@ -32,6 +33,8 @@ import zlib
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from . import DbError, IDb, Transaction, TxAbort
+
+logger = logging.getLogger("garage_tpu.db.memory")
 
 _OP_INSERT = 0
 _OP_REMOVE = 1
@@ -240,15 +243,51 @@ class MemoryDb(IDb):
             return
         off = len(_WAL_MAGIC)
         good_end = off
+        bad_reason = None
         while off + 8 <= len(raw):
             blen, crc = struct.unpack_from("<II", raw, off)
             body = raw[off + 8:off + 8 + blen]
-            if len(body) != blen or zlib.crc32(body) != crc:
-                break  # torn tail: the record never committed
+            if len(body) != blen:
+                bad_reason = "short_record"  # torn tail: never committed
+                break
+            if zlib.crc32(body) != crc:
+                bad_reason = "crc_mismatch"
+                break
             self._replay(_dec_ops(body))
             off += 8 + blen
             good_end = off
         if good_end < len(raw):
+            dropped = len(raw) - good_end
+            if bad_reason is None:
+                bad_reason = "short_header"  # < 8 trailing bytes
+            # A short final record/header is the EXPECTED kill -9 shape
+            # (the record never committed — losing it loses nothing
+            # acknowledged).  A CRC mismatch FOLLOWED by parseable
+            # records is a different animal: mid-file corruption eating
+            # commits that were acknowledged — scan ahead to tell the
+            # two apart and log accordingly (round-5 ADVICE #2; the old
+            # silent truncate hid both cases).
+            later_records = 0
+            if bad_reason == "crc_mismatch":
+                scan = off + 8 + struct.unpack_from("<II", raw, off)[0]
+                while scan + 8 <= len(raw):
+                    blen2, crc2 = struct.unpack_from("<II", raw, scan)
+                    body2 = raw[scan + 8:scan + 8 + blen2]
+                    if len(body2) != blen2 or zlib.crc32(body2) != crc2:
+                        break
+                    later_records += 1
+                    scan += 8 + blen2
+            if later_records:
+                logger.error(
+                    "WAL %s: mid-file CRC mismatch at offset %d with %d "
+                    "parseable record(s) after it — %d bytes of "
+                    "ACKNOWLEDGED commits discarded (media corruption, "
+                    "not a torn tail)",
+                    wal, off, later_records, dropped)
+            else:
+                logger.warning(
+                    "WAL %s: torn tail (%s at offset %d), truncating %d "
+                    "uncommitted byte(s)", wal, bad_reason, off, dropped)
             with open(wal, "r+b") as f:
                 f.truncate(good_end)
 
@@ -291,7 +330,12 @@ class MemoryDb(IDb):
                 self._trees[op[1]].remove(op[2])
 
     def snapshot(self, path: str) -> None:
-        """Consistent copy for `garage meta snapshot` / convert-db."""
+        """Consistent copy for `garage meta snapshot` / convert-db.
+
+        The copied snapshot, the stub WAL and the destination directory
+        are all fsynced before returning, mirroring _write_snapshot — a
+        snapshot whose caller archives/deletes the source right after
+        must not evaporate in a crash (round-5 ADVICE #3)."""
         if self._path is None:
             raise DbError("snapshot requires a durable (path) memory db")
         with self._lock:
@@ -299,9 +343,19 @@ class MemoryDb(IDb):
             import shutil
 
             os.makedirs(path, exist_ok=True)
-            shutil.copy2(self._snap_path(), os.path.join(path, "snap.db"))
+            dst_snap = os.path.join(path, "snap.db")
+            shutil.copy2(self._snap_path(), dst_snap)
+            with open(dst_snap, "rb") as f:
+                os.fsync(f.fileno())
             with open(os.path.join(path, "wal.log"), "wb") as f:
                 f.write(_WAL_MAGIC)
+                f.flush()
+                os.fsync(f.fileno())
+            dirfd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
 
     def close(self) -> None:
         with self._lock:

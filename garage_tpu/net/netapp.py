@@ -41,11 +41,8 @@ from collections import deque
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 import msgpack
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+
+from .ed25519_compat import Ed25519PrivateKey, Ed25519PublicKey, serialization
 
 from ..utils.data import FixedBytes32
 from ..utils.error import RpcError

@@ -19,18 +19,25 @@ from __future__ import annotations
 from .codec import BlockCodec, CodecParams
 
 
-def make_codec(backend: str = "cpu", **kw) -> BlockCodec:
-    """Codec factory — `codec.backend` in config selects this."""
+def make_codec(backend: str = "cpu", metrics=None, tracer=None,
+               **kw) -> BlockCodec:
+    """Codec factory — `codec.backend` in config selects this.
+
+    `metrics`/`tracer` plumb the System-owned MetricsRegistry and Tracer
+    into the codec (BlockManager passes its own): per-stage histograms,
+    bytes-by-side counters, and the gate-decision event ring then show
+    up on /metrics and the admin `codec info`/`codec events` commands."""
     if backend == "cpu":
         from .cpu_codec import CpuCodec
-        return CpuCodec(CodecParams(**kw))
+        return CpuCodec(CodecParams(**kw), metrics=metrics, tracer=tracer)
     if backend == "tpu":
         from .tpu_codec import TpuCodec
-        return TpuCodec(CodecParams(**kw))
+        return TpuCodec(CodecParams(**kw), metrics=metrics, tracer=tracer)
     if backend == "hybrid":
         from .hybrid_codec import HybridCodec
         # async: the daemon must come up on the CPU floor even if JAX
         # backend init hangs on a dead device tunnel; the device codec
         # attaches in the background when ready
-        return HybridCodec(CodecParams(**kw), build_device="async")
+        return HybridCodec(CodecParams(**kw), build_device="async",
+                           metrics=metrics, tracer=tracer)
     raise ValueError(f"unknown codec backend {backend!r}")

@@ -56,7 +56,27 @@ class CodecParams:
     # 256-block staging width left most of the chip idle (VERDICT r4 #1).
     # 1024 lanes = 8 full (8, 128) vregs per state word, the Pallas
     # blake2s kernel's native tile.
+    #
+    # MEMORY IMPLICATION (round-5 ADVICE #4): with the hybrid backend,
+    # up to (hybrid_window + 1) merged submissions are in flight at
+    # once, each spanning up to device_batch_blocks blocks — so host
+    # staging AND device HBM claim peak at
+    #   (hybrid_window + 1) × device_batch_blocks × block_size
+    # = 2 GiB at the defaults (window 1, 1024 blocks, 1 MiB blocks).
+    # HybridCodec clamps the width at construction so this bound never
+    # exceeds max_device_staging_mib (assuming the 1 MiB default
+    # block_size; raise the cap when running bigger blocks on a box
+    # with the RAM/HBM for it).
     device_batch_blocks: int = 1024
+    # Upper bound (MiB) on the in-flight staging claim formula above;
+    # the hybrid backend clamps device_batch_blocks to honor it and
+    # logs a gate event when it does.
+    max_device_staging_mib: int = 4096
+    # The block size the staging clamp assumes (bytes).  BlockManager
+    # plumbs the daemon's configured block_size through make(); bare
+    # codecs default to the daemon default (1 MiB) — without this, a
+    # 4 MiB-block config would stage 4× the promised bound unclamped.
+    block_size: int = 1 << 20
     # CPU-side span width (blocks) while the device is actively claiming
     # work: the CPU merges this many deque groups per fused call (wide
     # native multi-buffer hash + pointer-gather RS amortize per-call
@@ -76,10 +96,34 @@ class CodecParams:
 
 
 class BlockCodec:
-    """Batch codec interface; see module docstring for the contract."""
+    """Batch codec interface; see module docstring for the contract.
 
-    def __init__(self, params: CodecParams):
+    Every codec carries a CodecObserver (`self.obs`): the gate-decision
+    event ring and per-stage accumulators are always on; Prometheus
+    instruments are created when the daemon plumbs its MetricsRegistry
+    in (make_codec(..., metrics=system.metrics, tracer=system.tracer))."""
+
+    def __init__(self, params: CodecParams, metrics=None, tracer=None,
+                 observer=None):
         self.params = params
+        if observer is None:
+            from .observer import CodecObserver
+
+            observer = CodecObserver(metrics=metrics, tracer=tracer)
+        self.obs = observer
+
+    def info(self) -> dict:
+        """Operator-facing snapshot (admin `codec info`): backend,
+        effective params, byte accounting, and per-stage attribution.
+        JSON-safe by construction."""
+        return {
+            "backend": type(self).__name__,
+            "params": dataclasses.asdict(self.params),
+            "bytes": dict(self.obs.bytes_total),
+            "tpu_frac": round(self.obs.tpu_frac(), 4),
+            "stages": self.obs.stage_stats(),
+            "events_recorded": len(self.obs.events),
+        }
 
     # --- hashing ---
     def batch_hash(self, blocks: Sequence[bytes]) -> List[Hash]:
@@ -149,7 +193,7 @@ class BlockCodec:
     def compress(self, data: bytes) -> Optional[bytes]:
         if self.params.compression_level is None:
             return None
-        import zstandard
+        from ..utils.zstd_compat import zstandard
         c = zstandard.ZstdCompressor(
             level=self.params.compression_level,
             write_checksum=True,   # ref block/block.rs:66-78 verifies via zstd checksum
@@ -159,7 +203,7 @@ class BlockCodec:
         return out if len(out) < len(data) else None
 
     def decompress(self, data: bytes) -> bytes:
-        import zstandard
+        from ..utils.zstd_compat import zstandard
         return zstandard.ZstdDecompressor().decompress(data)
 
     # --- sharding helpers (shape plumbing, backend-independent) ---

@@ -47,8 +47,10 @@ def _hash_pool() -> concurrent.futures.ThreadPoolExecutor:
 
 
 class CpuCodec(BlockCodec):
-    def __init__(self, params: CodecParams):
-        super().__init__(params)
+    def __init__(self, params: CodecParams, metrics=None, tracer=None,
+                 observer=None):
+        super().__init__(params, metrics=metrics, tracer=tracer,
+                         observer=observer)
         self._hash_fn = BLOCK_HASH_ALGOS[params.hash_algo]
         self._pool = _hash_pool()
         self._native = get_native_gf_matmul_blocks()

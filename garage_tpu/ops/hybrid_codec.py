@@ -69,7 +69,7 @@ class HybridCodec(BlockCodec):
 
     def __init__(self, params: CodecParams,
                  device_codec: Optional[BlockCodec] = None,
-                 build_device="sync"):
+                 build_device="sync", metrics=None, tracer=None):
         """build_device selects how the device codec is constructed:
           "sync"  — build now (the caller has already probed the device
                     alive, e.g. bench.py after its subprocess probe);
@@ -79,8 +79,15 @@ class HybridCodec(BlockCodec):
                     a storage daemon must come up and scrub on its CPU
                     floor regardless (the device joins in when/if init
                     completes);
-          False   — never build; pure CPU floor."""
-        super().__init__(params)
+          False   — never build; pure CPU floor.
+
+        metrics/tracer: the System-owned MetricsRegistry/Tracer — stage
+        histograms, bytes-by-side counters, and the gate-decision event
+        ring become node-visible (/metrics + admin `codec info`)."""
+        super().__init__(params, metrics=metrics, tracer=tracer)
+        # the inner CPU codec gets NO observer plumbing: the hybrid does
+        # all byte/stage accounting itself (first-writer-wins makes the
+        # inner codec's view double-count hedged groups)
         self.cpu = CpuCodec(params)
         self.tpu = device_codec
         # group = the stealing quantum; must be k-aligned so each group's
@@ -100,6 +107,34 @@ class HybridCodec(BlockCodec):
         # granularity) per VERDICT r4 #1.
         self.device_batch_blocks = max(self.group_blocks,
                                        params.device_batch_blocks)
+        # Staging-claim clamp (round-5 ADVICE #4): (window+1) merged
+        # submissions × device_batch_blocks × block_size is host RAM +
+        # device HBM held at once — 2 GiB at the defaults.  Clamp the
+        # submission width so the bound never exceeds
+        # max_device_staging_mib at the CONFIGURED block size (the
+        # daemon plumbs config.block_size in; 1 MiB default); the event
+        # makes a silently narrower device pipeline attributable.
+        blk = max(1, params.block_size)
+        cap = max(
+            self.group_blocks,
+            (params.max_device_staging_mib << 20)
+            // ((self.window + 1) * blk),
+        )
+        if self.device_batch_blocks > cap:
+            logger.warning(
+                "clamping device_batch_blocks %d -> %d: "
+                "(hybrid_window+1)=%d in-flight submissions of %d-byte "
+                "blocks would stage %d MiB (> max_device_staging_mib=%d)",
+                self.device_batch_blocks, cap, self.window + 1, blk,
+                (self.window + 1) * self.device_batch_blocks * blk >> 20,
+                params.max_device_staging_mib,
+            )
+            self.obs.event(
+                "staging_clamp", reason="max_device_staging_mib",
+                requested=self.device_batch_blocks, clamped=cap,
+                window=self.window, block_size=blk,
+            )
+            self.device_batch_blocks = cap
         # CPU-side merged span while the device is actively stealing;
         # unbounded (whole contiguous segments) when the device is gated
         # or absent — the pass then degenerates to exactly the wide
@@ -124,6 +159,11 @@ class HybridCodec(BlockCodec):
         self.last_link_gibs: Optional[float] = None
         self.last_gate: Optional[str] = None
         self._stats_lock = threading.Lock()
+        # NOTE: the codec-level gauges (codec_device_attached,
+        # codec_link_gibs, codec_tpu_frac) are registered by
+        # BlockManager against self.codec — per-instance fn= observers
+        # here would pin this instance in the registry forever and go
+        # stale on a codec swap (Gauge dedup keeps the FIRST observer).
         if self.tpu is None and build_device:
             if build_device == "async":
                 threading.Thread(
@@ -137,12 +177,32 @@ class HybridCodec(BlockCodec):
         try:
             from .tpu_codec import TpuCodec
 
-            self.tpu = TpuCodec(self.params)  # atomic attach
-        except Exception:
+            # the device codec SHARES this hybrid's observer: kernel
+            # demotions land in the same event ring as gate decisions
+            self.tpu = TpuCodec(self.params, observer=self.obs)  # atomic attach
+            self.obs.event("device_attach", reason="ok")
+        except Exception as e:
             logger.warning(
                 "device codec unavailable; hybrid runs CPU-only",
                 exc_info=True,
             )
+            self.obs.event("device_attach", reason="failed",
+                           error=f"{type(e).__name__}: {e}"[:200])
+
+    def info(self) -> dict:
+        d = super().info()
+        with self._stats_lock:
+            d.update({
+                "device_attached": self.tpu is not None,
+                "device_backend": (type(self.tpu).__name__
+                                   if self.tpu is not None else None),
+                "gate": self.last_gate,
+                "link_gibs": self.last_link_gibs,
+                "group_blocks": self.group_blocks,
+                "device_batch_blocks": self.device_batch_blocks,
+                "window": self.window,
+            })
+        return d
 
     def pop_stats(self) -> Tuple[int, int]:
         with self._stats_lock:
@@ -322,6 +382,9 @@ class HybridCodec(BlockCodec):
                 "no-device" if self.tpu is None else "cpu-only")
             if not use_device:
                 self.last_link_gibs = None
+        if not use_device:
+            self.obs.event("gate", reason=self.last_gate,
+                           groups=len(groups))
 
         dq = collections.deque(range(len(groups)))
         lock = threading.Lock()
@@ -349,6 +412,7 @@ class HybridCodec(BlockCodec):
                         self.bytes_cpu += nbytes
                     else:
                         self.bytes_tpu += nbytes
+                self.obs.add_bytes(side, nbytes)
                 remaining[0] -= 1
                 if remaining[0] == 0:
                     done.set()
@@ -382,13 +446,22 @@ class HybridCodec(BlockCodec):
                 # a sub-threshold link costs more in staging + tail-hedge
                 # redo than it contributes (and learning that from the
                 # first real collect can take tens of seconds).
-                rate = self._probe_link()
+                with self.obs.stage("probe", "tpu"):
+                    rate = self._probe_link()
                 with self._stats_lock:
                     self.last_link_gibs = (
                         None if rate == float("inf") else round(rate, 4))
+                self.obs.event(
+                    "probe",
+                    reason="unmetered" if rate == float("inf") else "ok",
+                    gibs=None if rate == float("inf") else round(rate, 4),
+                    threshold=self.params.hybrid_min_link_gibs)
                 if rate < self.params.hybrid_min_link_gibs:
                     with self._stats_lock:
                         self.last_gate = "hold"
+                    self.obs.event(
+                        "gate", reason="hold", gibs=round(rate, 4),
+                        threshold=self.params.hybrid_min_link_gibs)
                     logger.info(
                         "hybrid feeder: link probe %.3f GiB/s below "
                         "threshold %.3f — CPU-only this pass",
@@ -396,6 +469,9 @@ class HybridCodec(BlockCodec):
                     return
                 with self._stats_lock:
                     self.last_gate = "open"
+                self.obs.event(
+                    "gate", reason="open",
+                    gibs=None if rate == float("inf") else round(rate, 4))
                 while True:
                     # width ramp: early submissions are small (cheap for
                     # the tail hedge to redo if the link turns out slow);
@@ -414,6 +490,7 @@ class HybridCodec(BlockCodec):
                     # merging must not let the feeder claim the whole
                     # deque in one gulp — the CPU side would sit idle
                     # while the device serializes everything
+                    t_claim = time.perf_counter()
                     with lock:
                         take_n = max(1, (len(dq) + 1) // 2)
                     while nblk < target and take_n > 0:
@@ -429,18 +506,28 @@ class HybridCodec(BlockCodec):
                             break
                         merged.insert(0, gi)
                         nblk += cgi
+                    self.obs.observe_stage(
+                        "feeder_wait", "tpu",
+                        time.perf_counter() - t_claim)
                     if not merged:
                         break
-                    gb: List[bytes] = []
-                    gh: List[Hash] = []
-                    for gi in merged:
-                        _idx, b, h = groups[gi]
-                        gb.extend(b)
-                        gh.extend(h)
+                    with self.obs.stage("host_staging", "tpu"):
+                        gb: List[bytes] = []
+                        gh: List[Hash] = []
+                        for gi in merged:
+                            _idx, b, h = groups[gi]
+                            gb.extend(b)
+                            gh.extend(h)
                     sub_bytes = sum(len(x) for x in gb)
                     try:
-                        ok_dev, parity_dev, _cnt = self.tpu.scrub_submit(
-                            gb, gh)
+                        # whole-submit envelope; an instrumented TpuCodec
+                        # additionally refines it into host_staging /
+                        # h2d_transfer / kernel_dispatch internally
+                        with self.obs.stage("device_submit", "tpu"):
+                            ok_dev, parity_dev, _cnt = self.tpu.scrub_submit(
+                                gb, gh)
+                        variant = getattr(
+                            self.tpu, "last_submit_variant", None)
                     except BaseException:
                         # none of `merged` was submitted: hand the whole
                         # claim back — carry (popped after merged's
@@ -454,7 +541,7 @@ class HybridCodec(BlockCodec):
                             dq.extend(merged)
                         raise
                     inflight.append(
-                        (merged, sub_bytes, ok_dev, parity_dev)
+                        (merged, sub_bytes, ok_dev, parity_dev, variant)
                     )
                     if len(inflight) > self.window:
                         t_c = time.monotonic()
@@ -462,6 +549,10 @@ class HybridCodec(BlockCodec):
                         self._tpu_collect(item, groups, set_result,
                                           fetch_parity)
                         ramp_i += 1
+                        new_target = ramp[min(ramp_i, len(ramp) - 1)]
+                        if new_target != target:
+                            self.obs.event("ramp", reason="widen",
+                                           blocks=new_target)
                         # Give up on a pathologically slow link: feeding it
                         # costs host CPU (transfer staging ≈ one memcpy per
                         # group, a few % of a CPU verify) that the verifier
@@ -483,6 +574,11 @@ class HybridCodec(BlockCodec):
                                 "ceding remaining groups to CPU",
                                 item_bytes / max(collect_dt, 1e-9) / 1024,
                             )
+                            self.obs.event(
+                                "cede", reason="slow_collect",
+                                kibs=round(item_bytes
+                                           / max(collect_dt, 1e-9) / 1024),
+                            )
                             break
                 while inflight:
                     self._tpu_collect(inflight.popleft(), groups,
@@ -493,6 +589,8 @@ class HybridCodec(BlockCodec):
                 logger.warning(
                     "device feeder failed; CPU absorbs its groups: %r", e
                 )
+                self.obs.event("feeder_error", reason=type(e).__name__,
+                               error=f"{e}"[:200])
             finally:
                 # A popped-but-unsubmitted carry group must not strand:
                 # on ANY exit (slow-link cede, submit failure, normal end
@@ -547,10 +645,11 @@ class HybridCodec(BlockCodec):
             for gi in span:
                 gb.extend(groups[gi][1])
                 gh.extend(groups[gi][2])
-            ok = self.cpu.batch_verify(gb, gh)
-            parity_arr = None
-            if compute_parity:
-                parity_arr = self.cpu.rs_encode_blocks(gb)
+            with self.obs.stage("cpu_span", "cpu"):
+                ok = self.cpu.batch_verify(gb, gh)
+                parity_arr = None
+                if compute_parity:
+                    parity_arr = self.cpu.rs_encode_blocks(gb)
             self._split_merged(
                 span, groups, ok,
                 parity_arr if fetch_parity else None,
@@ -572,14 +671,25 @@ class HybridCodec(BlockCodec):
                 len(b) for gi in pending for b in groups[gi][1]
             )
             grace = 0.25 * pend_bytes / cpu_rate if cpu_rate > 0 else 1.0
-            done.wait(timeout=grace)
+            with self.obs.stage("tail_wait", "tpu"):
+                done.wait(timeout=grace)
+            hedged = 0
             for gi in pending:
                 with lock:
                     if results[gi] is not None:
                         continue
                 _idx, gb, gh = groups[gi]
-                val = self._cpu_group(gb, gh, compute_parity, fetch_parity)
-                set_result(gi, val, "cpu", sum(len(b) for b in gb))
+                with self.obs.stage("hedge", "cpu"):
+                    val = self._cpu_group(gb, gh, compute_parity,
+                                          fetch_parity)
+                if set_result(gi, val, "cpu", sum(len(b) for b in gb)):
+                    hedged += 1
+            if hedged:
+                # the hedge redoing device-claimed groups is exactly the
+                # kind of silent work the round-5 heal non-repro hid —
+                # make it an attributable event
+                self.obs.event("tail_hedge", reason="grace_expired",
+                               groups=hedged)
             done.wait()  # every slot now has a writer; returns immediately
         return results
 
@@ -620,10 +730,35 @@ class HybridCodec(BlockCodec):
             off += ln
 
     def _tpu_collect(self, item, groups, set_result, fetch_parity):
-        """Sync one merged device submission and split it per-group."""
-        merged, _sub_bytes, ok_dev, parity_dev = item
-        ok = np.asarray(ok_dev)
-        parity_np = np.asarray(parity_dev) if fetch_parity else None
+        """Sync one merged device submission and split it per-group.
+
+        The np.asarray here is where an async backend's kernel failures
+        actually surface — long after scrub_submit returned clean — so
+        the outcome is reported back to the device codec's demotion
+        latch (note_sync_failure/_success, round-5 ADVICE #1)."""
+        merged, _sub_bytes, ok_dev, parity_dev, variant = item
+        try:
+            with self.obs.stage("sync_collect", "tpu"):
+                ok = np.asarray(ok_dev)
+                parity_np = np.asarray(parity_dev) if fetch_parity else None
+        except BaseException as e:
+            self.obs.event("sync_failure", reason=type(e).__name__,
+                           error=f"{e}"[:200])
+            note = getattr(self.tpu, "note_sync_failure", None)
+            if note is not None:
+                try:
+                    note(e, variant)
+                except Exception:
+                    logger.warning("note_sync_failure hook failed",
+                                   exc_info=True)
+            raise
+        note = getattr(self.tpu, "note_sync_success", None)
+        if note is not None:
+            try:
+                note(variant)
+            except Exception:
+                logger.warning("note_sync_success hook failed",
+                               exc_info=True)
         self._split_merged(merged, groups, ok, parity_np, set_result,
                            "tpu")
 

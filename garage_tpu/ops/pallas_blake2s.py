@@ -134,6 +134,9 @@ def blake2s_words_pallas(msg, lengths, interpret: bool = False):
     while rt > 8 or rows % rt:
         rt -= 1
     grid = (rows // rt, nchunks)
+    # renamed in jax 0.5: TPUCompilerParams → CompilerParams; support both
+    params_cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
     return pl.pallas_call(
         functools.partial(_kernel, nchunks),
         grid=grid,
@@ -144,7 +147,7 @@ def blake2s_words_pallas(msg, lengths, interpret: bool = False):
         out_specs=pl.BlockSpec((8, rt, LANE), lambda i, j: (0, i, 0)),
         out_shape=jax.ShapeDtypeStruct((8, rows, LANE), jnp.uint32),
         scratch_shapes=[pltpu.VMEM((8, rt, LANE), jnp.uint32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=params_cls(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,

@@ -179,8 +179,10 @@ def _pallas_error_is_permanent(e: BaseException) -> bool:
 
 
 class TpuCodec(BlockCodec):
-    def __init__(self, params: CodecParams, devices: Optional[list] = None):
-        super().__init__(params)
+    def __init__(self, params: CodecParams, devices: Optional[list] = None,
+                 metrics=None, tracer=None, observer=None):
+        super().__init__(params, metrics=metrics, tracer=tracer,
+                         observer=observer)
         if params.hash_algo != "blake2s":
             raise ValueError(
                 "TpuCodec offloads blake2s only; set codec.hash_algo='blake2s' "
@@ -207,6 +209,11 @@ class TpuCodec(BlockCodec):
         self._pallas_fused_ok = True
         self._pallas_fused_fails = 0
         self._scrub_pallas_jit = None
+        # which fused-scrub variant produced the LAST submission — the
+        # caller snapshots this right after scrub_submit and passes it
+        # back into note_sync_{success,failure} so sync-time failures
+        # (surfacing only at np.asarray) demote the right latch
+        self.last_submit_variant = "xla"
         self.mesh = None
         if params.shard_mesh > 1:
             devs = (devices or jax.devices())[: params.shard_mesh]
@@ -373,9 +380,12 @@ class TpuCodec(BlockCodec):
             pg = self._pallas_for(mat)
             if pg is not None:
                 try:
-                    out = u32_view_bytes(pg(u32))
+                    out = np.asarray(u32_view_bytes(pg(u32)))[..., :s]
+                    # reset only after the host-side materialization
+                    # proved the kernel ran (same rule as the fused
+                    # latch, round-5 ADVICE #1)
                     self._pallas_transient_fails = 0
-                    return np.asarray(out)[..., :s]
+                    return out
                 except Exception as e:
                     import logging
 
@@ -392,6 +402,8 @@ class TpuCodec(BlockCodec):
                             "(permanent); using the XLA kernel",
                             exc_info=True)
                         self._pallas_ok = False
+                        self.obs.event("gf_demote", reason="permanent",
+                                       error=f"{type(e).__name__}: {e}"[:200])
                     else:
                         self._pallas_transient_fails += 1
                         if (self._pallas_transient_fails
@@ -401,6 +413,9 @@ class TpuCodec(BlockCodec):
                                 "times; demoting to the XLA kernel",
                                 self._pallas_transient_fails, exc_info=True)
                             self._pallas_ok = False
+                            self.obs.event(
+                                "gf_demote", reason="transient_limit",
+                                fails=self._pallas_transient_fails)
                         else:
                             log.warning(
                                 "pallas GF kernel transient failure "
@@ -503,6 +518,8 @@ class TpuCodec(BlockCodec):
                 "pallas fused scrub unsupported on this backend "
                 "(permanent); using the XLA kernels", exc_info=True)
             self._pallas_fused_ok = False
+            self.obs.event("fused_demote", reason="permanent",
+                           error=f"{type(e).__name__}: {e}"[:200])
         else:
             self._pallas_fused_fails += 1
             if self._pallas_fused_fails >= PALLAS_MAX_TRANSIENT_FAILS:
@@ -511,11 +528,37 @@ class TpuCodec(BlockCodec):
                     "demoting to the XLA kernels",
                     self._pallas_fused_fails, exc_info=True)
                 self._pallas_fused_ok = False
+                self.obs.event("fused_demote", reason="transient_limit",
+                               fails=self._pallas_fused_fails,
+                               error=f"{type(e).__name__}: {e}"[:200])
             else:
                 log.warning(
                     "pallas fused scrub transient failure (%d/%d); "
                     "will retry", self._pallas_fused_fails,
                     PALLAS_MAX_TRANSIENT_FAILS, exc_info=True)
+                self.obs.event("fused_transient",
+                               reason=type(e).__name__,
+                               fails=self._pallas_fused_fails)
+
+    def note_sync_failure(self, e: BaseException,
+                          variant: Optional[str] = None) -> None:
+        """Sync-time kernel failure — surfacing at the caller's
+        np.asarray (HybridCodec._tpu_collect), long after scrub_submit
+        returned.  Routes the failure into the fused-scrub demotion
+        latch when the failing submission came from the Pallas variant:
+        a consistently sync-failing kernel must demote to the XLA
+        fallback instead of silently losing the device side every pass
+        (round-5 ADVICE #1)."""
+        if (variant or self.last_submit_variant) == "pallas":
+            self._note_fused_failure(e)
+
+    def note_sync_success(self, variant: Optional[str] = None) -> None:
+        """Successful host-side materialization of a submission — the
+        ONLY point the fused-kernel transient-failure counter resets
+        (resetting at submit time, before the kernel provably ran,
+        defeated the latch: round-5 ADVICE #1)."""
+        if (variant or self.last_submit_variant) == "pallas":
+            self._pallas_fused_fails = 0
 
     def scrub_submit(self, blocks: Sequence[bytes], hashes: Sequence[Hash]):
         """Enqueue one group's fused verify+encode WITHOUT synchronizing.
@@ -525,7 +568,8 @@ class TpuCodec(BlockCodec):
         host→device link latency (the accelerator may sit behind a
         constrained tunnel), then sync each with `np.asarray(ok_dev)[:n]`.
         """
-        arr, lengths, expected = self._pad_group(blocks, hashes)
+        with self.obs.stage("host_staging", "tpu"):
+            arr, lengths, expected = self._pad_group(blocks, hashes)
         _h, ok, _bad, parity = self.scrub_encode_submit(arr, lengths, expected)
         return ok, parity, len(blocks)
 
@@ -560,24 +604,37 @@ class TpuCodec(BlockCodec):
         """Enqueue ONE device dispatch doing verify + RS(k,m) parity for a
         full batch; returns device arrays WITHOUT synchronizing, so callers
         can pipeline batches and hide the dispatch latency (essential when
-        the accelerator sits behind a high-latency tunnel)."""
+        the accelerator sits behind a high-latency tunnel).
+
+        Sets `last_submit_variant` ("pallas"|"xla") for the caller to
+        thread into note_sync_{success,failure}: kernel failures surface
+        only at sync time, and the demotion latch must attribute them to
+        the variant that actually produced the arrays.  The transient-
+        failure counter is NOT reset here — a submit returning is proof
+        of nothing on an async backend (round-5 ADVICE #1); the reset
+        happens in note_sync_success."""
         assert arr.shape[0] % self.params.rs_data == 0
         assert arr.shape[1] % 4 == 0
+        with self.obs.stage("h2d_transfer", "tpu"):
+            da = jnp.asarray(arr)
+            dl = jnp.asarray(lengths)
+            de = jnp.asarray(expected)
         if self._use_pallas_scrub(arr.shape[0]):
             try:
-                out = self._scrub_pallas()(
-                    jnp.asarray(arr), jnp.asarray(lengths),
-                    jnp.asarray(expected), self._K_enc,
-                    self.params.rs_data,
-                )
-                self._pallas_fused_fails = 0
+                with self.obs.stage("kernel_dispatch", "tpu"):
+                    out = self._scrub_pallas()(
+                        da, dl, de, self._K_enc, self.params.rs_data,
+                    )
+                self.last_submit_variant = "pallas"
                 return out
             except Exception as e:
                 self._note_fused_failure(e)
-        return self._scrub_jit(
-            jnp.asarray(arr), jnp.asarray(lengths), jnp.asarray(expected),
-            self._K_enc, self.params.rs_data,
-        )
+        with self.obs.stage("kernel_dispatch", "tpu"):
+            out = self._scrub_jit(
+                da, dl, de, self._K_enc, self.params.rs_data,
+            )
+        self.last_submit_variant = "xla"
+        return out
 
     def scrub_encode_batch(self, blocks: Sequence[bytes], hashes: Sequence[Hash],
                            fetch_parity: bool = True):
@@ -587,13 +644,21 @@ class TpuCodec(BlockCodec):
         padding (pad rows/columns are zero blocks → zero parity); with
         fetch_parity=False it stays on the device and None is returned."""
         ok, parity, n = self.scrub_submit(blocks, hashes)
-        ok = np.asarray(ok)[:n]
+        variant = self.last_submit_variant
+        try:
+            with self.obs.stage("sync_collect", "tpu"):
+                ok = np.asarray(ok)[:n]
+                parity_np = (np.asarray(parity) if fetch_parity else None)
+        except Exception as e:
+            self.note_sync_failure(e, variant)
+            raise
+        self.note_sync_success(variant)
         if not fetch_parity:
             return ok, None
         k = self.params.rs_data
         nrows = (n + k - 1) // k
         maxlen = max(len(b) for b in blocks)
-        return ok, np.asarray(parity)[:nrows, :, :maxlen]
+        return ok, parity_np[:nrows, :, :maxlen]
 
 
 # --- multi-chip sharded variants (dryrun_multichip + pod-scale batches) -----

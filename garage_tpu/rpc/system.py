@@ -121,6 +121,18 @@ class System:
         self.tracer = init_tracing(
             getattr(config, "admin_trace_sink", None), bytes(self.id)
         )
+        # tracer self-observability: exporter health + the always-on
+        # slow-op log's high-water mark are scrapeable, so "is tracing
+        # even working" never needs a collector to answer
+        self.metrics.gauge(
+            "tracer_spans_exported_total", "Spans delivered to the "
+            "OTLP collector", fn=lambda: float(self.tracer.exported))
+        self.metrics.gauge(
+            "tracer_spans_dropped_total", "Spans dropped (no/slow "
+            "collector)", fn=lambda: float(self.tracer.dropped))
+        self.metrics.gauge(
+            "tracer_slow_op_max_seconds", "Slowest operation retained "
+            "in the slow-op log", fn=lambda: self.tracer.slow.max_seconds())
         self.rpc = RpcHelper(self.netapp, self.peering, metrics=self.metrics,
                              tracer=self.tracer)
 

@@ -17,7 +17,10 @@ import dataclasses
 import os
 import re
 import stat
-import tomllib
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: the API-identical backport
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -106,13 +109,35 @@ class CodecConfig:
     # (1 + m/k × storage tolerating m codeword-node losses).
     parity_distribute: bool = False
     hybrid_window: int = 1          # hybrid backend: device in-flight groups
+    # Device submission width (blocks) for the hybrid feeder.  MEMORY
+    # IMPLICATION (round-5 ADVICE #4): host staging + device HBM hold up
+    # to (hybrid_window + 1) × device_batch_blocks × block_size at once
+    # — 2 GiB at the defaults (window 1 × 1024 blocks × 1 MiB).  The
+    # codec clamps this width at construction so the bound never
+    # exceeds max_device_staging_mib; see ops/codec.py CodecParams for
+    # the full derivation.
+    device_batch_blocks: int = _CODEC_DEFAULTS.device_batch_blocks
+    # Cap (MiB) on the in-flight staging claim above.  Raise it only on
+    # hosts with the RAM/HBM headroom for wider windows.
+    max_device_staging_mib: int = _CODEC_DEFAULTS.max_device_staging_mib
 
-    def make(self, compression_level: Optional[int] = 1):
-        """Build the configured BlockCodec (`backend` selects the impl)."""
+    def make(self, compression_level: Optional[int] = 1,
+             metrics=None, tracer=None, block_size: Optional[int] = None):
+        """Build the configured BlockCodec (`backend` selects the impl).
+
+        metrics/tracer plumb the System's MetricsRegistry/Tracer into
+        the codec: per-stage histograms, bytes-by-side counters, and the
+        gate-decision event ring (admin `codec info` / `codec events`).
+        block_size feeds the staging clamp so the memory bound holds at
+        the daemon's configured block size, not the 1 MiB default."""
         from ..ops import make_codec
+        from ..ops.codec import CodecParams as _CP
 
         return make_codec(
             self.backend,
+            metrics=metrics,
+            tracer=tracer,
+            block_size=block_size or _CP.block_size,
             hash_algo=self.hash_algo,
             rs_data=self.rs_data,
             rs_parity=self.rs_parity,
@@ -121,6 +146,8 @@ class CodecConfig:
             shard_mesh=self.shard_mesh,
             hybrid_group_blocks=self.hybrid_group_blocks,
             hybrid_window=self.hybrid_window,
+            device_batch_blocks=self.device_batch_blocks,
+            max_device_staging_mib=self.max_device_staging_mib,
         )
 
 

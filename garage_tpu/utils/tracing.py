@@ -15,17 +15,23 @@ the exporter speaks the OTLP/HTTP **JSON** encoding directly
 task.  A dead or slow collector drops batches after a short timeout —
 tracing must never hold up the data path.
 
-When no trace_sink is configured the tracer is disabled and `span()`
-returns a shared no-op context manager: the instrumentation points cost
-one truthiness check.
+When no trace_sink is configured the tracer is disabled for EXPORT but
+not for the slow-op log: `span()` then returns a lightweight timing-only
+span (no ids, no buffering, no contextvar) that feeds the always-on
+top-N slow-op log — so "what were the slowest block ops this node ever
+ran" is answerable on a node that never configured a collector
+(round-5: the heal non-repro and the sub-floor headline were invisible
+precisely because nothing retained timings without a trace_sink).
 """
 
 from __future__ import annotations
 
 import asyncio
 import contextvars
+import heapq
 import logging
 import os
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -36,6 +42,54 @@ FLUSH_INTERVAL = 3.0      # seconds between export batches
 EXPORT_TIMEOUT = 3.0      # ref tracing_setup.rs with_timeout(3s)
 MAX_BUFFER = 4096         # spans held while the collector is unreachable
 MAX_BATCH = 512
+SLOW_LOG_SIZE = 64        # top-N slowest spans retained, always on
+SLOW_LOG_MIN_S = 0.010    # ignore sub-10ms ops entirely (noise floor)
+
+
+class SlowOpLog:
+    """Top-N slowest operations by duration — bounded, always on.
+
+    Fed by every span exit (real spans and the no-sink lite spans
+    alike).  The read side is the admin `slow-ops` command.  The O(1)
+    fast path (compare against the current minimum before locking)
+    keeps the hot-path cost of a fast op at one float compare."""
+
+    def __init__(self, size: int = SLOW_LOG_SIZE):
+        self._size = size
+        self._heap: list = []   # (dur_s, seq, record) min-heap
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def note(self, name: str, dur_s: float, attrs: Dict[str, Any]) -> None:
+        if dur_s < SLOW_LOG_MIN_S:
+            return
+        heap = self._heap
+        if len(heap) >= self._size and dur_s <= heap[0][0]:
+            return  # fast path: racy read is fine, the bar only rises
+        rec = {
+            "name": name,
+            "seconds": round(dur_s, 6),
+            "ts": round(time.time(), 3),
+            "attrs": {k: v for k, v in attrs.items()
+                      if isinstance(v, (str, int, float, bool))},
+        }
+        with self._lock:
+            self._seq += 1
+            if len(heap) < self._size:
+                heapq.heappush(heap, (dur_s, self._seq, rec))
+            elif dur_s > heap[0][0]:
+                heapq.heapreplace(heap, (dur_s, self._seq, rec))
+
+    def snapshot(self, limit: Optional[int] = None) -> List[dict]:
+        """Slowest-first list of retained records."""
+        with self._lock:
+            items = sorted(self._heap, key=lambda t: -t[0])
+        out = [rec for _d, _s, rec in items]
+        return out[:limit] if limit else out
+
+    def max_seconds(self) -> float:
+        with self._lock:
+            return max((d for d, _s, _r in self._heap), default=0.0)
 
 _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "garage_tpu_current_span", default=None
@@ -72,25 +126,35 @@ class Span:
             self.error = f"{exc_type.__name__}: {exc}"
         _current_span.reset(self._token)
         self._tracer._record(self)
+        self._tracer.slow.note(
+            self.name, (self.end_ns - self.start_ns) / 1e9, self.attrs
+        )
         return False
 
 
-class _NullSpan:
-    """Shared no-op for disabled tracers — the hot-path cost is ~nothing."""
+class _LiteSpan:
+    """Timing-only span for tracers without an exporter: no ids, no
+    buffering, no contextvar — just perf_counter in/out feeding the
+    always-on slow-op log.  Cost per op: one object + two clock reads."""
 
-    __slots__ = ()
+    __slots__ = ("_log", "name", "attrs", "_t0")
+
+    def __init__(self, log: SlowOpLog, name: str, attrs: Dict[str, Any]):
+        self._log = log
+        self.name = name
+        self.attrs = attrs
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
 
     def __enter__(self):
+        self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        self._log.note(self.name, time.perf_counter() - self._t0,
+                       self.attrs)
         return False
-
-    def set_attr(self, key, value):
-        pass
-
-
-_NULL_SPAN = _NullSpan()
 
 
 class Tracer:
@@ -104,15 +168,19 @@ class Tracer:
         self._buf: deque = deque(maxlen=MAX_BUFFER)
         self.dropped = 0
         self.exported = 0
+        # always-on top-N slow-op retention — populated by every span
+        # exit whether or not a collector is configured
+        self.slow = SlowOpLog()
         self._task: Optional[asyncio.Task] = None
 
     # --- span creation ---
 
     def span(self, name: str, /, **attrs):
         """Child span of the context's current span (or a new trace root
-        if none)."""
+        if none).  Without an exporter, a timing-only lite span still
+        feeds the slow-op log."""
         if not self.enabled:
-            return _NULL_SPAN
+            return _LiteSpan(self.slow, name, attrs)
         parent = _current_span.get()
         if parent is not None:
             return Span(self, name, parent.trace_id, parent.span_id, attrs)
@@ -122,7 +190,7 @@ class Tracer:
         """Root span with a FRESH trace id — one per API request (ref
         generic_server.rs:187-200 gen_trace_id)."""
         if not self.enabled:
-            return _NULL_SPAN
+            return _LiteSpan(self.slow, name, attrs)
         return Span(self, name, os.urandom(16).hex(), None, attrs)
 
     def _record(self, span: Span) -> None:

@@ -40,7 +40,13 @@ def pytest_configure(config):
 # The axon TPU plugin overrides JAX_PLATFORMS from the environment, so force
 # the platform through the config API as well.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.4.34 has no jax_num_cpu_devices): the XLA_FLAGS
+    # --xla_force_host_platform_device_count=8 set above already provides
+    # the virtual 8-device mesh there
+    pass
 
 # The production default codec backend is "hybrid" (async background
 # device attach).  In-process test clusters must stay deterministic: the

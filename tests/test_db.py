@@ -361,3 +361,124 @@ def test_native_runtime_compaction_bounds_log(tmp_path):
     assert size < 8 * (1 << 20), f"log grew unbounded: {size}"
     assert len(t) == 64
     d.close()
+
+
+# --- memory-db WAL recovery diagnostics + snapshot durability
+#     (round-5 ADVICE #2 and #3) ---
+
+
+def _mem_wal_path(p):
+    import os
+
+    return os.path.join(p, "wal.log")
+
+
+def test_memory_wal_torn_tail_warns(tmp_path, caplog):
+    """A short final record (the expected kill -9 shape) must log a
+    WARNING naming the truncated byte count — not truncate silently."""
+    import logging
+    import struct
+
+    p = str(tmp_path / "db.mem")
+    d = open_db("memory", p)
+    t = d.open_tree("t")
+    t.insert(b"k1", b"v1")
+    d.close()
+    # append a torn record: a full header promising more bytes than exist
+    with open(_mem_wal_path(p), "ab") as f:
+        f.write(struct.pack("<II", 1000, 0) + b"short")
+    with caplog.at_level(logging.WARNING, logger="garage_tpu.db.memory"):
+        d2 = open_db("memory", p)
+    msgs = [r.getMessage() for r in caplog.records
+            if r.name == "garage_tpu.db.memory"]
+    assert any("torn tail" in m and "13" in m for m in msgs), msgs
+    assert not any("ACKNOWLEDGED" in m for m in msgs)
+    assert d2.open_tree("t").get(b"k1") == b"v1"
+    d2.close()
+    # the tail was truncated: a further clean reopen logs nothing
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="garage_tpu.db.memory"):
+        d3 = open_db("memory", p)
+    assert not [r for r in caplog.records
+                if r.name == "garage_tpu.db.memory"]
+    d3.close()
+
+
+def test_memory_wal_midfile_corruption_logs_error(tmp_path, caplog):
+    """A mid-file CRC mismatch FOLLOWED by parseable records is media
+    corruption eating acknowledged commits — it must log an ERROR
+    distinguishing it from a torn tail."""
+    import logging
+    import struct
+
+    p = str(tmp_path / "db.mem")
+    d = open_db("memory", p)
+    t = d.open_tree("t")
+    t.insert(b"k1", b"v1")
+    t.insert(b"k2", b"v2")
+    t.insert(b"k3", b"v3")
+    d.close()
+    wal = _mem_wal_path(p)
+    with open(wal, "rb") as f:
+        raw = f.read()
+    # records: [open_tree t][insert k1][insert k2][insert k3] — walk the
+    # framing to find the insert-k2 record, then corrupt its body so the
+    # insert-k3 record stays parseable after it
+    offs = []
+    off = 8  # magic
+    while off + 8 <= len(raw):
+        blen, _crc = struct.unpack_from("<II", raw, off)
+        offs.append((off, blen))
+        off += 8 + blen
+    assert len(offs) == 4, offs
+    off_k2, blen_k2 = offs[2]
+    body_pos = off_k2 + 8 + blen_k2 // 2
+    raw = raw[:body_pos] + bytes([raw[body_pos] ^ 0xFF]) + raw[body_pos + 1:]
+    with open(wal, "wb") as f:
+        f.write(raw)
+    with caplog.at_level(logging.WARNING, logger="garage_tpu.db.memory"):
+        d2 = open_db("memory", p)
+    msgs = [r.getMessage() for r in caplog.records
+            if r.name == "garage_tpu.db.memory"
+            and r.levelno >= logging.ERROR]
+    assert any("ACKNOWLEDGED" in m and "1 parseable" in m for m in msgs), \
+        [r.getMessage() for r in caplog.records]
+    t2 = d2.open_tree("t")
+    # only the records before the corruption replayed: k1 survives,
+    # k2 (corrupt) and k3 (after the corruption) are gone
+    assert t2.get(b"k1") == b"v1"
+    assert t2.get(b"k2") is None and t2.get(b"k3") is None
+    d2.close()
+
+
+def test_memory_snapshot_fsyncs_and_is_loadable(tmp_path, monkeypatch):
+    """snapshot() must fsync the copied snapshot, the stub WAL and the
+    destination directory before returning (mirroring _write_snapshot),
+    and the result must open as a valid db."""
+    import os
+
+    fsyncs = []
+    real_fsync = os.fsync
+
+    def counting_fsync(fd):
+        fsyncs.append(fd)
+        return real_fsync(fd)
+
+    p = str(tmp_path / "db.mem")
+    d = open_db("memory", p)
+    t = d.open_tree("t")
+    t.insert(b"k", b"v")
+    dest = str(tmp_path / "snap.mem")
+    monkeypatch.setattr(os, "fsync", counting_fsync)
+    n0 = len(fsyncs)
+    d.snapshot(dest)
+    monkeypatch.setattr(os, "fsync", real_fsync)
+    # _write_snapshot itself fsyncs (tmp file, dir, wal reset) — the
+    # copy-out adds at least 3 more: dst snap, dst wal stub, dst dir
+    assert len(fsyncs) - n0 >= 6, f"only {len(fsyncs) - n0} fsyncs"
+    t.insert(b"k2", b"after-snapshot")
+    d.close()
+    d2 = open_db("memory", dest)
+    t2 = d2.open_tree("t")
+    assert t2.get(b"k") == b"v" and t2.get(b"k2") is None
+    d2.close()

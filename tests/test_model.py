@@ -1434,6 +1434,19 @@ async def test_get_survives_silent_sole_copy_loss_via_read_decode(tmp_path):
             "holder never re-materialized the lost copy"
         blk = await holder.block_manager.read_block(covered)
         assert blk.decompressed() == datas[hs.index(covered)]
+
+        # heal ATTRIBUTION (round-5 heal non-repro): the reader that ran
+        # the decode must have recorded exactly a write-back heal — not a
+        # resync-chain one (resync was stubbed out above) — and the
+        # counter must be scrapeable from its registry
+        reader = garages[0].block_manager
+        assert reader.heal_counts.get("writeback", 0) >= 1, \
+            reader.heal_counts
+        assert reader.m_heal.get(source="writeback") >= 1
+        assert 'block_heal_total{source="writeback"}' in \
+            garages[0].system.metrics.render()
+        for g in garages:
+            assert g.block_manager.heal_counts.get("resync_fetch", 0) == 0
     finally:
         for g in garages:
             await g.shutdown()
