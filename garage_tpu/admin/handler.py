@@ -383,6 +383,44 @@ class AdminRpcHandler:
         await self.garage.key_table.insert(k)
         return "updated"
 
+    # --- cluster network health (no reference equivalent: the per-peer
+    #     RPC-fabric view `garage node` ops keep asking for) ---------------
+
+    async def _cmd_cluster_stats(self, msg) -> Dict:
+        """Per-peer network health: RTT EWMA, liveness, failure streaks,
+        reconnect churn, and live per-priority traffic split — the
+        straggler-attribution view (a quorum PUT stalling on ONE slow
+        peer shows up here as that peer's RTT/backlog, not as generic
+        API latency)."""
+        sys = self.garage.system
+        now = time.monotonic()
+        peers = []
+        for nid, st in sys.peering.peers.items():
+            conn = sys.netapp.conns.get(nid)
+            status = sys.node_status.get(nid)
+            peers.append({
+                "id": bytes(nid).hex(),
+                "hostname": status.hostname if status else None,
+                "addr": st.addr,
+                "up": st.is_up,
+                "connected": conn is not None and not conn._closed,
+                "rtt_ewma_ms": (
+                    round(st.latency * 1000.0, 3)
+                    if st.latency is not None else None),
+                "consecutive_failures": st.failures,
+                "reconnects": st.reconnects,
+                "ping_failures": st.ping_failures,
+                "last_seen_secs_ago": (
+                    round(now - st.last_seen, 1)
+                    if st.last_seen is not None else None),
+                "traffic": conn.traffic_stats() if conn is not None else None,
+            })
+        peers.sort(key=lambda p: (not p["up"], p["id"]))
+        return {
+            "node_id": bytes(sys.id).hex(),
+            "peers": peers,
+        }
+
     # --- workers / repair / stats -----------------------------------------
 
     async def _cmd_worker_list(self, msg) -> List[Dict]:

@@ -178,10 +178,13 @@ class AdminApiServer:
         gauge("cluster_available", 1 if h.status != "unavailable" else 0)
         gauge("cluster_connected_nodes", h.connected_nodes)
         gauge("cluster_known_nodes", h.known_nodes)
-        # refresh per-table observed gauges, then render the registry that
-        # the rpc/table/block/api layers record into
+        # refresh scrape-time observed gauges (per-table backlogs, the
+        # per-worker status registry, per-peer health), then render the
+        # registry that the rpc/table/block/api layers record into
         for t in g.tables:
             t.observe_gauges()
+        g.bg.observe_gauges(g.system.metrics)
+        g.system.peering.observe_gauges()
         body = "\n".join(lines) + "\n" + g.system.metrics.render()
         return web.Response(text=body, content_type="text/plain")
 

@@ -8,6 +8,7 @@ encoding helpers.
 
 from __future__ import annotations
 
+import os
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, Optional, Tuple
@@ -203,8 +204,13 @@ def request_trace(tracer, title: str, api: str, request):
     """Per-request trace root shared by the S3/K2V/Web servers (ref
     api/generic_server.rs:187-200 creates one span per request with a
     fresh trace id).  Records method/path, the TCP peer, and the
-    forwarded client address when it differs.  No-op when tracing is
-    off."""
+    forwarded client address when it differs.
+
+    → (span, request_id).  The request id IS the trace id (it seeds the
+    root span), so the `x-amz-request-id` a client quotes in a support
+    ticket is the exact key to look the distributed trace up by.  The
+    id exists even with tracing off — clients always get one."""
+    rid = os.urandom(16).hex()
     attrs = {
         "api": api,
         "method": request.method,
@@ -214,7 +220,9 @@ def request_trace(tracer, title: str, api: str, request):
     fwd = client_addr(request)
     if fwd != attrs["peer"]:
         attrs["forwarded_for"] = fwd
-    return tracer.new_trace(f"{title} {request.method}", **attrs)
+    return tracer.new_trace(
+        f"{title} {request.method}", trace_id=rid, **attrs
+    ), rid
 
 
 def host_to_bucket(host: str, root_domain: Optional[str]) -> Optional[str]:

@@ -24,7 +24,6 @@ from aiohttp import web
 
 from ..model.helper import NoSuchBucket, NoSuchKey
 from ..model.k2v.causality import CausalContext
-from ..utils.data import gen_uuid
 from .common import (
     AccessDeniedError,
     ApiError,
@@ -67,21 +66,23 @@ class K2VApiServer:
             await self._runner.cleanup()
 
     async def handle_request(self, request: web.Request) -> web.StreamResponse:
-        trace = request_trace(
+        trace, rid = request_trace(
             self.garage.system.tracer, "K2V", "k2v", request)
         with trace:
-            resp = await self._handle_with_errors(request)
+            resp = await self._handle_with_errors(request, rid)
             trace.set_attr("status", resp.status)
+            if not resp.prepared:
+                resp.headers["x-amz-request-id"] = rid
             return resp
 
-    async def _handle_with_errors(self, request) -> web.StreamResponse:
+    async def _handle_with_errors(self, request, rid: str) -> web.StreamResponse:
         try:
             return await self._handle(request)
         except (ApiError, NoSuchBucket, NoSuchKey) as e:
             status = getattr(e, "status", 500)
             return web.Response(
                 status=status,
-                body=error_xml(e, request.path, bytes(gen_uuid()).hex()[:16]),
+                body=error_xml(e, request.path, rid),
                 content_type="application/xml",
             )
         except ConnectionError as e:  # incl. ConnectionResetError
@@ -90,7 +91,7 @@ class K2VApiServer:
         except Exception as e:  # noqa: BLE001
             logger.exception("K2V API error")
             return web.Response(
-                status=500, body=error_xml(e, request.path, ""),
+                status=500, body=error_xml(e, request.path, rid),
                 content_type="application/xml",
             )
 

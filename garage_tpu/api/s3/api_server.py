@@ -22,7 +22,6 @@ from ...model.helper import (
     NoSuchBucket,
     NoSuchKey,
 )
-from ...utils.data import gen_uuid
 from ...utils.metrics import maybe_time
 from ..common import (
     AccessDeniedError,
@@ -99,16 +98,20 @@ class S3ApiServer:
         if self._m is not None:
             self._m["requests"].inc(api="s3")
         # fresh trace per request (ref generic_server.rs:187-200); child
-        # spans (table ops, quorum RPCs, block IO) parent under it via the
-        # context variable.  new_trace is a shared no-op when tracing is
-        # off (set_attr included).
-        trace = request_trace(self.garage.system.tracer, "S3", "s3", request)
+        # spans (table ops, quorum RPCs, block IO — on EVERY node the
+        # request touches, via the propagated context) parent under it.
+        # The request id returned to the client IS the trace id, so a
+        # quoted x-amz-request-id is the trace lookup key.
+        trace, rid = request_trace(
+            self.garage.system.tracer, "S3", "s3", request)
         with trace, maybe_time(self._m and self._m["duration"], api="s3"):
-            resp = await self._handle_with_errors(request)
+            resp = await self._handle_with_errors(request, rid)
             trace.set_attr("status", resp.status)
+            if not resp.prepared:
+                resp.headers["x-amz-request-id"] = rid
             return resp
 
-    async def _handle_with_errors(self, request) -> web.StreamResponse:
+    async def _handle_with_errors(self, request, rid: str) -> web.StreamResponse:
         try:
             return await self._handle(request)
         except ConnectionError as e:  # incl. ConnectionResetError
@@ -128,7 +131,7 @@ class S3ApiServer:
                 logger.debug("S3 API error %s: %s", status, e)
             return web.Response(
                 status=status,
-                body=error_xml(e, request.path, bytes(gen_uuid()).hex()[:16]),
+                body=error_xml(e, request.path, rid),
                 content_type="application/xml",
             )
         except Exception as e:  # noqa: BLE001 — uniform 500 rendering
@@ -138,7 +141,7 @@ class S3ApiServer:
             logger.exception("S3 API unexpected error")
             return web.Response(
                 status=500,
-                body=error_xml(e, request.path, ""),
+                body=error_xml(e, request.path, rid),
                 content_type="application/xml",
             )
 

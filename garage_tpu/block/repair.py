@@ -590,6 +590,9 @@ class RepairWorker(Worker):
                 self.cursor = key
                 batch += 1
             self.status().progress = "phase 1"
+            # the backlog this sweep is generating: resync drains it, so
+            # `worker list` shows sweep progress AND the induced queue
+            self.status().queue_length = mgr.resync.queue_len()
             return WorkerState.BUSY
         batch = await asyncio.to_thread(self.iterator.next_prefix)
         if batch is None:

@@ -63,6 +63,17 @@ def _build_parser() -> argparse.ArgumentParser:
     pc = sub.add_parser("connect", help="connect to a peer (id@host:port)")
     pc.add_argument("peer")
 
+    pcl = sub.add_parser(
+        "cluster",
+        help="cluster-level network observability (per-peer health)")
+    cls_ = pcl.add_subparsers(dest="cluster_cmd", required=True)
+    cst = cls_.add_parser(
+        "stats",
+        help="per-peer RTT / liveness / reconnect churn / per-priority "
+             "traffic split")
+    cst.add_argument("--json", action="store_true",
+                     help="raw JSON instead of the table rendering")
+
     pl = sub.add_parser("layout", help="cluster layout operations")
     ls = pl.add_subparsers(dest="layout_cmd", required=True)
     la = ls.add_parser("assign")
@@ -231,6 +242,14 @@ def _perm_str(p) -> str:
     return "".join(c if f else "-" for c, f in zip("RWO", p))
 
 
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
 async def _amain(args) -> None:
     if args.command == "server":
         from .server import run_server
@@ -348,6 +367,34 @@ async def _amain(args) -> None:
         else:
             nid, addr = None, args.peer
         print(await client.call({"cmd": "connect", "addr": addr, "node_id": nid}))
+        return
+
+    if args.command == "cluster":
+        if args.cluster_cmd == "stats":
+            st = await client.call({"cmd": "cluster_stats"})
+            if args.json:
+                print(json.dumps(st, indent=2))
+                return
+            print(f"==== Node: {st['node_id'][:16]}… — peer health ====")
+            rows = ["PEER\tADDR\tUP\tRTT\tFAILS\tRECONN\tTX\tRX\tBG TX%"]
+            for p in st["peers"]:
+                tr = p.get("traffic") or {}
+                tx = sum(v["tx_bytes"] for v in tr.values())
+                rx = sum(v["rx_bytes"] for v in tr.values())
+                bg = tr.get("background", {}).get("tx_bytes", 0)
+                rtt = p["rtt_ewma_ms"]
+                rows.append("\t".join([
+                    f"{p['id'][:16]}…",
+                    p["addr"] or "-",
+                    "up" if p["up"] else "DOWN",
+                    f"{rtt}ms" if rtt is not None else "-",
+                    str(p["consecutive_failures"]),
+                    str(p["reconnects"]),
+                    _fmt_bytes(tx) if tr else "-",
+                    _fmt_bytes(rx) if tr else "-",
+                    f"{100.0 * bg / tx:.0f}%" if tx else "-",
+                ]))
+            print(format_table(rows))
         return
 
     if args.command == "layout":

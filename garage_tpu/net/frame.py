@@ -25,6 +25,9 @@ PRIO_BACKGROUND = 3  # resync/scrub/rebalance bulk traffic
 
 N_PRIO = 4
 
+# Metric/display labels for the priority levels (index == PRIO_* value).
+PRIO_NAMES = ("high", "normal", "secondary", "background")
+
 # Frame kinds.
 K_REQ = 1        # open stream: payload = msgpack request header + body blob
 K_RESP = 2       # payload = msgpack response header + body blob

@@ -38,6 +38,7 @@ import os
 import struct
 import time
 from collections import deque
+from contextlib import nullcontext
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 import msgpack
@@ -45,7 +46,14 @@ import msgpack
 from .ed25519_compat import Ed25519PrivateKey, Ed25519PublicKey, serialization
 
 from ..utils.data import FixedBytes32
-from ..utils.error import RpcError
+from ..utils.error import RpcError, error_code, remote_error
+from ..utils.tracing import (
+    TraceContext,
+    current_trace_context,
+    inherited_priority,
+    reset_remote_context,
+    set_remote_context,
+)
 from .frame import (
     CHUNK,
     HDR_SIZE,
@@ -63,6 +71,7 @@ from .frame import (
     N_PRIO,
     PRIO_HIGH,
     PRIO_NORMAL,
+    PRIO_NAMES,
     Frame,
     decode_header,
 )
@@ -70,6 +79,8 @@ from .frame import (
 logger = logging.getLogger("garage_tpu.net")
 
 NodeID = FixedBytes32
+
+_NULL_CTX = nullcontext()
 
 MAGIC = b"GTPU/1\n"
 _OUT_QUEUE_LIMIT = 16       # frames buffered per priority level
@@ -132,6 +143,7 @@ class ByteStream:
                  maxsize: int = STREAM_WINDOW + 2):
         self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
         self._err: Optional[str] = None
+        self._err_code: Optional[str] = None  # structured K_ERR code
         self._on_consumed = on_consumed
         self._on_cancel = on_cancel
         self._consumed = 0
@@ -147,12 +159,20 @@ class ByteStream:
             # only a sender ignoring the credit window can get here
             self._fail("flow-control window violated by sender")
 
-    def _fail(self, err: str):
+    def _fail(self, err: str, code: Optional[str] = None):
         self._err = err
+        self._err_code = code
         try:
             self._q.put_nowait(None)
         except asyncio.QueueFull:
             pass  # consumer drains the queue, then sees _err
+
+    def _raise_err(self):
+        """Stream failure as an exception: typed when the sender shipped
+        a structured code in its K_ERR frame, plain RpcError otherwise."""
+        if self._err_code is not None:
+            raise remote_error(self._err_code, f"stream error: {self._err}")
+        raise RpcError(f"stream error: {self._err}")
 
     def __aiter__(self):
         return self
@@ -160,12 +180,12 @@ class ByteStream:
     async def __anext__(self) -> bytes:
         if self._err is not None and self._q.empty():
             self._done = True
-            raise RpcError(f"stream error: {self._err}")
+            self._raise_err()
         chunk = await self._q.get()
         if chunk is None:
             self._done = True
             if self._err is not None:
-                raise RpcError(f"stream error: {self._err}")
+                self._raise_err()
             raise StopAsyncIteration
         if self._on_consumed is not None:
             self._consumed += 1
@@ -239,7 +259,12 @@ class Endpoint:
 
 
 class _OutMux:
-    """Bounded per-priority outgoing frame queues + strict-priority pop."""
+    """Bounded per-priority outgoing frame queues + strict-priority pop.
+
+    Entries carry their enqueue timestamp so the writer can report how
+    long each frame waited for the wire — the direct measure of
+    priority-queue head-of-line blocking (a PRIO_HIGH gossip frame stuck
+    behind bulk shows up as queue-wait, not as mystery RPC latency)."""
 
     def __init__(self):
         self.queues = [deque() for _ in range(N_PRIO)]
@@ -254,17 +279,18 @@ class _OutMux:
                 await self.cv.wait()
             if self.closed:
                 raise RpcError("connection closed")
-            self.queues[frame.prio].append(frame)
+            self.queues[frame.prio].append((frame, time.perf_counter()))
             self.cv.notify_all()
 
-    async def pop(self) -> Optional[Frame]:
+    async def pop(self) -> Optional[Tuple[Frame, float]]:
+        """→ (frame, enqueue_perf_counter) or None when closed+drained."""
         async with self.cv:
             while True:
                 for q in self.queues:
                     if q:
-                        frame = q.popleft()
+                        entry = q.popleft()
                         self.cv.notify_all()
-                        return frame
+                        return entry
                 if self.closed:
                     return None
                 await self.cv.wait()
@@ -348,6 +374,42 @@ class Connection:
         self._tasks: list = []
         self._closed = False
         self.last_seen = time.monotonic()
+        # per-peer per-priority traffic accounting, read by the Prometheus
+        # counters below and by the `cluster stats` admin command
+        self.tx_bytes = [0] * N_PRIO
+        self.tx_frames = [0] * N_PRIO
+        self.rx_bytes = [0] * N_PRIO
+        self.rx_frames = [0] * N_PRIO
+        self._peer_id_hex = bytes(remote_id).hex()[:16]
+        self._peer_durable = False
+
+    @property
+    def _peer_label(self) -> str:
+        """Metric label for this peer.  Connections from peers the node
+        cannot redial (CLI clients with throwaway keypairs) aggregate
+        under 'transient' — every `garage status` otherwise mints a new
+        immortal counter series, unbounded over a daemon's lifetime.
+        Once a peer proves durable (a dialable address is known) the
+        real label sticks."""
+        if self._peer_durable:
+            return self._peer_id_hex
+        fn = self.netapp.peer_durable_fn
+        if fn is None or fn(self.remote_id):
+            self._peer_durable = True
+            return self._peer_id_hex
+        return "transient"
+
+    def traffic_stats(self) -> Dict[str, Dict[str, int]]:
+        """{prio_name: {tx_bytes, tx_frames, rx_bytes, rx_frames}}."""
+        return {
+            PRIO_NAMES[p]: {
+                "tx_bytes": self.tx_bytes[p],
+                "tx_frames": self.tx_frames[p],
+                "rx_bytes": self.rx_bytes[p],
+                "rx_frames": self.rx_frames[p],
+            }
+            for p in range(N_PRIO)
+        }
 
     def start(self):
         loop = asyncio.get_running_loop()
@@ -374,9 +436,16 @@ class Connection:
         if self._closed:
             raise RpcError(f"connection to {self.remote_id.hex_short()} closed")
         sid = self._alloc_stream()
-        header = msgpack.packb(
-            {"p": path, "b": body is not None}, use_bin_type=True
-        )
+        hdr_obj: Dict[str, Any] = {"p": path, "b": body is not None}
+        # cross-node trace propagation: the caller's span identity rides
+        # the request header, so the remote handler's spans join THIS
+        # trace instead of starting an orphan one
+        ctx = current_trace_context()
+        if ctx is not None:
+            hdr_obj["tc"] = TraceContext(
+                ctx.trace_id, ctx.span_id, prio
+            ).pack()
+        header = msgpack.packb(hdr_obj, use_bin_type=True)
         fut = asyncio.get_running_loop().create_future()
         self._pending[sid] = fut
         try:
@@ -399,7 +468,9 @@ class Connection:
             rheader = msgpack.unpackb(resp_payload[4 : 4 + hlen], raw=False)
             rbody = resp_payload[4 + hlen :]
             if not rheader.get("ok", False):
-                raise RpcError(rheader.get("err", "remote error"))
+                raise remote_error(
+                    rheader.get("code"), rheader.get("err", "remote error")
+                )
             return rbody, stream
         except asyncio.TimeoutError:
             raise RpcError(
@@ -428,7 +499,12 @@ class Connection:
         except Exception as e:
             logger.debug("body pump error on stream %d: %s", sid, e)
             try:
-                await self._out.put(Frame(K_ERR, prio, sid, str(e).encode()))
+                # structured abort: code + message, so the receiver can
+                # re-raise the domain error type and label its metrics
+                payload = msgpack.packb(
+                    {"c": error_code(e), "m": str(e)}, use_bin_type=True
+                )
+                await self._out.put(Frame(K_ERR, prio, sid, payload))
             except RpcError:
                 pass
         finally:
@@ -457,11 +533,25 @@ class Connection:
     # --- loops ---
 
     async def _write_loop(self):
+        nm = self.netapp._net_metrics
         try:
             while True:
-                frame = await self._out.pop()
-                if frame is None:
+                entry = await self._out.pop()
+                if entry is None:
                     break
+                frame, t_enq = entry
+                self.tx_frames[frame.prio] += 1
+                self.tx_bytes[frame.prio] += HDR_SIZE + len(frame.payload)
+                if nm is not None:
+                    prio_name = PRIO_NAMES[frame.prio]
+                    nm["queue_wait"].observe(
+                        time.perf_counter() - t_enq, prio=prio_name
+                    )
+                    nm["tx_frames"].inc(peer=self._peer_label, prio=prio_name)
+                    nm["tx_bytes"].inc(
+                        HDR_SIZE + len(frame.payload),
+                        peer=self._peer_label, prio=prio_name,
+                    )
                 self.writer.write(frame.encode())
                 await self.writer.drain()
         except (ConnectionError, asyncio.CancelledError, OSError):
@@ -470,6 +560,7 @@ class Connection:
             await self._shutdown()
 
     async def _read_loop(self):
+        nm = self.netapp._net_metrics
         try:
             while True:
                 hdr = await self.reader.readexactly(HDR_SIZE)
@@ -478,6 +569,15 @@ class Connection:
                     raise RpcError(f"oversized frame: {length}")
                 payload = await self.reader.readexactly(length) if length else b""
                 self.last_seen = time.monotonic()
+                if prio < N_PRIO:
+                    self.rx_frames[prio] += 1
+                    self.rx_bytes[prio] += HDR_SIZE + length
+                    if nm is not None:
+                        nm["rx_frames"].inc(
+                            peer=self._peer_label, prio=PRIO_NAMES[prio])
+                        nm["rx_bytes"].inc(
+                            HDR_SIZE + length,
+                            peer=self._peer_label, prio=PRIO_NAMES[prio])
                 await self._dispatch(kind, prio, sid, payload)
         except (
             asyncio.IncompleteReadError,
@@ -521,7 +621,7 @@ class Connection:
                 body = self._make_in_stream(sid)
                 self._in_streams[sid] = body
             asyncio.get_running_loop().create_task(
-                self._handle_request(sid, prio, header["p"], msg, body)
+                self._handle_request(sid, prio, header, msg, body)
             )
         elif kind == K_RESP:
             # register the body stream before resolving the future, and hand
@@ -557,7 +657,13 @@ class Connection:
         elif kind == K_ERR:
             stream = self._in_streams.pop(sid, None)
             if stream is not None:
-                stream._fail(payload.decode("utf-8", "replace"))
+                try:
+                    err = msgpack.unpackb(payload, raw=False)
+                    stream._fail(str(err.get("m", "remote error")),
+                                 code=err.get("c"))
+                except Exception:
+                    # pre-structured peers sent bare utf-8 text
+                    stream._fail(payload.decode("utf-8", "replace"))
         elif kind == K_PING:
             await self._out.put(Frame(K_PONG, PRIO_HIGH, 0, payload))
         elif kind == K_PONG:
@@ -568,7 +674,37 @@ class Connection:
             raise RpcError("peer said goodbye")
 
     async def _handle_request(
-        self, sid: int, prio: int, path: str, msg: bytes, body: Optional[ByteStream]
+        self, sid: int, prio: int, header: dict, msg: bytes,
+        body: Optional[ByteStream],
+    ):
+        path = header["p"]
+        # cross-node trace propagation, server side: extract the caller's
+        # context and (a) wrap the handler in a span parented on it, so
+        # every node an RPC touches contributes spans to ONE trace, and
+        # (b) install it task-locally so deeper spans and further hops
+        # inherit it.  This task is freshly created per request, so the
+        # contextvar never leaks across requests.
+        tctx = TraceContext.unpack(header.get("tc")) if header.get("tc") else None
+        token = set_remote_context(tctx) if tctx is not None else None
+        tracer = self.netapp.tracer
+        if tracer is not None and tctx is not None:
+            span = tracer.span_from_context(
+                f"RPC handler {path}", tctx,
+                **{"from": self._peer_label, "prio": PRIO_NAMES[prio]
+                   if prio < N_PRIO else prio},
+            )
+        else:
+            span = _NULL_CTX
+        try:
+            with span:
+                await self._handle_request_inner(sid, prio, path, msg, body)
+        finally:
+            if token is not None:
+                reset_remote_context(token)
+
+    async def _handle_request_inner(
+        self, sid: int, prio: int, path: str, msg: bytes,
+        body: Optional[ByteStream],
     ):
         ep = self.netapp.endpoints.get(path)
         try:
@@ -581,7 +717,10 @@ class Connection:
             raise
         except Exception as e:
             logger.debug("handler %s error: %s", path, e)
-            header = msgpack.packb({"ok": False, "err": str(e)}, use_bin_type=True)
+            header = msgpack.packb(
+                {"ok": False, "err": str(e), "code": error_code(e)},
+                use_bin_type=True,
+            )
             try:
                 await self._out.put(
                     Frame(K_RESP, prio, sid, struct.pack(">I", len(header)) + header)
@@ -644,6 +783,36 @@ class NetApp:
         self._server: Optional[asyncio.AbstractServer] = None
         self._dial_locks: Dict[str, asyncio.Lock] = {}
         self._addr_ids: Dict[str, NodeID] = {}  # addr -> last node seen there
+        # set by System: server-side handler spans parent on the caller's
+        # propagated trace context
+        self.tracer = None
+        self._net_metrics: Optional[Dict[str, Any]] = None
+        # set by System: NodeID -> bool, True when the peer has a known
+        # dialable address (metric series worth keeping per-peer)
+        self.peer_durable_fn: Optional[Callable[[NodeID], bool]] = None
+
+    def set_metrics(self, registry) -> None:
+        """Attach per-peer traffic + queue-wait instruments (called by
+        System; bare NetApps — tests, the CLI's throwaway client — stay
+        uninstrumented)."""
+        self._net_metrics = {
+            "tx_bytes": registry.counter(
+                "net_peer_tx_bytes_total",
+                "Frame bytes written per peer and priority"),
+            "tx_frames": registry.counter(
+                "net_peer_tx_frames_total",
+                "Frames written per peer and priority"),
+            "rx_bytes": registry.counter(
+                "net_peer_rx_bytes_total",
+                "Frame bytes read per peer and priority"),
+            "rx_frames": registry.counter(
+                "net_peer_rx_frames_total",
+                "Frames read per peer and priority"),
+            "queue_wait": registry.histogram(
+                "net_queue_wait_seconds",
+                "Time outgoing frames waited in the priority queues "
+                "before hitting the wire (head-of-line blocking signal)"),
+        }
 
     def endpoint(self, path: str) -> Endpoint:
         ep = self.endpoints.get(path)
@@ -788,6 +957,13 @@ class NetApp:
         timeout: Optional[float] = 30.0,
         body: Optional[AsyncIterator[bytes]] = None,
     ) -> Tuple[Any, Optional[ByteStream]]:
+        # priority inheritance (demote-only): work spawned while serving
+        # a background-priority request never jumps ahead of it — a
+        # resync-triggered nested fetch must not compete with user
+        # traffic just because its call site asked for PRIO_NORMAL
+        inherited = inherited_priority()
+        if inherited is not None and inherited > prio:
+            prio = inherited
         msg_bytes = msgpack.packb(msg, use_bin_type=True)
         if node == self.id:
             return await self._local_call(path, msg_bytes, body)

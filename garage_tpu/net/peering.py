@@ -32,6 +32,8 @@ class PeerState:
     latency: Optional[float] = None       # EWMA RTT seconds
     last_seen: Optional[float] = None     # monotonic, last successful ping
     failures: int = 0                     # consecutive connect/ping failures
+    reconnects: int = 0                   # successful re-establishments
+    ping_failures: int = 0                # lifetime failed pings/dials
     addrs_tried: Set[str] = field(default_factory=set)
 
     @property
@@ -48,7 +50,7 @@ class FullMeshPeering:
     list, and layout gossip (the rpc System layer feeds those in via
     `add_peer`)."""
 
-    def __init__(self, netapp: NetApp):
+    def __init__(self, netapp: NetApp, metrics=None):
         self.netapp = netapp
         self.peers: Dict[NodeID, PeerState] = {}
         self._addr_only: Set[str] = set()   # peers known only by address
@@ -56,6 +58,47 @@ class FullMeshPeering:
         self._stopped = asyncio.Event()
         netapp.on_connected = self._on_connected
         netapp.on_disconnected = self._on_disconnected
+        # per-peer health instruments: RTT EWMA / liveness / failure
+        # streak are mirrored into gauges at scrape (observe_gauges);
+        # reconnects and ping failures are counted at event time
+        if metrics is not None:
+            self._m = {
+                "rtt": metrics.gauge(
+                    "peer_rtt_ewma_seconds",
+                    "Smoothed ping round-trip time per peer"),
+                "up": metrics.gauge(
+                    "peer_up", "1 when the peer answers pings"),
+                "failures": metrics.gauge(
+                    "peer_consecutive_failures",
+                    "Consecutive failed dials/pings per peer"),
+                "reconnect": metrics.counter(
+                    "peer_reconnect_total",
+                    "Connection re-establishments per peer"),
+                "ping_fail": metrics.counter(
+                    "peer_ping_failure_total",
+                    "Failed pings/dials per peer"),
+            }
+        else:
+            self._m = None
+
+    @staticmethod
+    def _label(node: NodeID) -> str:
+        return bytes(node).hex()[:16]
+
+    def observe_gauges(self) -> None:
+        """Refresh the per-peer gauges from PeerState (called at scrape
+        time by the admin /metrics handler).  Clear-then-set so forgotten
+        peers drop out instead of freezing at their last value."""
+        if self._m is None:
+            return
+        for g in ("rtt", "up", "failures"):
+            self._m[g].clear()
+        for nid, st in self.peers.items():
+            lbl = self._label(nid)
+            if st.latency is not None:
+                self._m["rtt"].set(st.latency, peer=lbl)
+            self._m["up"].set(1.0 if st.is_up else 0.0, peer=lbl)
+            self._m["failures"].set(float(st.failures), peer=lbl)
 
     # --- peer book ---
 
@@ -103,6 +146,13 @@ class FullMeshPeering:
 
     def _on_connected(self, node: NodeID, is_dialer: bool):
         st = self.peers.setdefault(node, PeerState())
+        if st.last_seen is not None:
+            # not the first contact: this is a RE-connection — the churn
+            # counter operators alert on (flapping link, crash-looping
+            # peer)
+            st.reconnects += 1
+            if self._m is not None:
+                self._m["reconnect"].inc(peer=self._label(node))
         st.failures = 0
         st.last_seen = time.monotonic()
         logger.debug("connected to %s", node.hex_short())
@@ -156,6 +206,9 @@ class FullMeshPeering:
             st.failures = 0
         except Exception as e:
             st.failures += 1
+            st.ping_failures += 1
+            if self._m is not None:
+                self._m["ping_fail"].inc(peer=self._label(nid))
             logger.debug("dial %s (%s) failed: %s", nid.hex_short(), st.addr, e)
 
     async def _ping(self, nid: NodeID, st: PeerState, conn):
@@ -169,4 +222,7 @@ class FullMeshPeering:
             st.failures = 0
         except Exception as e:
             st.failures += 1
+            st.ping_failures += 1
+            if self._m is not None:
+                self._m["ping_fail"].inc(peer=self._label(nid))
             logger.debug("ping %s failed: %s", nid.hex_short(), e)

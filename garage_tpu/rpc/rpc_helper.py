@@ -73,16 +73,22 @@ class RpcHelper:
         async def timed(*a, **kw):
             import time as _time
 
+            from ..utils.error import error_code
+
             self.m_requests.inc(endpoint=endpoint_path)
             t0 = _time.perf_counter()
             try:
                 return await coro_fn(*a, **kw)
             except asyncio.TimeoutError:
                 self.m_timeouts.inc(endpoint=endpoint_path)
-                self.m_errors.inc(endpoint=endpoint_path)
+                self.m_errors.inc(endpoint=endpoint_path, error="Timeout")
                 raise
-            except Exception:
-                self.m_errors.inc(endpoint=endpoint_path)
+            except Exception as e:
+                # the error label is the structured wire code (satellite:
+                # K_ERR/K_RESP carry a code, so remote domain errors keep
+                # their type here instead of collapsing into one bucket)
+                self.m_errors.inc(
+                    endpoint=endpoint_path, error=error_code(e))
                 raise
             finally:
                 self.m_duration.observe(

@@ -107,20 +107,32 @@ class System:
         )
         self.netapp = NetApp(self.node_key, config.rpc_secret)
         self.id = self.netapp.id
-        self.peering = FullMeshPeering(self.netapp)
         # per-node metrics registry: every layer records into it and the
         # admin /metrics endpoint renders it (ref util/metrics.rs + the
-        # per-layer metric structs)
+        # per-layer metric structs).  Built BEFORE peering/netapp wiring
+        # so the transport's per-peer instruments register into it.
         from ..utils.metrics import MetricsRegistry
         from ..utils.tracing import init_tracing
 
         self.metrics = MetricsRegistry()
+        self.netapp.set_metrics(self.metrics)
+        self.peering = FullMeshPeering(self.netapp, metrics=self.metrics)
+        # per-peer metric series only for peers with a dialable address;
+        # throwaway CLI connections aggregate under peer="transient"
+        # (unbounded label growth otherwise)
+        self.netapp.peer_durable_fn = lambda nid: (
+            (st := self.peering.peers.get(nid)) is not None
+            and st.addr is not None
+        )
         # tracer next to the metrics registry: spans export to
         # admin.trace_sink when configured, no-op otherwise (ref
         # garage/tracing_setup.rs:13-37)
         self.tracer = init_tracing(
             getattr(config, "admin_trace_sink", None), bytes(self.id)
         )
+        # the transport parents incoming-request handler spans on the
+        # caller's propagated context (cross-node traces)
+        self.netapp.tracer = self.tracer
         # tracer self-observability: exporter health + the always-on
         # slow-op log's high-water mark are scrapeable, so "is tracing
         # even working" never needs a collector to answer

@@ -32,6 +32,7 @@ class InsertQueueWorker(Worker):
             batch.append((k, v))
             if len(batch) >= BATCH_SIZE:
                 break
+        self.status().queue_length = len(data.insert_queue)
         if not batch:
             return WorkerState.IDLE
         entries = []

@@ -228,6 +228,7 @@ class SyncWorker(Worker):
         st = self.status()
         if time.monotonic() >= self.next_full_sync:
             self.add_full_sync()
+        st.queue_length = len(self.todo)
         if not self.todo:
             return WorkerState.IDLE
         partition, first_hash = self.todo.pop(0)

@@ -36,6 +36,7 @@ class WorkerStatus:
 
     def __init__(self) -> None:
         self.state = WorkerState.IDLE
+        self.iterations = 0
         self.errors = 0
         self.consecutive_errors = 0
         self.last_error: Optional[str] = None
@@ -49,6 +50,7 @@ class WorkerStatus:
     def to_dict(self) -> Dict[str, Any]:
         return {
             "state": self.state.value,
+            "iterations": self.iterations,
             "errors": self.errors,
             "consecutive_errors": self.consecutive_errors,
             "last_error": self.last_error,
@@ -125,6 +127,7 @@ class BackgroundRunner:
         while not self.stopping.is_set():
             try:
                 state = await worker.work()
+                status.iterations += 1
                 status.consecutive_errors = 0
                 status.state = state
             except asyncio.CancelledError:
@@ -176,6 +179,45 @@ class BackgroundRunner:
             wid: {"name": w.name(), **w.status().to_dict()}
             for wid, w in self.workers.items()
         }
+
+    def observe_gauges(self, registry) -> None:
+        """Mirror every worker's status into labelled Prometheus gauges —
+        called at scrape time by the admin /metrics handler (the same
+        pattern as Table.observe_gauges).  Clear-then-set: a reaped
+        worker's series must disappear, not freeze.
+
+        One series per (id, name) pair; state is a 0/1 family over the
+        four WorkerState values so `worker_state{state="busy"} == 1`
+        selects busy workers without string-valued metrics."""
+        g_state = registry.gauge(
+            "worker_state", "1 for the worker's current state, 0 otherwise")
+        g_iter = registry.gauge(
+            "worker_iterations", "Completed work() iterations")
+        g_err = registry.gauge("worker_errors", "Total worker errors")
+        g_cerr = registry.gauge(
+            "worker_consecutive_errors",
+            "Consecutive errors (drives the retry backoff)")
+        g_queue = registry.gauge(
+            "worker_queue_length",
+            "Backlog the worker is draining (todo/queue/backlog depth)")
+        g_perr = registry.gauge(
+            "worker_persistent_errors",
+            "Entries parked in the worker's error/backoff set")
+        for g in (g_state, g_iter, g_err, g_cerr, g_queue, g_perr):
+            g.clear()
+        for wid, w in self.workers.items():
+            st = w.status()
+            lbl = {"id": str(wid), "name": w.name()}
+            for s in WorkerState:
+                g_state.set(
+                    1.0 if st.state == s else 0.0, state=s.value, **lbl)
+            g_iter.set(float(st.iterations), **lbl)
+            g_err.set(float(st.errors), **lbl)
+            g_cerr.set(float(st.consecutive_errors), **lbl)
+            if st.queue_length is not None:
+                g_queue.set(float(st.queue_length), **lbl)
+            if st.persistent_errors is not None:
+                g_perr.set(float(st.persistent_errors), **lbl)
 
     async def shutdown(self, timeout: float = 8.0) -> None:
         """Signal stop; hard-cancel after deadline (ref worker.rs:100-113

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import asyncio
+
 
 class GarageError(Exception):
     """Base error (ref util/error.rs Error enum)."""
@@ -48,3 +50,60 @@ class DbError(GarageError):
 
 class LayoutError(GarageError):
     """Invalid cluster layout operation (ref util/error.rs Message variants)."""
+
+
+# --- wire error codes ------------------------------------------------------
+#
+# RPC error frames carry a structured code next to the message so (a) the
+# client-side error counter can label failures by TYPE, not by unbounded
+# message text, and (b) remote domain errors round-trip their class — a
+# handler raising NoSuchBlock surfaces as NoSuchBlock at the caller, not
+# as an anonymous RpcError string.
+#
+# Only classes constructible from a single message string participate;
+# QuorumError (3-arg) is deliberately absent and falls back to RpcError.
+
+_WIRE_CLASSES = {
+    cls.__name__: cls
+    for cls in (
+        GarageError, RpcError, TimeoutError_, CorruptData, NoSuchBlock,
+        DbError, LayoutError,
+    )
+}
+# every timeout flavor emits ONE code, so it must also reconstruct
+_WIRE_CLASSES["Timeout"] = TimeoutError_
+
+
+def error_code(e: BaseException) -> str:
+    """Stable wire/metric label for an exception: the class name for
+    domain errors, 'Timeout' for EVERY timeout flavor (builtin,
+    asyncio — a distinct class until py3.11 — and TimeoutError_), and
+    'Internal' for everything else (unbounded foreign types must not
+    explode label cardinality).  An error reconstructed from the wire
+    keeps its ORIGINAL code, so a remote 'Internal' forwarded across
+    hops stays 'Internal' instead of relabeling as the carrier class."""
+    rc = getattr(e, "remote_code", None)
+    if rc:
+        return str(rc)
+    if isinstance(e, (TimeoutError, asyncio.TimeoutError, TimeoutError_)):
+        return "Timeout"
+    if isinstance(e, GarageError):
+        return type(e).__name__
+    return "Internal"
+
+
+def remote_error(code, msg) -> GarageError:
+    """Reconstruct a remote failure from its wire (code, message) pair.
+    Unknown or absent codes degrade to RpcError with the code kept in
+    the message."""
+    msg = str(msg if msg is not None else "remote error")
+    cls = _WIRE_CLASSES.get(code or "")
+    if cls is not None:
+        err = cls(msg)
+    elif code in (None, "", "Internal"):
+        err = RpcError(msg)
+    else:
+        err = RpcError(f"[{code}] {msg}")
+    if code:
+        err.remote_code = str(code)
+    return err

@@ -73,6 +73,13 @@ class Gauge:
     def set(self, v: float, **labels) -> None:
         self._vals[tuple(sorted(labels.items()))] = v
 
+    def clear(self) -> None:
+        """Drop all labelled samples.  Scrape-time observers that mirror a
+        MUTABLE population (per-worker, per-peer) clear + repopulate so a
+        reaped worker's series disappears instead of freezing at its last
+        value."""
+        self._vals.clear()
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         if self.fn is not None:
@@ -200,8 +207,21 @@ class MetricsRegistry:
 
     def gauge(self, name: str, help: str = "",
               fn: Optional[Callable[[], float]] = None) -> Gauge:
-        """Note: on dedup the FIRST registration's observer callback wins;
-        per-instance values should use labelled `set()` instead of `fn`."""
+        """A second registration of an existing gauge may not pass a
+        DIFFERENT observer callback: the first registration's `fn` used to
+        win silently, which turned a double-construction bug (two
+        components observing through dead instances) into wrong metrics
+        instead of a crash.  Per-instance values must use labelled
+        `set()`; re-requesting an existing gauge without an observer
+        stays valid (that is the sharing path)."""
+        m = self._by_name.get(name)
+        if m is not None and fn is not None and getattr(m, "fn", None) is not fn:
+            raise ValueError(
+                f"gauge {name!r} already registered"
+                + (" with a different observer callback"
+                   if getattr(m, "fn", None) is not None else "")
+                + "; a second fn= observer would be silently ignored"
+            )
         return self._get_or_create(Gauge, name, help, fn)
 
     def histogram(self, name: str, help: str = "",

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import dataclasses
 import heapq
 import logging
 import os
@@ -94,6 +95,79 @@ class SlowOpLog:
 _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "garage_tpu_current_span", default=None
 )
+
+# Trace context extracted from an INCOMING RPC frame: set by the netapp
+# handler task so server-side spans parent on the caller's span even when
+# this node's tracer is export-disabled (the context is still forwarded
+# to further hops).  Task-local like _current_span.
+_remote_ctx: contextvars.ContextVar[Optional["TraceContext"]] = (
+    contextvars.ContextVar("garage_tpu_remote_trace_ctx", default=None)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Wire-portable span identity: what one node needs to parent its
+    spans on a caller running on another node.  Carried in the msgpack
+    request header of K_REQ frames (net/netapp.py) — the equivalent of
+    W3C traceparent for the cluster-internal RPC fabric.
+
+    `priority` is the caller's frame priority; the receiving node's
+    further hops never run MORE urgent than it
+    (netapp.call_streaming demotes via inherited_priority), so work
+    spawned by a background request stays background."""
+
+    trace_id: str
+    span_id: str
+    priority: int = 1  # PRIO_NORMAL; plain int to avoid a net/ import
+
+    def pack(self) -> Dict[str, Any]:
+        """Header-embeddable dict (short keys: rides every K_REQ)."""
+        return {"t": self.trace_id, "s": self.span_id, "p": self.priority}
+
+    @classmethod
+    def unpack(cls, d: Any) -> Optional["TraceContext"]:
+        """Parse a header dict; None on anything malformed — a bad peer
+        must never be able to break request dispatch via the trace
+        header."""
+        try:
+            t, s = str(d["t"]), str(d["s"])
+            if not t or not s or len(t) > 64 or len(s) > 32:
+                return None
+            int(t, 16), int(s, 16)  # hex ids only
+            p = min(max(int(d.get("p", 1)), 0), 3)  # clamp to PRIO range
+            return cls(t, s, p)
+        except (TypeError, KeyError, ValueError):
+            return None
+
+
+def current_trace_context() -> Optional[TraceContext]:
+    """The context to INJECT into an outgoing RPC: the current local
+    span's identity, or (when this node created no span of its own, e.g.
+    tracer export-disabled mid-chain) the remote context it received."""
+    span = _current_span.get()
+    if span is not None:
+        return TraceContext(span.trace_id, span.span_id)
+    return _remote_ctx.get()
+
+
+def inherited_priority() -> Optional[int]:
+    """The incoming request's frame priority, when this task is serving
+    one — the floor (in urgency terms: the CEILING) for further hops.
+    Deliberately reads only the REMOTE context: local spans default to
+    PRIO_NORMAL and must not demote explicit high-priority calls."""
+    ctx = _remote_ctx.get()
+    return ctx.priority if ctx is not None else None
+
+
+def set_remote_context(ctx: Optional[TraceContext]):
+    """Install an extracted incoming context for the current task; returns
+    the reset token."""
+    return _remote_ctx.set(ctx)
+
+
+def reset_remote_context(token) -> None:
+    _remote_ctx.reset(token)
 
 
 class Span:
@@ -176,22 +250,41 @@ class Tracer:
     # --- span creation ---
 
     def span(self, name: str, /, **attrs):
-        """Child span of the context's current span (or a new trace root
-        if none).  Without an exporter, a timing-only lite span still
-        feeds the slow-op log."""
+        """Child span of the context's current span; when there is none,
+        of the REMOTE context extracted from an incoming RPC frame; a new
+        trace root otherwise.  Without an exporter, a timing-only lite
+        span still feeds the slow-op log."""
         if not self.enabled:
             return _LiteSpan(self.slow, name, attrs)
         parent = _current_span.get()
         if parent is not None:
             return Span(self, name, parent.trace_id, parent.span_id, attrs)
+        rctx = _remote_ctx.get()
+        if rctx is not None:
+            return Span(self, name, rctx.trace_id, rctx.span_id, attrs)
         return Span(self, name, os.urandom(16).hex(), None, attrs)
 
-    def new_trace(self, name: str, /, **attrs):
-        """Root span with a FRESH trace id — one per API request (ref
-        generic_server.rs:187-200 gen_trace_id)."""
+    def span_from_context(self, name: str, ctx: Optional[TraceContext],
+                          /, **attrs):
+        """Span parented on an EXPLICIT cross-node context (the netapp
+        server side wraps request handlers in one).  Falls back to a
+        plain span when the caller sent no context."""
         if not self.enabled:
             return _LiteSpan(self.slow, name, attrs)
-        return Span(self, name, os.urandom(16).hex(), None, attrs)
+        if ctx is None:
+            return self.span(name, **attrs)
+        return Span(self, name, ctx.trace_id, ctx.span_id, attrs)
+
+    def new_trace(self, name: str, /, trace_id: Optional[str] = None,
+                  **attrs):
+        """Root span with a fresh trace id — one per API request (ref
+        generic_server.rs:187-200 gen_trace_id).  `trace_id` lets the
+        API layer supply the id it returns to the client
+        (x-amz-request-id == trace id, so a support ticket quoting the
+        request id IS the trace lookup key)."""
+        if not self.enabled:
+            return _LiteSpan(self.slow, name, attrs)
+        return Span(self, name, trace_id or os.urandom(16).hex(), None, attrs)
 
     def _record(self, span: Span) -> None:
         if len(self._buf) == self._buf.maxlen:

@@ -68,7 +68,7 @@ class WebServer:
             self._m_requests.inc(api="web")
         host = request.headers.get("Host", "")
         bucket_name = host_to_bucket(host, self.root_domain) or host.split(":")[0]
-        trace = request_trace(
+        trace, _rid = request_trace(
             self.garage.system.tracer, "Web", "web", request)
         with trace, maybe_time(self._m_duration, api="web"):
             try:

@@ -22,6 +22,7 @@ BASE = os.environ.get("GARAGE_TPU_DEV_DIR", "/tmp/garage_tpu_dev")
 CFG = f"{BASE}/node0/garage.toml"
 S3_PORTS = (3900, 3910, 3920)
 WEB_PORT = 3902
+ADMIN_PORTS = (3903, 3913, 3923)
 
 
 def cli(*args):
@@ -132,6 +133,24 @@ async def main() -> None:
                                     base64.b64encode(md5).decode()})
     assert st == 200, st
     print("delete + batch delete ok")
+
+    # 6. strict Prometheus exposition lint on every node's live /metrics
+    # (the registry IS the exporter — a malformed scrape body takes the
+    # whole node's telemetry dark at ingest), plus presence checks for
+    # the control-plane families this smoke run must have populated
+    from garage_tpu.utils.promlint import lint_exposition
+
+    async with aiohttp.ClientSession() as s:
+        for port in ADMIN_PORTS:
+            async with s.get(f"http://127.0.0.1:{port}/metrics") as r:
+                assert r.status == 200, (port, r.status)
+                body = await r.text()
+            problems = lint_exposition(body)
+            assert not problems, f"/metrics on :{port} fails lint: {problems}"
+            for fam in ("net_peer_tx_bytes_total", "worker_state",
+                        "peer_rtt_ewma_seconds", "rpc_request_counter"):
+                assert fam in body, f"family {fam} missing on :{port}"
+    print("metrics exposition lint ok (3 nodes)")
 
     print("SMOKE OK")
 

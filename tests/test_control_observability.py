@@ -1,0 +1,427 @@
+"""Control-plane observability: cross-node trace propagation, per-peer
+network health, the worker status registry, structured RPC error codes,
+and the strict Prometheus exposition lint.
+
+The acceptance shape: one client request → ONE trace whose spans come
+from every node it touched; /metrics exposes per-peer RTT/bytes and
+per-worker state/queue-depth families; `cluster stats` and `worker list`
+consume the same state.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from garage_tpu.net import NetApp, gen_node_key
+from garage_tpu.utils.background import BackgroundRunner, Worker, WorkerState
+from garage_tpu.utils.error import CorruptData, NoSuchBlock, RpcError
+from garage_tpu.utils.metrics import MetricsRegistry
+from garage_tpu.utils.promlint import lint_exposition
+from garage_tpu.utils.tracing import TraceContext, Tracer
+
+from test_model import make_garage_cluster, mkconfig, shutdown
+
+pytestmark = pytest.mark.asyncio
+
+
+class _Sink:
+    """In-process exporter: keeps Span objects for direct inspection."""
+
+    def __init__(self):
+        self.spans = []
+
+    async def export(self, spans, service_instance):
+        self.spans.extend(spans)
+        return True
+
+    async def close(self):
+        pass
+
+
+def attach_tracer(g):
+    """Swap an export-enabled tracer into every layer that holds a
+    reference (System owns it; RpcHelper and NetApp cache it)."""
+    sink = _Sink()
+    tr = Tracer(bytes(g.system.id)[:4].hex(), exporter=sink)
+    g.system.tracer = tr
+    g.system.rpc.tracer = tr
+    g.system.netapp.tracer = tr
+    return sink
+
+
+# --- cross-node trace propagation ------------------------------------------
+
+
+async def test_one_put_produces_one_trace_across_nodes(tmp_path):
+    """One S3 PUT against node 0 of a 3-node cluster: the response's
+    x-amz-request-id IS the trace id, and the replica nodes' handler
+    spans carry the same trace id (no orphan per-node traces)."""
+    import aiohttp
+    import yarl
+
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.api.signature import sign_request
+
+    garages = await make_garage_cluster(tmp_path)
+    sinks = [attach_tracer(g) for g in garages]
+    g = garages[0]
+    helper = g.helper()
+    key = await helper.create_key("trace")
+    key.params().allow_create_bucket.update(True)
+    await g.key_table.insert(key)
+    server = S3ApiServer(g)
+    await server.start("127.0.0.1:0")
+    sport = server.port
+    kid, secret = key.key_id, key.params().secret_key
+
+    async def req(method, path, body=b""):
+        headers = {"host": f"127.0.0.1:{sport}"}
+        headers.update(sign_request(kid, secret, "garage", method, path, [],
+                                    headers, body, path_is_raw=True))
+        async with aiohttp.ClientSession() as s:
+            async with s.request(
+                method, yarl.URL(f"http://127.0.0.1:{sport}{path}",
+                                 encoded=True),
+                data=body, headers=headers,
+            ) as r:
+                return r.status, r.headers.copy()
+
+    st, _ = await req("PUT", "/tbkt")
+    assert st == 200
+    st, hdrs = await req("PUT", "/tbkt/obj", b"x" * 4096)
+    assert st == 200
+    rid = hdrs["x-amz-request-id"]
+    assert len(rid) == 32 and int(rid, 16) >= 0
+
+    # spans buffer at span END; replica-side handler spans can finish in
+    # tasks scheduled after the response — flush until they arrive
+    deadline = time.monotonic() + 5.0
+    remote_hits = []
+    while time.monotonic() < deadline:
+        for garage in garages:
+            await garage.system.tracer.flush()
+        roots = [s for s in sinks[0].spans
+                 if s.name == "S3 PUT"
+                 and s.attrs.get("path") == "/tbkt/obj"]
+        assert all(r.trace_id == rid for r in roots)
+        remote_hits = [
+            i for i in (1, 2)
+            if any(s.trace_id == rid and s.name.startswith("RPC handler")
+                   for s in sinks[i].spans)
+        ]
+        if roots and remote_hits:
+            break
+        await asyncio.sleep(0.05)
+    assert remote_hits, "no replica node contributed spans to the trace"
+    # node 0's own spans parent under the request root, same trace
+    local_children = [s for s in sinks[0].spans
+                      if s.trace_id == rid and s.name != "S3 PUT"]
+    assert local_children and all(s.parent_id for s in local_children)
+
+    await server.stop()
+    await shutdown(garages)
+
+
+async def test_trace_context_pack_unpack_and_malformed():
+    ctx = TraceContext("ab" * 16, "cd" * 8, 3)
+    assert TraceContext.unpack(ctx.pack()) == ctx
+    for bad in (None, {}, {"t": "xyz", "s": "12"}, {"t": "", "s": "12ab"},
+                {"t": "12ab", "s": "zz"}, {"t": "a" * 100, "s": "12ab"},
+                "garbage", 7):
+        assert TraceContext.unpack(bad) is None
+
+
+# --- structured RPC error codes --------------------------------------------
+
+
+async def _make_pair():
+    a = NetApp(gen_node_key(), "obs-secret")
+    b = NetApp(gen_node_key(), "obs-secret")
+    await b.listen("127.0.0.1:0")
+    port = b._server.sockets[0].getsockname()[1]
+    await a.connect(f"127.0.0.1:{port}", expected_id=b.id)
+    return a, b
+
+
+async def test_remote_error_roundtrips_type_and_labels_metrics():
+    from garage_tpu.net.peering import FullMeshPeering
+    from garage_tpu.rpc.rpc_helper import RpcHelper
+
+    a, b = await _make_pair()
+
+    async def handler(remote, msg, body):
+        raise NoSuchBlock("block 1234 is nowhere")
+
+    b.endpoint("t/err").set_handler(handler)
+    with pytest.raises(NoSuchBlock, match="nowhere"):
+        await a.endpoint("t/err").call(b.id, {})
+
+    # the per-endpoint error counter carries the structured code
+    reg = MetricsRegistry()
+    helper = RpcHelper(a, FullMeshPeering(a), metrics=reg)
+    with pytest.raises(NoSuchBlock):
+        await helper.call(a.endpoint("t/err"), b.id, {})
+    assert reg.counter("rpc_error_counter").get(
+        endpoint="t/err", error="NoSuchBlock") == 1
+
+    # foreign exception types collapse into one label bucket
+    async def boom(remote, msg, body):
+        raise ValueError("intentional")
+
+    b.endpoint("t/boom").set_handler(boom)
+    with pytest.raises(RpcError, match="intentional"):
+        await helper.call(a.endpoint("t/boom"), b.id, {})
+    assert reg.counter("rpc_error_counter").get(
+        endpoint="t/boom", error="Internal") == 1
+    await a.shutdown()
+    await b.shutdown()
+
+
+async def test_stream_abort_carries_error_code():
+    a, b = await _make_pair()
+
+    async def handler(remote, msg, body):
+        async def resp_body():
+            yield b"first chunk"
+            raise CorruptData(b"\x12" * 32)
+
+        return {"ok": True}, resp_body()
+
+    b.endpoint("t/stream").set_handler(handler)
+    _resp, stream = await a.endpoint("t/stream").call_streaming(b.id, {})
+    with pytest.raises(CorruptData):
+        await stream.read_all()
+    await a.shutdown()
+    await b.shutdown()
+
+
+async def test_timeout_code_unified_and_reconstructible():
+    from garage_tpu.utils.error import (
+        TimeoutError_, error_code, remote_error,
+    )
+
+    assert error_code(asyncio.TimeoutError()) == "Timeout"  # py3.10: distinct class
+    assert error_code(TimeoutError("t")) == "Timeout"
+    assert error_code(TimeoutError_("t")) == "Timeout"
+    err = remote_error("Timeout", "rpc timeout after 30s")
+    assert isinstance(err, TimeoutError_)
+    assert error_code(err) == "Timeout"  # forwarding keeps the code
+
+
+async def test_priority_inheritance_demotes_nested_calls():
+    """A nested call made while serving a background-priority request is
+    demoted to background even when its call site asks for normal."""
+    from garage_tpu.net.frame import PRIO_BACKGROUND, PRIO_NORMAL
+
+    a, b = await _make_pair()
+
+    async def ping_back(remote, msg, body):
+        return "ok", None
+
+    a.endpoint("t/nested").set_handler(ping_back)
+
+    async def outer(remote, msg, body):
+        await b.endpoint("t/nested").call(a.id, {}, prio=PRIO_NORMAL)
+        return "done", None
+
+    b.endpoint("t/outer").set_handler(outer)
+    tr = Tracer("aa", exporter=_Sink())
+    with tr.new_trace("root"):  # a current span makes the context ride the wire
+        out = await a.endpoint("t/outer").call(
+            b.id, {}, prio=PRIO_BACKGROUND)
+    assert out == "done"
+    conn_ba = b.conns[a.id]
+    # everything B sent (outer's response AND the nested request) stayed
+    # at background; nothing jumped to normal
+    assert conn_ba.tx_frames[PRIO_BACKGROUND] >= 2
+    assert conn_ba.tx_frames[PRIO_NORMAL] == 0
+    await a.shutdown()
+    await b.shutdown()
+
+
+# --- per-peer network health -----------------------------------------------
+
+
+async def test_peer_health_metrics_and_cluster_stats(tmp_path):
+    from garage_tpu.admin.handler import AdminRpcHandler
+
+    garages = await make_garage_cluster(tmp_path)
+    g = garages[0]
+    # one ping round populates RTT EWMAs
+    await g.system.peering._tick()
+    # some cross-node traffic
+    key = await g.helper().create_key("peer-test")
+    await g.key_table.insert(key)
+
+    admin = AdminRpcHandler(g, register_endpoint=False)
+    st = await admin._cmd_cluster_stats({})
+    assert st["node_id"] == bytes(g.system.id).hex()
+    assert len(st["peers"]) == 2
+    for p in st["peers"]:
+        assert p["connected"] and p["up"]
+        assert p["rtt_ewma_ms"] is not None and p["rtt_ewma_ms"] >= 0
+        assert p["traffic"] is not None
+        total_tx = sum(v["tx_bytes"] for v in p["traffic"].values())
+        assert total_tx > 0  # pings + table inserts crossed the wire
+
+    # the same state is scrapeable: refresh observers, render, lint
+    g.system.peering.observe_gauges()
+    g.bg.observe_gauges(g.system.metrics)
+    body = g.system.metrics.render()
+    assert 'peer_rtt_ewma_seconds{peer="' in body
+    assert 'peer_up{peer="' in body
+    assert 'net_peer_tx_bytes_total{peer="' in body
+    assert "net_queue_wait_seconds_bucket" in body
+    assert lint_exposition(body) == [], lint_exposition(body)
+    await shutdown(garages)
+
+
+# --- worker status registry ------------------------------------------------
+
+
+async def test_worker_registry_gauges_and_listing(tmp_path):
+    from garage_tpu.admin.handler import AdminRpcHandler
+    from garage_tpu.model import Garage
+
+    g = Garage(mkconfig(tmp_path, 0, "none"))
+    await g.system.netapp.listen("127.0.0.1:0")
+    from garage_tpu.rpc.layout import ClusterLayout, NodeRole
+
+    lay = g.system.layout
+    lay.stage_role(bytes(g.system.id), NodeRole("dc1", 1000))
+    lay.apply_staged_changes()
+    g.system.layout = ClusterLayout.decode(lay.encode())
+    g.system._rebuild_ring()
+    g.spawn_workers()
+    await asyncio.sleep(0.3)  # let workers run at least one iteration
+
+    admin = AdminRpcHandler(g, register_endpoint=False)
+    listing = await admin._cmd_worker_list({})
+    names = {w["name"] for w in listing}
+    assert any("Merkle" in n for n in names)
+    assert any("resync" in n for n in names)
+    assert any(w["iterations"] > 0 for w in listing)
+    # queue depths are wired for the drain workers
+    assert any(w["queue_length"] is not None for w in listing
+               if "Merkle" in w["name"] or "queue" in w["name"])
+
+    g.bg.observe_gauges(g.system.metrics)
+    body = g.system.metrics.render()
+    assert 'worker_state{' in body and 'state="idle"' in body
+    assert "worker_iterations{" in body
+    assert "worker_queue_length{" in body
+    assert lint_exposition(body) == [], lint_exposition(body)
+
+    # a DONE worker that gets reaped disappears from the gauges
+    class OneShot(Worker):
+        async def work(self):
+            return WorkerState.DONE
+
+    wid = g.bg.spawn(OneShot())
+    await g.bg.tasks[wid]
+    assert g.bg.reap(wid)
+    g.bg.observe_gauges(g.system.metrics)
+    assert f'id="{wid}"' not in g.system.metrics.render()
+    await g.shutdown()
+
+
+async def test_background_runner_spawn_reap_shutdown_timeout():
+    runner = BackgroundRunner()
+
+    class Counting(Worker):
+        def __init__(self):
+            self.count = 0
+
+        async def work(self):
+            self.count += 1
+            return WorkerState.DONE if self.count >= 3 else WorkerState.BUSY
+
+    class Hanging(Worker):
+        async def work(self):
+            await asyncio.sleep(3600)
+            return WorkerState.IDLE
+
+    cw = Counting()
+    wid = runner.spawn(cw)
+    hid = runner.spawn(Hanging())
+    assert runner.reap(hid) is False  # refuses while running
+    await runner.tasks[wid]
+    assert cw.count == 3
+    assert runner.workers[wid].status().iterations == 3
+    assert runner.reap(wid) is True
+    assert wid not in runner.workers and wid not in runner.tasks
+
+    t0 = time.monotonic()
+    await runner.shutdown(timeout=0.2)  # hanging worker forces the deadline
+    assert time.monotonic() - t0 < 5.0
+    assert runner.tasks[hid].cancelled() or runner.tasks[hid].done()
+
+
+# --- metrics registry + exposition lint ------------------------------------
+
+
+def test_gauge_observer_redeclaration_raises():
+    reg = MetricsRegistry()
+    reg.gauge("g_plain", "no observer")
+    reg.gauge("g_plain", "shared again")  # sharing without fn stays legal
+    reg.gauge("g_obs", "observed", fn=lambda: 1.0)
+    reg.gauge("g_obs", "observed")  # re-request without fn: legal
+    with pytest.raises(ValueError):
+        reg.gauge("g_obs", "observed", fn=lambda: 2.0)
+    with pytest.raises(ValueError):
+        reg.gauge("g_plain", "late observer", fn=lambda: 3.0)
+
+
+def test_promlint_accepts_populated_registry():
+    reg = MetricsRegistry()
+    c = reg.counter("lint_requests_total", "with nasty label values")
+    c.inc(path='quo"te', peer="back\\slash")
+    c.inc(5, path="new\nline", peer="plain")
+    g = reg.gauge("lint_gauge", "a gauge")
+    g.set(1.5, zone="dc1")
+    h = reg.histogram("lint_latency_seconds", "a histogram")
+    for v in (0.002, 0.03, 0.4, 9.0, 100.0):
+        h.observe(v, endpoint="a/b", prio="high")
+    assert lint_exposition(reg.render()) == lint_exposition(reg.render()) == []
+
+
+def test_promlint_catches_violations():
+    dup = ("# TYPE x counter\nx 1\n# TYPE x counter\nx 2\n")
+    assert any("duplicate # TYPE" in e for e in lint_exposition(dup))
+    assert any("duplicate sample" not in e for e in lint_exposition(dup))
+
+    orphan = "no_type_metric 1\n"
+    assert any("no preceding # TYPE" in e for e in lint_exposition(orphan))
+
+    unsorted = ('# TYPE u counter\nu{b="1",a="2"} 1\n')
+    assert any("not sorted" in e for e in lint_exposition(unsorted))
+
+    bad_escape = ('# TYPE e counter\ne{a="bad\\q"} 1\n')
+    assert any("ill-escaped" in e for e in lint_exposition(bad_escape))
+
+    bad_hist = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 5\n'
+        'h_bucket{le="0.05"} 2\n'
+        'h_bucket{le="+Inf"} 6\n'
+        "h_sum 1\nh_count 6\n"
+    )
+    assert any("not strictly increasing" in e
+               for e in lint_exposition(bad_hist))
+
+    shrink = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 5\n'
+        'h_bucket{le="1"} 3\n'
+        'h_bucket{le="+Inf"} 6\n'
+        "h_sum 1\nh_count 6\n"
+    )
+    assert any("decrease" in e for e in lint_exposition(shrink))
+
+    no_inf = ("# TYPE h histogram\n" 'h_bucket{le="0.1"} 5\n'
+              "h_sum 1\nh_count 5\n")
+    assert any("+Inf" in e for e in lint_exposition(no_inf))
+
+    dup_sample = "# TYPE d gauge\nd 1\nd 2\n"
+    assert any("duplicate sample" in e for e in lint_exposition(dup_sample))

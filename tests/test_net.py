@@ -126,9 +126,9 @@ async def test_outmux_strict_priority():
     for i in range(5):
         await mux.put(Frame(K_DATA, PRIO_BACKGROUND, 1, bytes([i])))
     await mux.put(Frame(K_DATA, PRIO_HIGH, 2, b"hi"))
-    first = await mux.pop()
+    first, _t = await mux.pop()
     assert first.prio == PRIO_HIGH and first.payload == b"hi"
-    rest = [await mux.pop() for _ in range(5)]
+    rest = [(await mux.pop())[0] for _ in range(5)]
     assert [f.payload for f in rest] == [bytes([i]) for i in range(5)]  # FIFO
 
 
