@@ -655,7 +655,7 @@ MP_TIME_CAP = 300.0
 
 async def _mk_cluster(tmp, n=1, repl="none", codec_cfg=None, quotas=None,
                       data_repl=None, db="native", wan_delay=None,
-                      proxies_out=None):
+                      proxies_out=None, rpc_cfg=None):
     """n in-process Garage daemons with an applied layout + one S3 server
     on node 0; returns (garages, server, port, key_id, secret)."""
     from garage_tpu.api.s3.api_server import S3ApiServer
@@ -678,6 +678,8 @@ async def _mk_cluster(tmp, n=1, repl="none", codec_cfg=None, quotas=None,
             cfg["data_replication_mode"] = data_repl
         if codec_cfg:
             cfg["codec"] = dict(codec_cfg)
+        if rpc_cfg:
+            cfg["rpc"] = dict(rpc_cfg)
         garages.append(Garage(config_from_dict(cfg)))
     for g in garages:
         await g.system.netapp.listen("127.0.0.1:0")

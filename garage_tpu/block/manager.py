@@ -71,6 +71,14 @@ class BlockManager:
         self.hash_algo = config.codec.hash_algo
         self.compression_level = config.compression_level
         self.data_fsync = config.data_fsync
+        # static block-transfer timeout ([rpc].block_rpc_timeout): the
+        # ceiling/fallback the adaptive per-peer layer clamps against
+        # (used to be the hardcoded BLOCK_RW_TIMEOUT literal everywhere)
+        rpc_cfg = getattr(config, "rpc", None)
+        self.block_rpc_timeout = (
+            rpc_cfg.block_rpc_timeout if rpc_cfg is not None
+            else BLOCK_RW_TIMEOUT
+        )
 
         # multi-drive layout, persisted (ref manager.rs:122-160)
         self._layout_persister = Persister(
@@ -415,7 +423,7 @@ class BlockManager:
         )
         from ..rpc.rpc_helper import RequestStrategy
 
-        async def send(node):
+        async def send(node, timeout):
             msg = {"t": "put_block", "h": bytes(h),
                    "hdr": block.header().pack()}
             if is_parity:
@@ -424,7 +432,7 @@ class BlockManager:
                 node,
                 msg,
                 prio=PRIO_NORMAL,
-                timeout=BLOCK_RW_TIMEOUT,
+                timeout=timeout,
                 body=_chunks(block.inner),
             )
             return node
@@ -435,7 +443,13 @@ class BlockManager:
             None,
             RequestStrategy(
                 rs_quorum=self.replication.write_quorum(),
-                rs_timeout=BLOCK_RW_TIMEOUT,
+                rs_timeout=self.block_rpc_timeout,
+                # the timeout covers the whole (bandwidth-bound) body
+                # transfer — an RTT-derived clamp would false-fail large
+                # blocks on slow links and feed the breaker; blackhole
+                # detection on this path comes from the breaker's other
+                # feeders (pings, probe-shaped calls)
+                rs_adaptive_timeout=False,
             ),
             make_call=send,
         )
@@ -503,16 +517,31 @@ class BlockManager:
         delivered (ref manager.rs:231-345 + the get-path streaming of
         get.rs:432-512).  Memory stays bounded by the transport chunk
         size — the block is never buffered whole."""
-        who = self.system.rpc.request_order(self.replication.read_nodes(h))
+        rpc = self.system.rpc
+        who = rpc.request_order(self.replication.read_nodes(h))
         delivered = 0
         errors = []
         for node in who:
+            # the streaming failover loop IS this path's retry/hedge
+            # mechanism; it still consults the resilience layer so an
+            # open-breaker replica fast-fails to the next copy and a
+            # known-RTT replica gets the clamped adaptive timeout
+            if not rpc.peer_allows(node):
+                errors.append(f"{bytes(node).hex()[:8]}: breaker open")
+                continue
             try:
+                # the transport timeout covers only time-to-response-
+                # header; the same (adaptive) budget is reused below as a
+                # PER-CHUNK inactivity deadline, because a peer that
+                # blackholes mid-stream keeps the connection "up" while
+                # bytes stop — without a chunk deadline the read hangs
+                # forever and the per-replica failover never runs
+                node_timeout = rpc.timeout_for(node, self.block_rpc_timeout)
                 resp, stream = await self.endpoint.call_streaming(
                     node,
                     {"t": "get_block", "h": bytes(h), "order": order_tag},
                     prio=PRIO_NORMAL,
-                    timeout=BLOCK_RW_TIMEOUT,
+                    timeout=node_timeout,
                 )
                 if resp.get("err"):
                     raise NoSuchBlock(resp["err"])
@@ -532,7 +561,13 @@ class BlockManager:
                 skip = delivered
                 try:
                     if stream is not None:
-                        async for chunk in stream:
+                        it = stream.__aiter__()
+                        while True:
+                            try:
+                                chunk = await asyncio.wait_for(
+                                    it.__anext__(), node_timeout)
+                            except StopAsyncIteration:
+                                break
                             if (meta_out is not None
                                     and meta_out.get("raw_chunks") is not None):
                                 meta_out["raw_chunks"].append(bytes(chunk))
@@ -555,14 +590,21 @@ class BlockManager:
                     # the connection dies; no-op after full consumption
                     if stream is not None:
                         await stream.aclose()
+                rpc.note_result(node, None)
                 return
-            except asyncio.CancelledError:
+            except (asyncio.CancelledError, GeneratorExit):
+                # consumer went away mid-fetch (client disconnect, task
+                # cancel): release the breaker's half-open probe slot if
+                # peer_allows granted it — no verdict on the peer, and a
+                # leaked slot would fast-fail the peer for a full cooldown
+                rpc.note_result(node, asyncio.CancelledError())
                 raise
             except Exception as e:
                 # ANY per-replica failure fails over to the next replica —
                 # a malformed header (version skew) or a corrupt zstd
                 # frame from one node must not mask a healthy copy one
                 # hop away (ref manager.rs:231-317 tries each in turn)
+                rpc.note_result(node, e)
                 errors.append(f"{bytes(node).hex()[:8]}: {e}")
                 if meta_out is not None and delivered > 0:
                     meta_out["raw_chunks"] = None  # stitched: frames mixed
@@ -676,9 +718,13 @@ class BlockManager:
                 if bytes(nid) in ring_nodes:
                     continue
                 try:
+                    # adaptive per-peer timeout keeps the O(cluster) walk
+                    # cheap past slow peers; no breaker veto (see above —
+                    # a stale "broken" verdict must not hide the only copy)
                     resp, stream = await self.endpoint.call_streaming(
                         nid, {"t": "get_block", "h": bytes(h)},
-                        timeout=30.0,
+                        timeout=self.system.rpc.timeout_for(
+                            nid, self.block_rpc_timeout),
                     )
                     if resp.get("err") or stream is None:
                         tried.append(f"{bytes(nid).hex()[:8]}:miss")
@@ -686,9 +732,15 @@ class BlockManager:
                     from .block import DataBlock, DataBlockHeader
 
                     hdr = DataBlockHeader.unpack(resp["hdr"])
-                    raw = DataBlock(
-                        await stream.read_all(),
-                        hdr.compressed).decompressed()
+                    # whole-body deadline: a peer blackholing mid-stream
+                    # must cost one timeout, not hang the sweep forever
+                    try:
+                        body = await asyncio.wait_for(
+                            stream.read_all(), self.block_rpc_timeout)
+                    except BaseException:
+                        await stream.aclose()  # stop the sender's pump
+                        raise
+                    raw = DataBlock(body, hdr.compressed).decompressed()
                     break
                 except Exception as e:
                     tried.append(f"{bytes(nid).hex()[:8]}:{type(e).__name__}")

@@ -233,11 +233,18 @@ class BlockResyncManager:
             who = [n for n in mgr.replication.write_nodes(h) if n != mgr.system.id]
             needy, remote_present = [], 0
             for node in who:
-                resp = await mgr.endpoint.call(
+                # need_block is a pure probe (idempotent): route it
+                # through the resilience gate so it retries transient
+                # resets with backoff, gets the adaptive per-peer
+                # timeout, and fast-fails open-breaker peers instead of
+                # stalling the resync worker a full static timeout
+                resp = await mgr.system.rpc.call(
+                    mgr.endpoint,
                     node,
                     {"t": "need_block", "h": bytes(h)},
                     prio=PRIO_BACKGROUND,
-                    timeout=60.0,
+                    timeout=mgr.block_rpc_timeout,
+                    idempotent=True,
                 )
                 if resp.get("needed"):
                     needy.append(node)
@@ -255,11 +262,14 @@ class BlockResyncManager:
                 if mgr.is_parity_block(h):
                     msg["parity"] = True
                 for node in needy:
-                    await mgr.endpoint.call(
+                    # push carries a streaming body → never retried; it
+                    # still gains the adaptive timeout + breaker gate
+                    await mgr.system.rpc.call(
+                        mgr.endpoint,
                         node,
                         msg,
                         prio=PRIO_BACKGROUND,
-                        timeout=60.0,
+                        timeout=mgr.block_rpc_timeout,
                         body=_chunks(block.inner),
                     )
                 logger.info(

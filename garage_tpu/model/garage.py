@@ -392,6 +392,11 @@ class Garage:
         # post-decode heals would fail noisily against the closing RPC
         # layer; their persistent resync entries finish the job later
         self.block_manager.drain_heals()
+        # quorum-write stragglers and cancelled-read losers still talk
+        # through the transport: give them a bounded drain BEFORE workers
+        # and the netapp go away (System.shutdown drains again, cheaply,
+        # for anything spawned in between)
+        await self.system.rpc.shutdown(timeout=5.0)
         await self.bg.shutdown()
         tracer = getattr(self.system, "tracer", None)
         if tracer is not None:

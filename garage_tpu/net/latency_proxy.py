@@ -12,9 +12,11 @@ bandwidth, optional jitter), so a 3-node loopback cluster behaves like
 three datacenters.
 
 Used by tests/test_wan_latency.py (1-RTT assertions + latency-ordered
-candidate selection) and bench.py's WAN phase.  Pure harness: the
-product stack (net/netapp.py, rpc/rpc_helper.py) is measured through
-it, never modified by it.
+candidate selection), bench.py's WAN phase, and — via the subclass hooks
+`_on_accept` / `_filter` — by testing/faults.py's FaultyLink, which
+composes partitions, resets and blackholes on top of the delay line.
+Pure harness: the product stack (net/netapp.py, rpc/rpc_helper.py) is
+measured through it, never modified by it.
 """
 
 from __future__ import annotations
@@ -38,10 +40,11 @@ class LatencyProxy:
     def __init__(self, target_host: str, target_port: int,
                  one_way_delay: float, jitter: float = 0.0):
         self.target = (target_host, target_port)
-        self.delay = one_way_delay
+        self.delay = one_way_delay      # mutable: read per-chunk
         self.jitter = jitter
         self._server: Optional[asyncio.AbstractServer] = None
         self._tasks: set = set()
+        self._conn_writers: set = set()  # live writers, for kill_connections
 
     async def start(self, port: int = 0) -> int:
         self._server = await asyncio.start_server(
@@ -51,6 +54,11 @@ class LatencyProxy:
     @property
     def port(self) -> int:
         return self._server.sockets[0].getsockname()[1]
+
+    def retarget(self, port: int, host: Optional[str] = None) -> None:
+        """Point the relay at a new upstream (a revived node listens on a
+        fresh port); existing connections keep their old upstream."""
+        self.target = (host or self.target[0], port)
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -68,16 +76,43 @@ class LatencyProxy:
         self._tasks.add(t)
         t.add_done_callback(self._tasks.discard)
 
+    def kill_connections(self) -> None:
+        """Abort every relayed connection (both sides see a reset-like
+        close).  The listener keeps running."""
+        for w in list(self._conn_writers):
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._conn_writers.clear()
+
+    # --- subclass hooks (fault injection) ---
+
+    def _on_accept(self, reader, writer) -> bool:
+        """Return False to refuse the connection (hard partition)."""
+        return True
+
+    def _filter(self, direction: str, data: bytes) -> Optional[bytes]:
+        """Per-chunk hook; direction is 'tx' (client→target) or 'rx'.
+        Return None to silently drop the chunk (one-way partition /
+        blackhole); EOF still propagates."""
+        return data
+
     async def _accept(self, reader, writer):
+        if not self._on_accept(reader, writer):
+            writer.close()
+            return
         try:
             up_r, up_w = await asyncio.open_connection(*self.target)
         except OSError:
             writer.close()
             return
-        self._spawn(self._pipe(reader, up_w))
-        self._spawn(self._pipe(up_r, writer))
+        self._conn_writers.add(writer)
+        self._conn_writers.add(up_w)
+        self._spawn(self._pipe(reader, up_w, "tx"))
+        self._spawn(self._pipe(up_r, writer, "rx"))
 
-    async def _pipe(self, reader, writer):
+    async def _pipe(self, reader, writer, direction: str = "tx"):
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 
@@ -95,6 +130,7 @@ class LatencyProxy:
             except (ConnectionError, asyncio.CancelledError):
                 pass
             finally:
+                self._conn_writers.discard(writer)
                 try:
                     writer.close()
                 except Exception:
@@ -104,6 +140,10 @@ class LatencyProxy:
         try:
             while True:
                 data = await reader.read(64 * 1024)
+                if data:
+                    data = self._filter(direction, data)
+                    if data is None:
+                        continue  # dropped: read on, deliver nothing
                 d = self.delay
                 if self.jitter:
                     d += random.uniform(-self.jitter, self.jitter)

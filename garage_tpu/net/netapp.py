@@ -473,7 +473,11 @@ class Connection:
                 )
             return rbody, stream
         except asyncio.TimeoutError:
-            raise RpcError(
+            # typed so the resilience layer can classify it (retryable,
+            # breaker-feeding) without string matching
+            from ..utils.error import TimeoutError_
+
+            raise TimeoutError_(
                 f"rpc timeout after {timeout}s calling {path} on "
                 f"{self.remote_id.hex_short()}"
             )

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional, Set, Tuple
 
 from .netapp import NetApp, NodeID
+from .resilience import BREAKER_STATE_VALUES, CircuitBreaker, ResilienceTunables
 
 logger = logging.getLogger("garage_tpu.net.peering")
 
@@ -50,8 +51,16 @@ class FullMeshPeering:
     list, and layout gossip (the rpc System layer feeds those in via
     `add_peer`)."""
 
-    def __init__(self, netapp: NetApp, metrics=None):
+    def __init__(self, netapp: NetApp, metrics=None,
+                 tunables: Optional[ResilienceTunables] = None):
         self.netapp = netapp
+        self.tunables = tunables or ResilienceTunables()
+        # per-peer circuit breakers (closed → open on failure streak /
+        # RTT blowup → half-open probe on timer).  Fed by the ping loop
+        # here AND by data-plane call outcomes via RpcHelper; consulted
+        # by request_order (broken peers sort last) and by every call
+        # gate (fast-fail instead of burning a timeout).
+        self.breakers: Dict[NodeID, CircuitBreaker] = {}
         self.peers: Dict[NodeID, PeerState] = {}
         self._addr_only: Set[str] = set()   # peers known only by address
         self._task: Optional[asyncio.Task] = None
@@ -77,6 +86,10 @@ class FullMeshPeering:
                 "ping_fail": metrics.counter(
                     "peer_ping_failure_total",
                     "Failed pings/dials per peer"),
+                "breaker": metrics.gauge(
+                    "peer_breaker_state",
+                    "Circuit breaker state per peer "
+                    "(0=closed, 1=half_open, 2=open)"),
             }
         else:
             self._m = None
@@ -91,7 +104,7 @@ class FullMeshPeering:
         peers drop out instead of freezing at their last value."""
         if self._m is None:
             return
-        for g in ("rtt", "up", "failures"):
+        for g in ("rtt", "up", "failures", "breaker"):
             self._m[g].clear()
         for nid, st in self.peers.items():
             lbl = self._label(nid)
@@ -99,6 +112,8 @@ class FullMeshPeering:
                 self._m["rtt"].set(st.latency, peer=lbl)
             self._m["up"].set(1.0 if st.is_up else 0.0, peer=lbl)
             self._m["failures"].set(float(st.failures), peer=lbl)
+            self._m["breaker"].set(
+                BREAKER_STATE_VALUES[self.breaker_state(nid)], peer=lbl)
 
     # --- peer book ---
 
@@ -115,6 +130,36 @@ class FullMeshPeering:
     def latency(self, node: NodeID) -> Optional[float]:
         st = self.peers.get(node)
         return st.latency if st else None
+
+    # --- circuit breaker surface (consulted by RpcHelper) ---
+
+    def breaker(self, node: NodeID) -> CircuitBreaker:
+        br = self.breakers.get(node)
+        if br is None:
+            br = self.breakers[node] = CircuitBreaker(self.tunables)
+        return br
+
+    def breaker_state(self, node: NodeID) -> str:
+        br = self.breakers.get(node)
+        return br.state_now() if br is not None else "closed"
+
+    def breaker_allows(self, node: NodeID) -> bool:
+        """Request gate: may a call be dispatched to this peer right now?
+        Consumes the half-open probe slot when it grants one — report the
+        outcome via record_rpc_success/record_rpc_failure (or
+        breaker_release if abandoned)."""
+        return self.breaker(node).allow()
+
+    def breaker_release(self, node: NodeID) -> None:
+        br = self.breakers.get(node)
+        if br is not None:
+            br.release_probe()
+
+    def record_rpc_success(self, node: NodeID) -> None:
+        self.breaker(node).on_success()
+
+    def record_rpc_failure(self, node: NodeID) -> None:
+        self.breaker(node).on_failure()
 
     def is_up(self, node: NodeID) -> bool:
         if node == self.netapp.id:
@@ -145,6 +190,10 @@ class FullMeshPeering:
                 pass
 
     def _on_connected(self, node: NodeID, is_dialer: bool):
+        # a completed handshake is bidirectional proof of life: an open
+        # breaker (peer crashed / was partitioned) closes immediately
+        # instead of waiting out its half-open probe timer
+        self.breaker(node).on_success()
         st = self.peers.setdefault(node, PeerState())
         if st.last_seen is not None:
             # not the first contact: this is a RE-connection — the churn
@@ -165,6 +214,7 @@ class FullMeshPeering:
         st = self.peers.get(node)
         if st is not None and st.addr is None:
             del self.peers[node]
+            self.breakers.pop(node, None)
 
     async def _run(self):
         """Main loop: every PING_INTERVAL, (re)dial missing peers and ping
@@ -207,6 +257,7 @@ class FullMeshPeering:
         except Exception as e:
             st.failures += 1
             st.ping_failures += 1
+            self.breaker(nid).on_failure()
             if self._m is not None:
                 self._m["ping_fail"].inc(peer=self._label(nid))
             logger.debug("dial %s (%s) failed: %s", nid.hex_short(), st.addr, e)
@@ -215,6 +266,10 @@ class FullMeshPeering:
         try:
             rtt = await conn.ping()
             st.last_seen = time.monotonic()
+            # breaker judges the fresh RTT against the PRE-ping EWMA: a
+            # 10× blowup on an established baseline counts as a failure
+            # even though the ping came back
+            self.breaker(nid).on_rtt(rtt, st.latency)
             st.latency = (
                 rtt if st.latency is None
                 else EWMA_ALPHA * rtt + (1 - EWMA_ALPHA) * st.latency
@@ -223,6 +278,7 @@ class FullMeshPeering:
         except Exception as e:
             st.failures += 1
             st.ping_failures += 1
+            self.breaker(nid).on_failure()
             if self._m is not None:
                 self._m["ping_fail"].inc(peer=self._label(nid))
             logger.debug("ping %s failed: %s", nid.hex_short(), e)

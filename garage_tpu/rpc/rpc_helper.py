@@ -11,21 +11,46 @@ two modes (rpc_helper.rs:263-390):
   - **writes** (all-sent): requests go to every replica at once; the call
     returns at quorum; stragglers keep running in a background drain task
     so all replicas converge without delaying the caller.
+
+Degraded-mode resilience (this repo's addition; docs/ROBUSTNESS.md): every
+per-node dispatch runs through one policy gate that layers
+
+  1. **adaptive per-peer timeouts** — clamped ``base + k·rtt`` from the
+     peering RTT EWMA, static strategy timeout as fallback and ceiling, so
+     a blackholed peer (accepts, never responds) costs ~2 s, not 30;
+  2. **bounded retries with full-jitter backoff** for idempotent calls
+     (``rs_idempotent``), transport errors only, under a per-fan-out
+     budget — a node yields exactly ONE outcome no matter how many
+     attempts, so quorum math never double-counts;
+  3. **read hedging** — when a quorum-read wave is slower than the
+     endpoint's observed latency quantile (reuse rpc_duration_seconds),
+     the next latency-ordered candidate launches speculatively and the
+     loser is cancelled at quorum;
+  4. the **per-peer circuit breaker** (net/peering.py) — open peers sort
+     last in request_order and fast-fail (PeerUnavailable) instead of
+     burning a timeout; call outcomes feed the breaker back.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from ..utils.data import FixedBytes32
-from ..utils.error import QuorumError, RpcError
+from ..utils.error import PeerUnavailable, QuorumError, RpcError, error_code
 from ..net.frame import PRIO_NORMAL
 from ..net.netapp import Endpoint, NetApp
 from ..net.peering import FullMeshPeering
+from ..net.resilience import (
+    ResilienceTunables,
+    adaptive_timeout,
+    full_jitter_backoff,
+    is_transport_error,
+)
 
 logger = logging.getLogger("garage_tpu.rpc.helper")
 
@@ -34,21 +59,47 @@ NodeID = FixedBytes32
 
 @dataclass
 class RequestStrategy:
-    """(ref rpc_helper.rs:37-53)"""
+    """(ref rpc_helper.rs:37-53, extended with the resilience knobs)"""
 
     rs_quorum: int = 1
     rs_interrupt_after_quorum: bool = False  # reads: stop once quorum is in
     rs_priority: int = PRIO_NORMAL
-    rs_timeout: Optional[float] = 30.0
+    rs_timeout: Optional[float] = 30.0       # static fallback + ceiling
+    # resilience (defaults resolve against the helper's tunables):
+    rs_idempotent: bool = False         # safe to retry (reads/probes only)
+    rs_retries: Optional[int] = None    # None → tunables.retry_max if idempotent
+    rs_adaptive_timeout: bool = True    # per-peer base + k·rtt clamp
+    rs_hedge: bool = True               # speculative next-candidate on slow wave
+    rs_hedge_delay: Optional[float] = None  # None → latency-quantile derived
+
+
+class _RetryBudget:
+    """Caps TOTAL retries across one logical fan-out: per-node retry
+    loops stay bounded even when every replica flaps at once (N nodes ×
+    retry_max would otherwise multiply tail latency under correlated
+    failure — the regime retries exist to escape, not amplify)."""
+
+    __slots__ = ("left",)
+
+    def __init__(self, total: int):
+        self.left = total
+
+    def take(self) -> bool:
+        if self.left <= 0:
+            return False
+        self.left -= 1
+        return True
 
 
 class RpcHelper:
     def __init__(self, netapp: NetApp, peering: FullMeshPeering, metrics=None,
-                 tracer=None):
+                 tracer=None, tunables: Optional[ResilienceTunables] = None):
         self.netapp = netapp
         self.peering = peering
         self.our_id = netapp.id
+        self.tunables = tunables or peering.tunables
         self._drain_tasks: set = set()
+        self._rng = random.Random()
         self.tracer = tracer
         # per-RPC counters + latency histogram (ref rpc/metrics.rs:38)
         if metrics is not None:
@@ -60,12 +111,22 @@ class RpcHelper:
                 "rpc_timeout_counter", "Number of RPC timeouts")
             self.m_duration = metrics.histogram(
                 "rpc_duration_seconds", "Duration of RPCs")
+            self.m_retries = metrics.counter(
+                "rpc_retry_total",
+                "RPC attempts retried after a retryable failure")
+            self.m_hedges = metrics.counter(
+                "rpc_hedge_total",
+                "Speculative (hedged) quorum-read requests launched")
+            self.m_adaptive = metrics.histogram(
+                "rpc_adaptive_timeout_seconds",
+                "Adaptive per-peer timeout chosen for outgoing RPCs")
         else:
             self.m_requests = self.m_errors = None
             self.m_timeouts = self.m_duration = None
+            self.m_retries = self.m_hedges = self.m_adaptive = None
 
     def _instrument(self, endpoint_path: str, coro_fn):
-        """Wrap one RPC call with counters + duration (the reference's
+        """Wrap one RPC attempt with counters + duration (the reference's
         RecordDuration + per-call metrics, rpc_helper.rs:238-260)."""
         if self.m_requests is None:
             return coro_fn
@@ -73,22 +134,21 @@ class RpcHelper:
         async def timed(*a, **kw):
             import time as _time
 
-            from ..utils.error import error_code
-
             self.m_requests.inc(endpoint=endpoint_path)
             t0 = _time.perf_counter()
             try:
                 return await coro_fn(*a, **kw)
-            except asyncio.TimeoutError:
-                self.m_timeouts.inc(endpoint=endpoint_path)
-                self.m_errors.inc(endpoint=endpoint_path, error="Timeout")
-                raise
             except Exception as e:
                 # the error label is the structured wire code (satellite:
                 # K_ERR/K_RESP carry a code, so remote domain errors keep
-                # their type here instead of collapsing into one bucket)
-                self.m_errors.inc(
-                    endpoint=endpoint_path, error=error_code(e))
+                # their type here instead of collapsing into one bucket).
+                # Timeout flavor matched by CODE, not class: the netapp
+                # layer raises the typed TimeoutError_ (an RpcError), which
+                # a bare `except asyncio.TimeoutError` would never see
+                code = error_code(e)
+                if code == "Timeout":
+                    self.m_timeouts.inc(endpoint=endpoint_path)
+                self.m_errors.inc(endpoint=endpoint_path, error=code)
                 raise
             finally:
                 self.m_duration.observe(
@@ -97,14 +157,124 @@ class RpcHelper:
 
         return timed
 
+    # --- resilience primitives (shared with block/manager.py streaming) ---
+
+    def timeout_for(self, node: NodeID, static: Optional[float],
+                    adaptive: bool = True) -> Optional[float]:
+        """Per-peer timeout: clamped base + k·rtt from the ping EWMA,
+        static fallback for self/unknown peers, static as the ceiling."""
+        if not adaptive or node == self.our_id:
+            return static
+        t = adaptive_timeout(self.peering.latency(node), static, self.tunables)
+        if t is not None and t != static and self.m_adaptive is not None:
+            self.m_adaptive.observe(t)
+        return t
+
+    def peer_allows(self, node: NodeID) -> bool:
+        """Circuit-breaker gate (self-calls always pass).  A True answer
+        may consume the half-open probe slot — report the call's outcome
+        via note_result."""
+        if node == self.our_id:
+            return True
+        return self.peering.breaker_allows(node)
+
+    def note_result(self, node: NodeID, err: Optional[BaseException]) -> None:
+        """Feed a call outcome back to the peer's breaker.  Only transport
+        errors count against the peer; a domain error (NoSuchBlock from a
+        live handler) proves the path works."""
+        if node == self.our_id:
+            return
+        if err is None:
+            self.peering.record_rpc_success(node)
+        elif isinstance(err, asyncio.CancelledError):
+            self.peering.breaker_release(node)
+        elif is_transport_error(err):
+            self.peering.record_rpc_failure(node)
+        else:
+            self.peering.record_rpc_success(node)
+
+    def _hedge_delay(self, endpoint_path: str,
+                     strategy: RequestStrategy) -> Optional[float]:
+        """How long a quorum-read wave may run before the next candidate
+        launches speculatively: the endpoint's observed latency quantile
+        (needs hedge_min_samples history), or the caller's explicit
+        rs_hedge_delay.  None disables hedging for this call."""
+        if not strategy.rs_hedge:
+            return None
+        if strategy.rs_hedge_delay is not None:
+            return max(strategy.rs_hedge_delay, 0.001)
+        if self.m_duration is None:
+            return None
+        d = self.m_duration.quantile(
+            self.tunables.hedge_quantile,
+            min_count=self.tunables.hedge_min_samples,
+            endpoint=endpoint_path,
+        )
+        return max(d, 0.001) if d is not None else None
+
+    async def _call_policied(
+        self,
+        endpoint_path: str,
+        node: NodeID,
+        raw_call: Callable[[Optional[float]], Any],
+        strategy: RequestStrategy,
+        budget: Optional[_RetryBudget] = None,
+    ) -> Any:
+        """ONE logical call to one node: breaker gate → adaptive timeout →
+        instrumented attempt → bounded full-jitter retries (idempotent +
+        transport errors only).  Exactly one outcome per node regardless
+        of attempts, so quorum accounting upstream stays per-node."""
+        retries = strategy.rs_retries
+        if retries is None:
+            retries = self.tunables.retry_max if strategy.rs_idempotent else 0
+        attempt = 0
+        while True:
+            if not self.peer_allows(node):
+                # fast-fail: no timeout burned, next candidate launches now
+                raise PeerUnavailable(
+                    f"breaker open for {bytes(node).hex()[:8]}")
+            timeout = self.timeout_for(
+                node, strategy.rs_timeout, strategy.rs_adaptive_timeout)
+            fn = self._instrument(endpoint_path, lambda: raw_call(timeout))
+            try:
+                result = await fn()
+            except asyncio.CancelledError:
+                self.note_result(node, asyncio.CancelledError())
+                raise
+            except Exception as e:
+                self.note_result(node, e)
+                retryable = (
+                    attempt < retries
+                    and not isinstance(e, PeerUnavailable)
+                    and is_transport_error(e)
+                    and (budget is None or budget.take())
+                )
+                if not retryable:
+                    raise
+                if self.m_retries is not None:
+                    self.m_retries.inc(
+                        endpoint=endpoint_path, reason=error_code(e))
+                await asyncio.sleep(
+                    full_jitter_backoff(attempt, self.tunables, self._rng))
+                attempt += 1
+                continue
+            else:
+                self.note_result(node, None)
+                return result
+
     # --- ordering (ref rpc_helper.rs:392-435) ---
 
     def request_order(self, nodes: Sequence[NodeID]) -> List[NodeID]:
-        """Self first, then ascending ping latency, unknown-latency last."""
+        """Self first, then ascending ping latency, unknown-latency next,
+        open-breaker peers last (they fast-fail, but a candidate that
+        will not answer should never latency-order into the first quorum
+        wave)."""
 
         def key(n: NodeID):
             if n == self.our_id:
                 return (0, 0.0)
+            if self.peering.breaker_state(n) == "open":
+                return (3, 0.0)
             lat = self.peering.latency(n)
             if lat is None:
                 return (2, 0.0)
@@ -121,12 +291,29 @@ class RpcHelper:
         msg: Any,
         prio: int = PRIO_NORMAL,
         timeout: Optional[float] = 30.0,
+        body: Any = None,
+        idempotent: bool = False,
     ) -> Any:
-        fn = self._instrument(
-            endpoint.path,
-            lambda: endpoint.call(node, msg, prio=prio, timeout=timeout),
+        """One-node call through the full resilience gate (adaptive
+        timeout, breaker fast-fail, retries when idempotent).  Calls
+        carrying a streaming body never retry (the iterator is consumed
+        by the first attempt) and keep the STATIC timeout: the timeout
+        covers the whole body transfer, which is bandwidth-bound — an
+        RTT-derived clamp would false-fail big transfers on slow links
+        and feed those timeouts into the breaker."""
+        strategy = RequestStrategy(
+            rs_priority=prio,
+            rs_timeout=timeout,
+            rs_idempotent=idempotent and body is None,
+            rs_adaptive_timeout=body is None,
         )
-        return await fn()
+        return await self._call_policied(
+            endpoint.path,
+            node,
+            lambda t: endpoint.call(node, msg, prio=prio, timeout=t,
+                                    body=body),
+            strategy,
+        )
 
     async def call_many(
         self,
@@ -140,7 +327,7 @@ class RpcHelper:
 
         async def one(n):
             try:
-                return n, await endpoint.call(n, msg, prio=prio, timeout=timeout)
+                return n, await self.call(endpoint, n, msg, prio, timeout)
             except Exception as e:
                 return n, e
 
@@ -164,26 +351,29 @@ class RpcHelper:
         nodes: Sequence[NodeID],
         msg: Any,
         strategy: RequestStrategy,
-        make_call: Optional[Callable[[NodeID], Any]] = None,
+        make_call: Optional[Callable[[NodeID, Optional[float]], Any]] = None,
     ) -> List[Any]:
         """Returns the first `quorum` successful responses, or raises
-        QuorumError with the collected errors."""
+        QuorumError with the collected errors.  ``make_call(node,
+        timeout)`` overrides the default dispatch; the resolved adaptive
+        timeout is handed in so custom senders inherit it."""
         quorum = strategy.rs_quorum
         nodes = list(nodes)
         if len(nodes) < quorum:
             raise QuorumError(quorum, 0, [f"only {len(nodes)} candidate nodes"])
 
-        def _raw(n: NodeID):
-            if make_call is not None:
-                return make_call(n)
-            return endpoint.call(
-                n, msg, prio=strategy.rs_priority, timeout=strategy.rs_timeout
-            )
+        budget = _RetryBudget(self.tunables.retry_max * max(quorum, 1))
 
-        timed = self._instrument(endpoint.path, lambda n: _raw(n))
+        def _raw(n: NodeID, t: Optional[float]):
+            if make_call is not None:
+                return make_call(n, t)
+            return endpoint.call(n, msg, prio=strategy.rs_priority, timeout=t)
 
         def call_node(n: NodeID):
-            return timed(n)
+            return self._call_policied(
+                endpoint.path, n, lambda t, _n=n: _raw(_n, t), strategy,
+                budget=budget,
+            )
 
         # quorum-call span with the reference's attributes
         # (rpc/rpc_helper.rs:238-260: to, quorum, strategy); attrs are only
@@ -198,18 +388,23 @@ class RpcHelper:
         ) if tr is not None and tr.enabled else nullcontext()
         with span:
             if strategy.rs_interrupt_after_quorum:
-                return await self._quorum_read(nodes, call_node, quorum)
+                return await self._quorum_read(
+                    nodes, call_node, quorum,
+                    self._hedge_delay(endpoint.path, strategy), endpoint.path)
             return await self._quorum_write(nodes, call_node, quorum)
 
-    async def _quorum_read(self, nodes, call_node, quorum) -> List[Any]:
+    async def _quorum_read(self, nodes, call_node, quorum,
+                           hedge_delay=None, endpoint_path="") -> List[Any]:
         ordered = self.request_order(nodes)
         in_flight: dict = {}
+        responded: set = set()   # nodes whose outcome already counted
         successes: List[Any] = []
         errors: List[Any] = []
         next_i = 0
         try:
             while len(successes) < quorum:
                 # keep exactly enough requests in flight to reach quorum
+                # (hedges may temporarily exceed this)
                 want = quorum - len(successes)
                 while len(in_flight) < want and next_i < len(ordered):
                     n = ordered[next_i]
@@ -217,19 +412,53 @@ class RpcHelper:
                     in_flight[asyncio.ensure_future(call_node(n))] = n
                 if not in_flight:
                     raise QuorumError(quorum, len(successes), errors)
+                # hedging: if the wave is slower than the endpoint's
+                # latency quantile AND an unsent candidate remains, launch
+                # it speculatively instead of waiting for a failure
+                can_hedge = hedge_delay is not None and next_i < len(ordered)
                 done, _ = await asyncio.wait(
-                    in_flight.keys(), return_when=asyncio.FIRST_COMPLETED
+                    in_flight.keys(),
+                    return_when=asyncio.FIRST_COMPLETED,
+                    timeout=hedge_delay if can_hedge else None,
                 )
+                if not done:
+                    n = ordered[next_i]
+                    next_i += 1
+                    in_flight[asyncio.ensure_future(call_node(n))] = n
+                    if self.m_hedges is not None:
+                        self.m_hedges.inc(endpoint=endpoint_path)
+                    continue
                 for fut in done:
-                    in_flight.pop(fut)
+                    node = in_flight.pop(fut)
+                    if bytes(node) in responded:
+                        # a node contributes at most ONE outcome to quorum
+                        # math.  Hedge/retry can't double-launch a node by
+                        # construction (next_i is monotonic; retries stay
+                        # inside one future) — this guards CALLERS passing
+                        # duplicate candidates, where two futures for one
+                        # node would otherwise both count.  Retrieve the
+                        # discarded outcome so it never logs as an
+                        # unretrieved task exception
+                        try:
+                            fut.exception()
+                        except asyncio.CancelledError:
+                            pass
+                        continue
+                    responded.add(bytes(node))
                     try:
                         successes.append(fut.result())
                     except Exception as e:
                         errors.append(e)
             return successes
         finally:
-            for fut in in_flight:
-                fut.cancel()
+            if in_flight:
+                # cancel losers AND await them in the background so their
+                # CancelledError/late results are consumed ("Task
+                # exception was never retrieved" leak otherwise); awaited
+                # at shutdown via RpcHelper.shutdown
+                for fut in in_flight:
+                    fut.cancel()
+                self._spawn_drain(list(in_flight.keys()))
 
     async def _quorum_write(self, nodes, call_node, quorum) -> List[Any]:
         futs = {asyncio.ensure_future(call_node(n)): n for n in nodes}
@@ -249,14 +478,30 @@ class RpcHelper:
             raise QuorumError(quorum, len(successes), errors)
         if pending:
             # drain stragglers in the background (ref rpc_helper.rs:348-382)
-            drain = asyncio.ensure_future(self._drain(pending))
-            self._drain_tasks.add(drain)
-            drain.add_done_callback(self._drain_tasks.discard)
+            self._spawn_drain(pending)
         return successes
+
+    def _spawn_drain(self, pending) -> None:
+        drain = asyncio.ensure_future(self._drain(pending))
+        self._drain_tasks.add(drain)
+        drain.add_done_callback(self._drain_tasks.discard)
+
+    async def shutdown(self, timeout: float = 5.0) -> None:
+        """Await background drains (write stragglers, cancelled read
+        losers) so no task outlives the transport it talks through; after
+        `timeout`, survivors are cancelled and awaited."""
+        tasks = [t for t in self._drain_tasks if not t.done()]
+        if not tasks:
+            return
+        _done, pending = await asyncio.wait(tasks, timeout=timeout)
+        for t in pending:
+            t.cancel()
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
 
     @staticmethod
     async def _drain(pending):
         results = await asyncio.gather(*pending, return_exceptions=True)
         for r in results:
             if isinstance(r, Exception):
-                logger.debug("background write straggler failed: %s", r)
+                logger.debug("background straggler failed: %s", r)

@@ -116,7 +116,10 @@ class System:
 
         self.metrics = MetricsRegistry()
         self.netapp.set_metrics(self.metrics)
-        self.peering = FullMeshPeering(self.netapp, metrics=self.metrics)
+        # [rpc] resilience tunables drive the per-peer circuit breakers
+        # (peering) and adaptive timeouts / retries / hedging (RpcHelper)
+        self.peering = FullMeshPeering(self.netapp, metrics=self.metrics,
+                                       tunables=config.rpc)
         # per-peer metric series only for peers with a dialable address;
         # throwaway CLI connections aggregate under peer="transient"
         # (unbounded label growth otherwise)
@@ -146,7 +149,7 @@ class System:
             "tracer_slow_op_max_seconds", "Slowest operation retained "
             "in the slow-op log", fn=lambda: self.tracer.slow.max_seconds())
         self.rpc = RpcHelper(self.netapp, self.peering, metrics=self.metrics,
-                             tracer=self.tracer)
+                             tracer=self.tracer, tunables=config.rpc)
 
         # node disk gauges, observed at scrape time (ref
         # rpc/system_metrics.rs:77 statvfs-fed data/meta avail gauges);
@@ -508,6 +511,9 @@ class System:
 
     async def shutdown(self):
         self._stopped.set()
+        # drain quorum-write stragglers / cancelled read losers while the
+        # transport is still alive (they are talking through it)
+        await self.rpc.shutdown(timeout=5.0)
         for t in self._tasks:
             t.cancel()
         for d in (self._discovery or []):

@@ -180,6 +180,7 @@ class Table:
                 rs_quorum=self.replication.read_quorum(),
                 rs_interrupt_after_quorum=True,
                 rs_timeout=TABLE_RPC_TIMEOUT,
+                rs_idempotent=True,  # pure read: retry/hedge freely
             ),
         )
         ret: Optional[Entry] = None
@@ -242,6 +243,7 @@ class Table:
                 rs_quorum=self.replication.read_quorum(),
                 rs_interrupt_after_quorum=True,
                 rs_timeout=TABLE_RPC_TIMEOUT,
+                rs_idempotent=True,  # pure read: retry/hedge freely
             ),
         )
         # merge per tree-key (ref table.rs:353-407)

@@ -13,14 +13,108 @@ write-time parity accumulator).  `revive()` rebuilds a Garage from the
 same config/dirs, the crash-consistency path real restarts take —
 meaningful only for persistent db engines (sqlite/native), not
 "memory".
+
+Network faults (the degraded-mode chaos rig; docs/ROBUSTNESS.md):
+`add_network_faults()` interposes a ``FaultyLink`` — a LatencyProxy
+subclass with mutable fault state — on every directed dial path i→j, so
+a running cluster's links can then be degraded live:
+
+  - latency spikes + jitter          set_latency / slow_peer
+  - probabilistic connection resets  flaky_link
+  - one-way partitions               partition_one_way (requests vanish,
+                                     replies still flow — the asymmetric
+                                     case gossip alone never detects)
+  - hard partitions                  partition (refuse + kill)
+  - blackholes                       blackhole_node (accept, never
+                                     respond — only ADAPTIVE timeouts
+                                     catch this; a static 60 s timeout
+                                     burns in full per call)
+
+Link (i, j) carries connections DIALED by i toward j; which link of a
+pair serves the one shared TCP connection depends on who won the dial
+race, so symmetric faults (latency, resets) are applied to both links of
+the pair by the helpers.
 """
 
 from __future__ import annotations
 
+import asyncio
+import logging
 import os
-from typing import List, Optional
+import random
+import time
+from typing import Dict, List, Optional, Tuple
 
+from ..net.latency_proxy import LatencyProxy
 from ..utils.data import Hash
+
+logger = logging.getLogger("garage_tpu.testing.faults")
+
+# Fast-twitch [rpc] tunables for chaos drives: sub-second adaptive
+# timeouts against loopback RTTs, quick retries, a 1 s breaker cooldown.
+# SHARED by tests/test_net_faults.py and scripts/chaos.py so the pytest
+# acceptance proof and the standalone script exercise the same regime —
+# tune it here, both rigs follow.
+FAST_CHAOS_RPC = {
+    "adaptive_timeout_base": 1.0,
+    "adaptive_timeout_min": 0.4,
+    "retry_backoff_base": 0.02,
+    "retry_backoff_max": 0.2,
+    "breaker_failure_threshold": 3,
+    "breaker_open_secs": 1.0,
+    "block_rpc_timeout": 20.0,
+}
+
+
+class FaultyLink(LatencyProxy):
+    """One directed network path with live-tunable faults.  All knobs are
+    plain attributes read per-chunk/per-accept, so tests flip them while
+    traffic is flowing."""
+
+    def __init__(self, target_host: str, target_port: int,
+                 rng: Optional[random.Random] = None):
+        super().__init__(target_host, target_port, 0.0, 0.0)
+        self.refuse = False           # hard partition: refuse new conns
+        self.blackhole = False        # accept, forward nothing either way
+        self.drop: set = set()        # {'tx','rx'} silently dropped
+        self.reset_prob = 0.0         # P(connection aborted after accept)
+        self.reset_delay = (0.02, 0.3)
+        # go dark MID-TRANSFER: after this many total forwarded bytes the
+        # link turns into a blackhole (the case a response-header timeout
+        # cannot catch — only per-chunk inactivity deadlines do)
+        self.blackhole_after_bytes: Optional[int] = None
+        self._forwarded = 0
+        self._rng = rng or random.Random()
+
+    def clear(self) -> None:
+        """Back to a clean, zero-latency link."""
+        self.refuse = False
+        self.blackhole = False
+        self.drop = set()
+        self.reset_prob = 0.0
+        self.blackhole_after_bytes = None
+        self._forwarded = 0
+        self.delay = 0.0
+        self.jitter = 0.0
+
+    def _on_accept(self, reader, writer) -> bool:
+        if self.refuse:
+            return False
+        if self.reset_prob and self._rng.random() < self.reset_prob:
+            # accepted, then reset shortly after — the classic flaky
+            # middlebox; in-flight RPCs on the conn fail all at once
+            asyncio.get_running_loop().call_later(
+                self._rng.uniform(*self.reset_delay), writer.close)
+        return True
+
+    def _filter(self, direction: str, data: bytes) -> Optional[bytes]:
+        if self.blackhole_after_bytes is not None:
+            self._forwarded += len(data)
+            if self._forwarded > self.blackhole_after_bytes:
+                self.blackhole = True
+        if self.blackhole or direction in self.drop:
+            return None
+        return data
 
 
 class FaultInjector:
@@ -31,6 +125,103 @@ class FaultInjector:
         self.configs = list(configs) if configs else [
             g.config for g in garages]
         self.dead: set = set()
+        self.links: Dict[Tuple[int, int], FaultyLink] = {}
+
+    # --- network faults ---
+
+    async def add_network_faults(
+        self, rng: Optional[random.Random] = None
+    ) -> None:
+        """Interpose a FaultyLink on every directed dial path and migrate
+        the cluster's connections through them: peer-book addresses are
+        rewritten to the link ports, direct connections are closed, and
+        the peering loop re-dials through the links."""
+        assert not self.links, "network faults already installed"
+        for i, gi in enumerate(self.garages):
+            for j, gj in enumerate(self.garages):
+                if i == j:
+                    continue
+                port = int(gj.config.rpc_public_addr.rsplit(":", 1)[1])
+                link = FaultyLink("127.0.0.1", port, rng=rng)
+                lport = await link.start()
+                self.links[(i, j)] = link
+                gi.system.peering.add_peer(f"127.0.0.1:{lport}", gj.system.id)
+        for g in self.garages:
+            for conn in list(g.system.netapp.conns.values()):
+                await conn.close()
+        await self.reconnect()
+
+    async def reconnect(self, rounds: int = 5) -> bool:
+        """Drive the live nodes' peering ticks until the mesh is whole
+        (or `rounds` exhausted) — chaos tests must not race the 15 s
+        reconnect loop."""
+        live = [g for i, g in enumerate(self.garages) if i not in self.dead]
+        for _ in range(rounds):
+            for g in live:
+                await g.system.peering._tick()
+            await asyncio.sleep(0.05)
+            if all(len(g.system.netapp.conns) >= len(live) - 1
+                   for g in live):
+                # one extra tick so freshly-dialed conns get PINGED: the
+                # RTT EWMAs must exist or the adaptive-timeout layer falls
+                # back to static timeouts for every peer
+                for g in live:
+                    await g.system.peering._tick()
+                return True
+        return False
+
+    def _pair(self, i: int, j: int) -> List[FaultyLink]:
+        return [self.links[(i, j)], self.links[(j, i)]]
+
+    def set_latency(self, i: int, j: int, delay: float,
+                    jitter: float = 0.0) -> None:
+        """One-way `delay` (±jitter) on both links of the pair (i, j)."""
+        for link in self._pair(i, j):
+            link.delay, link.jitter = delay, jitter
+
+    def slow_peer(self, k: int, delay: float, jitter: float = 0.0) -> None:
+        """Latency spike on every link touching node k (a straggling
+        datacenter, not a single bad cable)."""
+        for (a, b), link in self.links.items():
+            if k in (a, b):
+                link.delay, link.jitter = delay, jitter
+
+    def flaky_link(self, i: int, j: int, reset_prob: float) -> None:
+        for link in self._pair(i, j):
+            link.reset_prob = reset_prob
+
+    def partition_one_way(self, src: int, dst: int) -> None:
+        """Bytes from src never reach dst; dst's bytes still reach src
+        (asymmetric routing failure).  Requests die, replies flow."""
+        self.links[(src, dst)].drop.add("tx")
+        self.links[(dst, src)].drop.add("rx")
+
+    def partition(self, i: int, j: int) -> None:
+        """Hard two-way partition: refuse new connections, kill live
+        ones (both sides see resets, dials fail fast)."""
+        for link in self._pair(i, j):
+            link.refuse = True
+            link.kill_connections()
+
+    def blackhole_node(self, k: int) -> None:
+        """Every link touching k accepts but never delivers — in-flight
+        RPCs hang until (only) the adaptive timeout fires."""
+        for (a, b), link in self.links.items():
+            if k in (a, b):
+                link.blackhole = True
+
+    def heal_link(self, i: int, j: int) -> None:
+        for link in self._pair(i, j):
+            link.clear()
+
+    def heal_network(self) -> None:
+        for link in self.links.values():
+            link.clear()
+
+    async def stop_network(self) -> None:
+        for link in self.links.values():
+            await link.stop()
+        self.links.clear()
 
     # --- node faults ---
 
@@ -44,10 +235,14 @@ class FaultInjector:
             g.db.close()
         self.dead.add(i)
 
-    async def revive(self, i: int, peers: Optional[List[str]] = None):
+    async def revive(self, i: int, peers: Optional[List[str]] = None,
+                     wait_secs: float = 10.0):
         """Restart node i from its on-disk state; returns the new Garage.
         `peers` = "host:port" addresses to reconnect to (defaults to the
-        rpc_public_addr of every live node)."""
+        rpc_public_addr — or fault-link port — of every live node).
+        Dial failures are LOGGED (the peering loop keeps retrying them),
+        and the call waits up to `wait_secs` for the peer handshakes so
+        chaos tests don't race the reconnect loop."""
         from ..model import Garage
 
         assert i in self.dead, f"node {i} is not dead"
@@ -55,23 +250,41 @@ class FaultInjector:
         await g.system.netapp.listen(self.configs[i].rpc_bind_addr)
         port = g.system.netapp._server.sockets[0].getsockname()[1]
         g.config.rpc_public_addr = f"127.0.0.1:{port}"
+        live = [j for j in range(len(self.garages))
+                if j != i and j not in self.dead]
+        if self.links:
+            # the revived node listens on a fresh port: EVERY link
+            # pointing at it must retarget — including links from
+            # currently-dead nodes, or a later revive of those nodes
+            # dials this node's stale port forever (failing ticks that
+            # wrongly feed its breaker)
+            for (a, b), link in self.links.items():
+                if b == i:
+                    link.retarget(port)
+
+        def _addr_of(j: int) -> str:
+            if self.links:
+                return f"127.0.0.1:{self.links[(i, j)].port}"
+            return self.garages[j].config.rpc_public_addr
+
         if peers is None:
-            peers = [
-                self.garages[j].config.rpc_public_addr
-                for j in range(len(self.garages))
-                if j != i and j not in self.dead
-            ]
+            peers = [_addr_of(j) for j in live]
         for addr in peers:
             try:
                 await g.system.netapp.connect(addr)
-            except Exception:
-                pass  # peer may be down; the peering loop keeps trying
-        for j, other in enumerate(self.garages):
-            if j != i and j not in self.dead:
-                other.system.peering.add_peer(
-                    g.config.rpc_public_addr, g.system.id)
-                g.system.peering.add_peer(
-                    other.config.rpc_public_addr, other.system.id)
+            except Exception as e:
+                # not silent: a chaos run must be able to tell "revive
+                # raced the reconnect loop" from "revive couldn't reach
+                # anything" in its logs
+                logger.warning(
+                    "revive(%d): dial %s failed (%s); peering loop will "
+                    "keep retrying", i, addr, e)
+        for j in live:
+            other = self.garages[j]
+            other_addr = (f"127.0.0.1:{self.links[(j, i)].port}"
+                          if self.links else g.config.rpc_public_addr)
+            other.system.peering.add_peer(other_addr, g.system.id)
+            g.system.peering.add_peer(_addr_of(j), other.system.id)
         # adopt the cluster's layout from any live node
         for j, other in enumerate(self.garages):
             if j != i and j not in self.dead:
@@ -85,6 +298,26 @@ class FaultInjector:
         g.system.peering.start()
         self.garages[i] = g
         self.dead.discard(i)
+        # bounded convergence wait: drive peering ticks (both sides —
+        # the live nodes' 15 s loops would otherwise win every race)
+        # until every live peer's handshake landed or the budget is out
+        expected = {self.garages[j].system.id for j in live}
+        deadline = time.monotonic() + wait_secs
+
+        def _missing():
+            return [n for n in expected if n not in g.system.netapp.conns]
+
+        while _missing() and time.monotonic() < deadline:
+            await g.system.peering._tick()
+            for j in live:
+                if j not in self.dead:
+                    await self.garages[j].system.peering._tick()
+            await asyncio.sleep(0.1)
+        still = _missing()
+        if still:
+            logger.warning(
+                "revive(%d): %d/%d peer handshakes still missing after "
+                "%.1fs", i, len(still), len(expected), wait_secs)
         return g
 
     # --- block faults ---

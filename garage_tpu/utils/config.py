@@ -24,6 +24,7 @@ except ImportError:  # Python < 3.11: the API-identical backport
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ..net.resilience import ResilienceTunables
 from ..ops.codec import CodecParams as _CodecParams
 
 _CODEC_DEFAULTS = _CodecParams()
@@ -209,6 +210,10 @@ class Config:
     admin_trace_sink: Optional[str] = None  # OTLP/HTTP collector endpoint
     k2v_api_bind_addr: Optional[str] = None
     codec: CodecConfig = field(default_factory=CodecConfig)
+    # [rpc] — degraded-mode resilience tunables (adaptive timeouts,
+    # retry/backoff, read hedging, per-peer circuit breaker, and the
+    # static block-transfer timeout); see docs/ROBUSTNESS.md
+    rpc: ResilienceTunables = field(default_factory=ResilienceTunables)
     consul_discovery: Optional[ConsulDiscoveryConfig] = None
     kubernetes_discovery: Optional[KubernetesDiscoveryConfig] = None
     # raw parsed TOML for anything not modeled
@@ -293,6 +298,19 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         "catalog", "agent"
     ):
         raise ConfigError("consul_discovery.api must be catalog|agent")
+
+    rpc = raw.get("rpc", {})
+    known = {f.name for f in dataclasses.fields(ResilienceTunables)}
+    bad = set(rpc) - known
+    if bad:
+        raise ConfigError(f"unknown [rpc] keys: {sorted(bad)}")
+    cfg.rpc = ResilienceTunables(**rpc)
+    if cfg.rpc.retry_max < 0:
+        raise ConfigError("rpc.retry_max must be >= 0")
+    if not 0.0 < cfg.rpc.hedge_quantile < 1.0:
+        raise ConfigError("rpc.hedge_quantile must be in (0, 1)")
+    if cfg.rpc.breaker_failure_threshold < 1:
+        raise ConfigError("rpc.breaker_failure_threshold must be >= 1")
 
     codec = raw.get("codec", {})
     known = {f.name for f in dataclasses.fields(CodecConfig)}

@@ -28,6 +28,15 @@ class TimeoutError_(RpcError):
     pass
 
 
+class PeerUnavailable(RpcError):
+    """Call refused locally: the peer's circuit breaker is open, so
+    dispatching would only burn a timeout.  Raised before any bytes hit
+    the wire — quorum fan-outs treat it like any other per-node error
+    (next candidate launches immediately), and it is deliberately NOT
+    retryable (the breaker's cooldown governs when the peer gets its
+    next chance)."""
+
+
 class CorruptData(GarageError):
     """Block content does not match its hash (ref util/error.rs CorruptData)."""
 

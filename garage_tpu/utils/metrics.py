@@ -119,6 +119,30 @@ class Histogram:
         RecordDuration combinator, util/metrics.rs:8-57)."""
         return _Timer(self, labels)
 
+    def quantile(self, q: float, min_count: int = 1,
+                 **labels) -> Optional[float]:
+        """Estimate the q-quantile for one label set by linear
+        interpolation inside the holding bucket (the same estimate
+        Prometheus' histogram_quantile() makes at query time — the hedge
+        trigger reuses it process-side).  None when the label set has
+        fewer than min_count observations; observations in the +Inf
+        bucket clamp to the last finite bucket edge."""
+        slot = self._vals.get(tuple(sorted(labels.items())))
+        if slot is None or slot[-1] < min_count:
+            return None
+        target = q * slot[-1]
+        cum = 0.0
+        lo = 0.0
+        for i, edge in enumerate(self.buckets):
+            c = slot[i]
+            if cum + c >= target:
+                if c <= 0:
+                    return lo
+                return lo + (edge - lo) * (target - cum) / c
+            cum += c
+            lo = edge
+        return self.buckets[-1]
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]

@@ -1,0 +1,182 @@
+"""Reproducible degraded-mode chaos drive (ISSUE 4 CI/tooling satellite).
+
+Builds a 3-node in-process cluster in a temp dir, interposes the
+FaultInjector's network FaultyLinks on every RPC path, then runs S3
+PUT/GET traffic through a sequence of network-fault phases:
+
+  baseline    clean links (sanity + latency floor)
+  latency     one peer at ~10× RTT with jitter (tail-latency regime)
+  flaky       10% connection resets on one link
+  oneway      one-way partition gateway→replica (requests vanish,
+              replies flow)
+  partition   hard two-way partition between the two replicas
+  blackhole   one replica accepts and never responds (the case only
+              adaptive timeouts catch) — breaker open/recover asserted
+
+Every phase must complete with ZERO client-visible errors (quorum 2/3
+survives each single fault); the exit code says so, and a JSON summary
+(per-phase op counts + p50/p99/max latency + breaker states) goes to
+stdout for bench comparisons.  The same rig the pytest chaos suite uses
+(tests/test_net_faults.py), runnable standalone:
+
+    JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/chaos.py [--quick]
+        [--phases latency,partition] [--secs 8]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PHASES = ("baseline", "latency", "flaky", "oneway", "partition", "blackhole")
+
+
+def _apply(inj, phase):
+    if phase == "latency":
+        inj.slow_peer(2, 0.02, jitter=0.005)
+    elif phase == "flaky":
+        inj.flaky_link(0, 1, 0.10)
+    elif phase == "oneway":
+        inj.partition_one_way(0, 1)
+    elif phase == "partition":
+        inj.partition(1, 2)
+    elif phase == "blackhole":
+        inj.blackhole_node(2)
+
+
+async def run(phases, secs):
+    import aiohttp
+    import numpy as np
+
+    import bench
+    from garage_tpu.testing.faults import FAST_CHAOS_RPC, FaultInjector
+
+    rng = random.Random(1031)
+    nprng = np.random.default_rng(57)
+    summary = {"phases": {}, "ok": True}
+    with tempfile.TemporaryDirectory(prefix="garage_chaos_") as tmp:
+        from pathlib import Path
+
+        garages, server, port, kid, secret = await bench._mk_cluster(
+            Path(tmp), n=3, repl="3", db="memory",
+            codec_cfg={"rs_data": 0, "rs_parity": 0, "backend": "cpu"},
+            rpc_cfg=FAST_CHAOS_RPC)
+        inj = FaultInjector(garages)
+        await inj.add_network_faults(rng=random.Random(7))
+        try:
+            async with aiohttp.ClientSession() as session:
+                s3 = bench._S3(session, port, kid, secret)
+                st, _b, _h = await s3.req("PUT", "/chaos")
+                assert st == 200, f"bucket create: {st}"
+                for phase in phases:
+                    _apply(inj, phase)
+                    stats = {"puts": 0, "gets": 0, "errors": 0}
+                    lats = []
+                    acked = {}
+                    deadline = time.monotonic() + secs
+                    i = 0
+                    while time.monotonic() < deadline:
+                        i += 1
+                        name = f"{phase}-{i:04d}"
+                        body = nprng.integers(
+                            0, 256, rng.randrange(4 << 10, 256 << 10),
+                            dtype=np.uint8).tobytes()
+                        t0 = time.perf_counter()
+                        st, _b, _h = await s3.req(
+                            "PUT", f"/chaos/{name}", body)
+                        lats.append(time.perf_counter() - t0)
+                        if st == 200:
+                            acked[name] = body
+                            stats["puts"] += 1
+                        else:
+                            stats["errors"] += 1
+                        if acked:
+                            probe = rng.choice(sorted(acked))
+                            t0 = time.perf_counter()
+                            st, got, _h = await s3.req(
+                                "GET", f"/chaos/{probe}")
+                            lats.append(time.perf_counter() - t0)
+                            if st == 200 and got == acked[probe]:
+                                stats["gets"] += 1
+                            else:
+                                stats["errors"] += 1
+                        if i % 5 == 0:
+                            for g in garages:
+                                await g.system.peering._tick()
+                    if phase == "blackhole":
+                        # the breaker must have opened on the blackholed
+                        # peer (fast-fail) — observable, not inferred
+                        g0 = garages[0]
+                        n2 = garages[2].system.id
+                        stats["breaker"] = g0.system.peering.breaker_state(n2)
+                        summary["ok"] &= stats["breaker"] in (
+                            "open", "half_open")
+                    inj.heal_network()
+                    await inj.reconnect()
+                    if phase == "blackhole":
+                        # …and recover: cooldown, then one probe call
+                        await asyncio.sleep(FAST_CHAOS_RPC["breaker_open_secs"] + 0.2)
+                        g0 = garages[0]
+                        n2 = garages[2].system.id
+                        try:
+                            await g0.system.rpc.call(
+                                g0.block_manager.endpoint, n2,
+                                {"t": "need_block", "h": bytes(32)},
+                                timeout=5.0, idempotent=True)
+                        except Exception as e:  # noqa: BLE001
+                            print(f"probe after heal failed: {e}",
+                                  file=sys.stderr)
+                        stats["breaker_after_heal"] = (
+                            g0.system.peering.breaker_state(n2))
+                        summary["ok"] &= (
+                            stats["breaker_after_heal"] == "closed")
+                    lats.sort()
+                    stats["ops"] = len(lats)
+                    if lats:
+                        stats["p50_ms"] = round(
+                            lats[len(lats) // 2] * 1000, 2)
+                        stats["p99_ms"] = round(
+                            lats[min(len(lats) - 1,
+                                     int(len(lats) * 0.99))] * 1000, 2)
+                        stats["max_ms"] = round(lats[-1] * 1000, 2)
+                    summary["phases"][phase] = stats
+                    summary["ok"] &= stats["errors"] == 0
+                    print(f"phase {phase}: {stats}", file=sys.stderr)
+        finally:
+            await server.stop()
+            await inj.stop_network()
+            for g in garages:
+                await g.shutdown()
+    return summary
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--phases", default=",".join(PHASES),
+                    help="comma-separated subset of " + ",".join(PHASES))
+    ap.add_argument("--secs", type=float, default=8.0,
+                    help="traffic seconds per phase")
+    ap.add_argument("--quick", action="store_true",
+                    help="3 s per phase (smoke mode)")
+    args = ap.parse_args()
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    bad = [p for p in phases if p not in PHASES]
+    if bad:
+        ap.error(f"unknown phases: {bad}")
+    secs = 3.0 if args.quick else args.secs
+    summary = asyncio.run(run(phases, secs))
+    print("CHAOS " + json.dumps(summary))
+    if not summary["ok"]:
+        sys.exit(1)
+    print("CHAOS OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -148,7 +148,9 @@ async def main() -> None:
             problems = lint_exposition(body)
             assert not problems, f"/metrics on :{port} fails lint: {problems}"
             for fam in ("net_peer_tx_bytes_total", "worker_state",
-                        "peer_rtt_ewma_seconds", "rpc_request_counter"):
+                        "peer_rtt_ewma_seconds", "rpc_request_counter",
+                        "peer_breaker_state", "rpc_retry_total",
+                        "rpc_hedge_total"):
                 assert fam in body, f"family {fam} missing on :{port}"
     print("metrics exposition lint ok (3 nodes)")
 

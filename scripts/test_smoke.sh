@@ -9,4 +9,9 @@
 #        scripts/test_smoke.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
-JAX_PLATFORMS=cpu PYTHONPATH="$PWD" exec python scripts/smoke.py "$@"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/smoke.py "$@"
+# degraded-mode smoke: one hard partition between the two replicas of an
+# in-process 3-node cluster must stay client-invisible (quorum 2/3)
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases partition
+echo "SMOKE+CHAOS OK"
