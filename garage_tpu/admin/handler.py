@@ -403,6 +403,10 @@ class AdminRpcHandler:
                 "hostname": status.hostname if status else None,
                 "addr": st.addr,
                 "up": st.is_up,
+                # gossiped worst data-root health: a remote node gone
+                # read-only (StorageFull/-Error rejections) is visible
+                # here without waiting for a failed PUT
+                "disk_state": status.disk_state if status else None,
                 "connected": conn is not None and not conn._closed,
                 "rtt_ewma_ms": (
                     round(st.latency * 1000.0, 3)
@@ -416,8 +420,32 @@ class AdminRpcHandler:
                 "traffic": conn.traffic_stats() if conn is not None else None,
             })
         peers.sort(key=lambda p: (not p["up"], p["id"]))
+        # local disk health: the per-root state machine + quarantine
+        # counters (block/health.py) — the node-side truth behind the
+        # gossiped disk_state peers see above
+        mgr = self.garage.block_manager
+        disk = {
+            "state": mgr.health.worst_state(),
+            "roots": [
+                {
+                    "path": r,
+                    "state": s,
+                    "free_bytes": mgr.health.free_bytes(r),
+                }
+                for r, s in mgr.health.states().items()
+            ],
+            "error_counts": {
+                # snapshot first: note_error inserts new (op, kind) keys
+                # from worker threads while this comprehension runs
+                f"{op}:{kind}": n
+                for (op, kind), n in dict(mgr.health.error_counts).items()
+            },
+            "quarantined": mgr.quarantined,
+            "quarantine_errors": mgr.quarantine_errors,
+        }
         return {
             "node_id": bytes(sys.id).hex(),
+            "disk": disk,
             "peers": peers,
         }
 

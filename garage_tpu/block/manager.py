@@ -21,6 +21,7 @@ pay a device roundtrip; batched scrub/resync still run on device).
 from __future__ import annotations
 
 import asyncio
+import errno as _errno
 import logging
 import os
 from typing import AsyncIterator, List, Optional, Tuple
@@ -28,12 +29,20 @@ from typing import AsyncIterator, List, Optional, Tuple
 from ..db import Db
 from ..net.frame import PRIO_BACKGROUND, PRIO_NORMAL
 from ..rpc.system import System
+from ..utils.crdt import now_msec
 from ..utils.data import Hash, block_hash
-from ..utils.direct_io import write_file_direct
-from ..utils.error import CorruptData, GarageError, NoSuchBlock
+from ..utils.error import (
+    CorruptData,
+    GarageError,
+    NoSuchBlock,
+    StorageError,
+    StorageFull,
+)
 from ..utils.metrics import maybe_time
 from ..utils.persister import Persister
 from .block import DataBlock, DataBlockHeader
+from .health import (DISK_STATE_VALUES, DiskHealthMonitor, DiskIo,
+                     is_media_error, janitor_pass)
 from .layout import DataLayout
 from .rc import BlockRc
 
@@ -95,6 +104,37 @@ class BlockManager:
             self.data_layout = saved
         for d in self.data_layout.data_dirs:
             os.makedirs(d.path, exist_ok=True)
+
+        # the filesystem boundary: every byte this manager moves to or
+        # from disk goes through self.disk, so storage faults inject at
+        # exactly one seam (testing/faults.py FaultyDisk wraps it)
+        self.disk = DiskIo()
+        # per-hash local-read error backoff (a bad sector must not be
+        # re-hit by every read of a hot block while peers can serve it);
+        # reuses the resync ErrorCounter schedule
+        self._disk_errors: dict = {}
+        m0 = getattr(system, "metrics", None)
+        # per-data-root ok → degraded(read-only) → failed state machine:
+        # free-space watermark preflight + disk-error streaks through
+        # the RPC layer's CircuitBreaker (block/health.py).  statvfs is
+        # routed through self.disk via a late-bound closure so a fault
+        # wrapper installed later is honored.
+        self.health = DiskHealthMonitor(
+            [d.path for d in self.data_layout.data_dirs],
+            watermark=getattr(config, "data_free_space_watermark", 128 << 20),
+            error_threshold=getattr(config, "disk_error_threshold", 8),
+            cooldown=getattr(config, "disk_error_cooldown", 30.0),
+            statvfs=lambda p: self.disk.statvfs(p),
+            counter=(m0.counter(
+                "disk_error_total",
+                "Disk I/O errors at the block store boundary, by "
+                "operation and errno kind") if m0 is not None else None),
+        )
+        # gossiped next to the statvfs numbers so peers' `cluster stats`
+        # show a remote node going read-only (rpc/system.py NodeStatus)
+        system.disk_state_fn = self.health.worst_state
+        self.quarantined = 0          # copies moved aside as .corrupted
+        self.quarantine_errors = 0    # quarantine renames that failed
 
         self.rc = BlockRc(db.open_tree("block_local_rc"))
         # node-local record of which stored blocks are distributed-parity
@@ -162,6 +202,29 @@ class BlockManager:
                 "block_read_duration_seconds", "Local block read+verify")
             self.m_write_dur = m.histogram(
                 "block_write_duration_seconds", "Local block write")
+            # labeled render-time observers: any render() (admin
+            # /metrics, tests, chaos scripts) sees CURRENT per-root
+            # health with no scrape-side refresh hook to forget
+            m.gauge(
+                "disk_root_state",
+                "Data-root health: 0 ok, 1 degraded (read-only), 2 failed",
+                labeled_fn=lambda: [
+                    ({"root": r}, DISK_STATE_VALUES[s])
+                    for r, s in self.health.states().items()])
+            m.gauge(
+                "disk_free_bytes",
+                "Free bytes per data root (statvfs, cached)",
+                labeled_fn=lambda: [
+                    ({"root": r}, float(self.health.free_bytes(r) or 0))
+                    for r in self.health.roots()])
+            self.m_quarantine = m.counter(
+                "block_quarantine_total",
+                "Block copies moved aside as .corrupted (read-path "
+                "verify failures, unreadable files, scrub)")
+            self.m_quarantine_err = m.counter(
+                "block_quarantine_error_total",
+                "Quarantine renames that failed (bad copy deleted "
+                "instead so resync can refetch)")
             self.m_heal = m.counter(
                 "block_heal_total",
                 "Blocks re-materialized, by heal source (writeback = "
@@ -192,6 +255,7 @@ class BlockManager:
         else:
             self.m_read_dur = self.m_write_dur = None
             self.m_heal = None
+            self.m_quarantine = self.m_quarantine_err = None
 
     # --- paths ---
 
@@ -217,6 +281,99 @@ class BlockManager:
 
     def is_block_present(self, h: Hash) -> bool:
         return self.find_block(h) is not None
+
+    def _root_of(self, path: str) -> str:
+        """Which data root a block file lives under (longest prefix
+        match; falls back to the file's dirname for out-of-layout paths
+        so health accounting never KeyErrors)."""
+        best = ""
+        for d in self.data_layout.data_dirs:
+            r = d.path.rstrip(os.sep)
+            if (path == r or path.startswith(r + os.sep)) and len(r) > len(best):
+                best = r
+        return best or os.path.dirname(path)
+
+    def quarantine_path(self, path: str) -> None:
+        """Move a bad copy aside as `.corrupted` for later forensics.
+        A failing rename is NOT swallowed (the old `_move_corrupted`
+        silently did, leaving a corrupt copy live and re-servable): it
+        is logged with path+errno, counted, and the bad copy is deleted
+        instead so resync refetches a clean one.  Runs in worker
+        threads — keep it sync."""
+        try:
+            self.disk.replace(path, path + ".corrupted")
+            self.quarantined += 1
+            if self.m_quarantine is not None:
+                self.m_quarantine.inc()
+        except FileNotFoundError:
+            # lost the race: a concurrent reader of the same bad copy
+            # (or a delete) already quarantined/removed it — that IS the
+            # desired end state, not a quarantine failure, and it must
+            # not count errors or feed the root's streak
+            return
+        except OSError as e:
+            self.quarantine_errors += 1
+            if self.m_quarantine_err is not None:
+                self.m_quarantine_err.inc()
+            logger.error(
+                "quarantine rename of %s failed (errno %s: %s); deleting "
+                "the bad copy so resync can refetch", path, e.errno, e)
+            try:
+                self.disk.remove(path)
+            except FileNotFoundError:
+                pass
+            except OSError as e2:
+                logger.error("deleting bad copy %s also failed "
+                             "(errno %s: %s)", path, e2.errno, e2)
+                self.health.note_error(self._root_of(path), "quarantine", e2)
+
+    def _note_disk_error(self, h: Hash) -> None:
+        """Arm/extend the per-hash local-read backoff (ErrorCounter
+        schedule: 60 s × 2^n).  While armed, read_block skips the local
+        file immediately so reads fail over to peers instead of
+        re-hitting a bad sector; a successful local write or read
+        clears it."""
+        from .resync import ErrorCounter
+
+        hb = bytes(h)
+        prev = self._disk_errors.get(hb)
+        self._disk_errors[hb] = ErrorCounter(
+            (prev.errors if prev is not None else 0) + 1, now_msec())
+        if len(self._disk_errors) > 4096:
+            # bounded: drop the oldest-armed entries (retrying a stale
+            # hash locally once is harmless)
+            for k in sorted(self._disk_errors,
+                            key=lambda k: self._disk_errors[k].last_try
+                            )[:1024]:
+                del self._disk_errors[k]
+
+    def startup_janitor(self) -> dict:
+        """Boot-time crash-consistency pass (block/health.py
+        janitor_pass): purge orphaned `.tmp` files (torn writes — never
+        acknowledged), bound the `.corrupted` quarantine, and re-enqueue
+        every surviving quarantined hash for resync so holes left by a
+        crash between quarantine and enqueue are refilled.  Called by
+        Garage right after the resync manager is attached."""
+        roots = [d.path for d in self.data_layout.data_dirs]
+        summary = janitor_pass(
+            roots,
+            max_quarantine_files=getattr(
+                self.config, "quarantine_max_files", 128),
+            max_quarantine_bytes=getattr(
+                self.config, "quarantine_max_bytes", 256 << 20),
+        )
+        requeue = summary.get("requeue", [])
+        if self.resync is not None:
+            for hb in requeue:
+                self.resync.put_to_resync(Hash(hb), 1.0, source="janitor")
+        if summary["tmp_purged"] or summary["quarantine_purged"] or requeue:
+            logger.info(
+                "startup janitor: purged %d orphaned .tmp, pruned %d "
+                "quarantined files (kept %d), requeued %d hashes for "
+                "resync", summary["tmp_purged"],
+                summary["quarantine_purged"], summary["quarantine_kept"],
+                len(requeue))
+        return summary
 
     def _lock_for(self, h: Hash) -> asyncio.Lock:
         return self._locks[h[0] % MUTEX_SHARDS]
@@ -277,29 +434,47 @@ class BlockManager:
             path, compressed = existing
             if compressed or not data.compressed:
                 # an equal-or-better copy exists (compressed preferred):
-                # keep it (ref manager.rs:717-735 dedupe)
+                # keep it (ref manager.rs:717-735 dedupe).  Checked
+                # BEFORE the health preflight — a degraded node that
+                # already holds the block should acknowledge the PUT,
+                # not reject data it has.
                 return False
-        d = os.path.dirname(final)
-        os.makedirs(d, exist_ok=True)
-        tmp = final + ".tmp"
-        # O_DIRECT (buffered fallback inside): ~4x less CPU than the
-        # page-cache copy and immune to dirty-page throttling, so
-        # concurrent puts overlap their writes on a 1-core host; the
-        # bulk of the block is on media at return even with
-        # data_fsync=false (see utils/direct_io.py)
-        write_file_direct(tmp, data.inner, fsync=self.data_fsync)
-        os.replace(tmp, final)
-        if self.data_fsync:
-            # fsync the directory so the rename is durable (manager.rs:760-775)
-            dirfd = os.open(d, os.O_RDONLY)
-            try:
-                os.fsync(dirfd)
-            finally:
-                os.close(dirfd)
+        # preflight: free-space watermark + error-streak breaker; raises
+        # the typed StorageFull/StorageError the write quorum routes
+        # around.  May consume the half-open probe slot — the outcome
+        # below MUST be reported back (note_ok / note_error).
+        self.health.check_writable(root, len(data.inner))
+        try:
+            d = os.path.dirname(final)
+            os.makedirs(d, exist_ok=True)
+            tmp = final + ".tmp"
+            # O_DIRECT (buffered fallback inside): ~4x less CPU than the
+            # page-cache copy and immune to dirty-page throttling, so
+            # concurrent puts overlap their writes on a 1-core host; the
+            # bulk of the block is on media at return even with
+            # data_fsync=false (see utils/direct_io.py)
+            self.disk.write_file(tmp, data.inner, fsync=self.data_fsync)
+            self.disk.replace(tmp, final)
+            if self.data_fsync:
+                # fsync the directory so the rename is durable
+                # (manager.rs:760-775)
+                self.disk.fsync_dir(d)
+        except OSError as e:
+            # a failed write's tmp is deliberately LEFT BEHIND, exactly
+            # as a crash would leave it: the path is deterministic (one
+            # stale tmp per block at most, reclaimed by the next write's
+            # truncate or the startup janitor), and cleanup attempts on
+            # a disk that just errored tend to error too
+            self.health.note_error(root, "write", e)
+            cls = StorageFull if e.errno == _errno.ENOSPC else StorageError
+            raise cls(f"block write failed on {root}: {e}") from e
+        self.health.note_ok(root, "write")
+        # a freshly-written good copy clears the hash's read backoff
+        self._disk_errors.pop(bytes(h), None)
         if existing is not None and existing[0] != final:
             # plain copy superseded by compressed one
             try:
-                os.remove(existing[0])
+                self.disk.remove(existing[0])
             except OSError:
                 pass
         self.bytes_written += len(data.inner)
@@ -312,21 +487,66 @@ class BlockManager:
             return await self._read_block_inner(h)
 
     async def _read_block_inner(self, h: Hash) -> DataBlock:
+        hb = bytes(h)
+        ec = self._disk_errors.get(hb)
+        if ec is not None and ec.next_try() > now_msec():
+            # the local copy recently EIO'd and is in backoff: fail over
+            # to peers immediately instead of re-hitting the bad sector
+            raise NoSuchBlock(
+                f"block {hb.hex()[:16]} local copy in disk-error backoff")
         found = self.find_block(h)
         if found is None:
-            raise NoSuchBlock(f"block {bytes(h).hex()[:16]} not found locally")
+            raise NoSuchBlock(f"block {hb.hex()[:16]} not found locally")
         path, compressed = found
-        raw = await asyncio.to_thread(_read_file, path)
+        try:
+            raw = await asyncio.to_thread(self.disk.read_file, path)
+        except FileNotFoundError:
+            # NOT a disk fault: the file vanished between find_block and
+            # the read — a benign race with delete_if_unneeded / stray
+            # cleanup.  Plain miss, no health/quarantine side effects
+            # (8 such races must never flip a healthy root read-only).
+            raise NoSuchBlock(
+                f"block {hb.hex()[:16]} removed concurrently")
+        except OSError as e:
+            if not is_media_error(e):
+                # process-level resource pressure (EMFILE/ENOMEM/…): the
+                # bytes on disk are fine — fail over to a replica but
+                # destroy nothing and keep the root's streak clean, or a
+                # busy node would mass-quarantine its own healthy data
+                logger.warning("transient read error on block %s at %s "
+                               "(errno %s: %s)", hb.hex()[:16], path,
+                               e.errno, e)
+                raise NoSuchBlock(
+                    f"block {hb.hex()[:16]} local read failed "
+                    f"transiently: {e}") from e
+            # read-time disk error (EIO, remount-ro, truncated dir):
+            # quarantine the unreadable copy, arm the per-hash backoff,
+            # enqueue a refetch, and surface NoSuchBlock so every caller
+            # — the get_block RPC handler, the streaming failover loop —
+            # transparently moves to the next replica instead of handing
+            # the client an OSError
+            root = self._root_of(path)
+            self.health.note_error(root, "read", e)
+            self._note_disk_error(h)
+            logger.error("disk read error on block %s at %s "
+                         "(errno %s: %s)", hb.hex()[:16], path, e.errno, e)
+            await asyncio.to_thread(self.quarantine_path, path)
+            if self.resync is not None:
+                self.resync.put_to_resync(h, 0.0, source="disk_error")
+            raise NoSuchBlock(
+                f"block {hb.hex()[:16]} local copy unreadable: {e}") from e
         block = DataBlock(raw, compressed)
         try:
             block.verify(h, self.hash_algo, codec=self.codec)
         except CorruptData:
             self.corruptions += 1
-            logger.error("corrupted block %s at %s", bytes(h).hex()[:16], path)
-            await asyncio.to_thread(_move_corrupted, path)
+            logger.error("corrupted block %s at %s", hb.hex()[:16], path)
+            await asyncio.to_thread(self.quarantine_path, path)
             if self.resync is not None:
                 self.resync.put_to_resync(h, 0.0, source="corrupt_read")
             raise
+        self.health.note_ok(self._root_of(path), "read")
+        self._disk_errors.pop(hb, None)
         self.bytes_read += len(raw)
         return block
 
@@ -344,7 +564,7 @@ class BlockManager:
                 found = self.find_block(h)
                 if found is None:
                     break
-                await asyncio.to_thread(os.remove, found[0])
+                await asyncio.to_thread(self.disk.remove, found[0])
             self.rc.clear_deleted_block_rc(h)
 
     # --- refcounting entry points (called from table updated() hooks) ---
@@ -474,7 +694,7 @@ class BlockManager:
 
     async def rpc_get_raw_block(
         self, h: Hash, order_tag: Optional[int] = None,
-        for_storage: bool = False,
+        for_storage: bool = False, idempotent: bool = False,
     ) -> DataBlock:
         """Fetch one block as a storable DataBlock.  Rides the SAME
         streaming failover path as the GET plane — mid-transfer node
@@ -487,7 +707,8 @@ class BlockManager:
         meta: dict = {}
         chunks = []
         async for c in self.rpc_get_block_streaming(h, order_tag,
-                                                    meta_out=meta):
+                                                    meta_out=meta,
+                                                    idempotent=idempotent):
             chunks.append(c)
         data = b"".join(chunks)
         if for_storage:
@@ -509,105 +730,142 @@ class BlockManager:
 
     async def rpc_get_block_streaming(
         self, h: Hash, order_tag: Optional[int] = None,
-        meta_out: Optional[dict] = None,
+        meta_out: Optional[dict] = None, idempotent: bool = False,
     ) -> AsyncIterator[bytes]:
         """Async-iterate a block's DECOMPRESSED bytes with mid-transfer
         node failover: if the serving node dies mid-stream, the read
         resumes from the next replica, skipping the bytes already
         delivered (ref manager.rs:231-345 + the get-path streaming of
         get.rs:432-512).  Memory stays bounded by the transport chunk
-        size — the block is never buffered whole."""
+        size — the block is never buffered whole.
+
+        ``idempotent`` grants the whole fan-out ONE shared budget of
+        ``retry_max`` full-jitter retries on TRANSPORT errors (same
+        shared-budget semantics as RpcHelper: per-node budgets would
+        multiply load during a correlated network failure), spent on
+        same-node retries before failing over — safe for pure fetches:
+        resync refetch, repair.  A GET already delivering a body to a
+        client keeps single-attempt-per-node failover semantics, since
+        the delivered-offset skip makes a same-node retry redundant with
+        just trying the next replica."""
+        from ..net.resilience import full_jitter_backoff, is_transport_error
+
         rpc = self.system.rpc
         who = rpc.request_order(self.replication.read_nodes(h))
         delivered = 0
         errors = []
+        attempts_left = rpc.tunables.retry_max if idempotent else 0
         for node in who:
             # the streaming failover loop IS this path's retry/hedge
             # mechanism; it still consults the resilience layer so an
             # open-breaker replica fast-fails to the next copy and a
             # known-RTT replica gets the clamped adaptive timeout
-            if not rpc.peer_allows(node):
-                errors.append(f"{bytes(node).hex()[:8]}: breaker open")
-                continue
-            try:
-                # the transport timeout covers only time-to-response-
-                # header; the same (adaptive) budget is reused below as a
-                # PER-CHUNK inactivity deadline, because a peer that
-                # blackholes mid-stream keeps the connection "up" while
-                # bytes stop — without a chunk deadline the read hangs
-                # forever and the per-replica failover never runs
-                node_timeout = rpc.timeout_for(node, self.block_rpc_timeout)
-                resp, stream = await self.endpoint.call_streaming(
-                    node,
-                    {"t": "get_block", "h": bytes(h), "order": order_tag},
-                    prio=PRIO_NORMAL,
-                    timeout=node_timeout,
-                )
-                if resp.get("err"):
-                    raise NoSuchBlock(resp["err"])
-                compressed = DataBlockHeader.unpack(resp["hdr"]).compressed
-                if meta_out is not None:
-                    meta_out["parity"] = bool(resp.get("parity"))
-                    meta_out["compressed"] = compressed
-                    # wire frames as received: valid for storage as long
-                    # as no failover stitched two replicas' (possibly
-                    # differently-encoded) streams together
-                    meta_out["raw_chunks"] = [] if delivered == 0 else None
-                decomp = None
-                if compressed:
-                    from ..utils.zstd_compat import zstandard
-
-                    decomp = zstandard.ZstdDecompressor().decompressobj()
-                skip = delivered
+            attempt = 0
+            while True:
+                if not rpc.peer_allows(node):
+                    errors.append(f"{bytes(node).hex()[:8]}: breaker open")
+                    break
                 try:
-                    if stream is not None:
-                        it = stream.__aiter__()
-                        while True:
-                            try:
-                                chunk = await asyncio.wait_for(
-                                    it.__anext__(), node_timeout)
-                            except StopAsyncIteration:
-                                break
-                            if (meta_out is not None
-                                    and meta_out.get("raw_chunks") is not None):
-                                meta_out["raw_chunks"].append(bytes(chunk))
-                            out = decomp.decompress(chunk) if decomp else chunk
-                            if not out:
-                                continue
-                            if skip:
-                                if len(out) <= skip:
-                                    skip -= len(out)
+                    # the transport timeout covers only time-to-response-
+                    # header; the same (adaptive) budget is reused below
+                    # as a PER-CHUNK inactivity deadline, because a peer
+                    # that blackholes mid-stream keeps the connection
+                    # "up" while bytes stop — without a chunk deadline
+                    # the read hangs forever and the per-replica failover
+                    # never runs
+                    node_timeout = rpc.timeout_for(node,
+                                                   self.block_rpc_timeout)
+                    resp, stream = await self.endpoint.call_streaming(
+                        node,
+                        {"t": "get_block", "h": bytes(h), "order": order_tag},
+                        prio=PRIO_NORMAL,
+                        timeout=node_timeout,
+                    )
+                    if resp.get("err"):
+                        raise NoSuchBlock(resp["err"])
+                    compressed = DataBlockHeader.unpack(
+                        resp["hdr"]).compressed
+                    if meta_out is not None:
+                        meta_out["parity"] = bool(resp.get("parity"))
+                        meta_out["compressed"] = compressed
+                        # wire frames as received: valid for storage as
+                        # long as no failover stitched two replicas'
+                        # (possibly differently-encoded) streams together
+                        meta_out["raw_chunks"] = \
+                            [] if delivered == 0 else None
+                    decomp = None
+                    if compressed:
+                        from ..utils.zstd_compat import zstandard
+
+                        decomp = zstandard.ZstdDecompressor().decompressobj()
+                    skip = delivered
+                    try:
+                        if stream is not None:
+                            it = stream.__aiter__()
+                            while True:
+                                try:
+                                    chunk = await asyncio.wait_for(
+                                        it.__anext__(), node_timeout)
+                                except StopAsyncIteration:
+                                    break
+                                if (meta_out is not None
+                                        and meta_out.get("raw_chunks")
+                                        is not None):
+                                    meta_out["raw_chunks"].append(
+                                        bytes(chunk))
+                                out = (decomp.decompress(chunk)
+                                       if decomp else chunk)
+                                if not out:
                                     continue
-                                out = out[skip:]
-                                skip = 0
-                            delivered += len(out)
-                            self.bytes_read += len(out)
-                            yield out
-                finally:
-                    # abandoning mid-stream (consumer closed this generator,
-                    # node failover, decompress error) must cancel the
-                    # sender's pump, or it parks in its credit window until
-                    # the connection dies; no-op after full consumption
-                    if stream is not None:
-                        await stream.aclose()
-                rpc.note_result(node, None)
-                return
-            except (asyncio.CancelledError, GeneratorExit):
-                # consumer went away mid-fetch (client disconnect, task
-                # cancel): release the breaker's half-open probe slot if
-                # peer_allows granted it — no verdict on the peer, and a
-                # leaked slot would fast-fail the peer for a full cooldown
-                rpc.note_result(node, asyncio.CancelledError())
-                raise
-            except Exception as e:
-                # ANY per-replica failure fails over to the next replica —
-                # a malformed header (version skew) or a corrupt zstd
-                # frame from one node must not mask a healthy copy one
-                # hop away (ref manager.rs:231-317 tries each in turn)
-                rpc.note_result(node, e)
-                errors.append(f"{bytes(node).hex()[:8]}: {e}")
-                if meta_out is not None and delivered > 0:
-                    meta_out["raw_chunks"] = None  # stitched: frames mixed
+                                if skip:
+                                    if len(out) <= skip:
+                                        skip -= len(out)
+                                        continue
+                                    out = out[skip:]
+                                    skip = 0
+                                delivered += len(out)
+                                self.bytes_read += len(out)
+                                yield out
+                    finally:
+                        # abandoning mid-stream (consumer closed this
+                        # generator, node failover, decompress error)
+                        # must cancel the sender's pump, or it parks in
+                        # its credit window until the connection dies;
+                        # no-op after full consumption
+                        if stream is not None:
+                            await stream.aclose()
+                    rpc.note_result(node, None)
+                    return
+                except (asyncio.CancelledError, GeneratorExit):
+                    # consumer went away mid-fetch (client disconnect,
+                    # task cancel): release the breaker's half-open probe
+                    # slot if peer_allows granted it — no verdict on the
+                    # peer, and a leaked slot would fast-fail the peer
+                    # for a full cooldown
+                    rpc.note_result(node, asyncio.CancelledError())
+                    raise
+                except Exception as e:
+                    # ANY per-replica failure fails over to the next
+                    # replica — a malformed header (version skew) or a
+                    # corrupt zstd frame from one node must not mask a
+                    # healthy copy one hop away (ref manager.rs:231-317
+                    # tries each in turn)
+                    rpc.note_result(node, e)
+                    errors.append(f"{bytes(node).hex()[:8]}: {e}")
+                    if meta_out is not None and delivered > 0:
+                        meta_out["raw_chunks"] = None  # stitched frames
+                    if attempts_left > 0 and is_transport_error(e):
+                        attempts_left -= 1
+                        if rpc.m_retries is not None:
+                            from ..utils.error import error_code
+
+                            rpc.m_retries.inc(endpoint=self.endpoint.path,
+                                              reason=error_code(e))
+                        await asyncio.sleep(full_jitter_backoff(
+                            attempt, rpc.tunables, rpc._rng))
+                        attempt += 1
+                        continue
+                    break
         # LAST RESORT, only from a clean start (stitching decoded bytes
         # after a partial replica stream would need offset bookkeeping
         # for no real case): every replica failed — decode the block
@@ -616,15 +874,33 @@ class BlockManager:
         # (the reference's only answer here is "another replica",
         # ref manager.rs:231-317; erasure coverage is this framework's
         # addition)
-        if delivered == 0 and self.parity_reconstructor is not None:
-            try:
-                data = await self.parity_reconstructor(h)
-            except Exception as e:  # noqa: BLE001 — degraded decode
-                errors.append(f"parity-decode: {e}")
-                data = None
+        if delivered == 0:
+            data = None
+            if self.parity_reconstructor is not None:
+                try:
+                    data = await self.parity_reconstructor(h)
+                except Exception as e:  # noqa: BLE001 — degraded decode
+                    errors.append(f"parity-decode: {e}")
+                    data = None
+                if data is not None:
+                    logger.info("served block %s via distributed RS decode "
+                                "(all replicas failed)", bytes(h).hex()[:16])
+            if data is None and self.parity_store is not None:
+                # final rung of the degraded-read ladder: the LOCAL RS
+                # parity sidecar.  Reachable when the local copy EIO'd
+                # (read failover quarantined it) and every replica is
+                # down — the sidecar decode needs only surviving local
+                # codeword members, zero network.
+                try:
+                    data = await asyncio.to_thread(
+                        self.parity_store.try_reconstruct, h)
+                except Exception as e:  # noqa: BLE001 — degraded decode
+                    errors.append(f"local-sidecar: {e}")
+                    data = None
+                if data is not None:
+                    logger.info("served block %s via LOCAL RS sidecar "
+                                "(all replicas failed)", bytes(h).hex()[:16])
             if data is not None:
-                logger.info("served block %s via distributed RS decode "
-                            "(all replicas failed)", bytes(h).hex()[:16])
                 self.blocks_reconstructed += 1
                 if meta_out is not None:
                     meta_out["parity"] = False
@@ -665,10 +941,16 @@ class BlockManager:
         """Do we need a copy of this block? (ring-assigned + rc>0 but no
         local file; the assignment check keeps rc holders outside the
         data ring — possible when data_replication_mode differs — from
-        accumulating copies)"""
+        accumulating copies).  A read-only/failed primary root answers
+        False: soliciting a block offer the subsequent put would reject
+        with StorageFull only wastes the offerer's bandwidth.  A root
+        whose breaker cooldown has elapsed (half-open) answers True —
+        the solicited push doubles as the probe write that walks the
+        root back to ok."""
         return (self.rc.get(h).is_needed()
                 and not self.is_block_present(h)
-                and self.is_assigned(h))
+                and self.is_assigned(h)
+                and self.health.writable(self.data_layout.primary_dir(h)))
 
     async def sweep_get_block(self, h: Hash,
                               try_ring: bool = True) -> Optional[bytes]:
@@ -771,7 +1053,7 @@ class BlockManager:
                 found = self.find_block(h)
                 if found is None:
                     break
-                await asyncio.to_thread(os.remove, found[0])
+                await asyncio.to_thread(self.disk.remove, found[0])
             # also drop the Deletable{at_time} rc row: nothing would
             # ever clear it for a departed block (clear_deleted_block_rc
             # only fires from delete_if_unneeded after the timer), and a
@@ -825,18 +1107,6 @@ class BlockManager:
 
     def rc_len(self) -> int:
         return self.rc.rc_len()
-
-
-def _read_file(path: str) -> bytes:
-    with open(path, "rb") as f:
-        return f.read()
-
-
-def _move_corrupted(path: str) -> None:
-    try:
-        os.replace(path, path + ".corrupted")
-    except OSError:
-        pass
 
 
 async def _chunks(data: bytes) -> AsyncIterator[bytes]:

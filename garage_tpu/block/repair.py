@@ -324,7 +324,8 @@ class ScrubWorker(Worker):
         if batch is None:
             return None
         reads = await asyncio.gather(
-            *[asyncio.to_thread(_try_read, path) for _h, path, _c in batch]
+            *[asyncio.to_thread(_try_read, self.manager, path)
+              for _h, path, _c in batch]
         )
         return batch, list(reads), it.position
 
@@ -339,10 +340,17 @@ class ScrubWorker(Worker):
         plain_idx, plain_blocks, plain_hashes = [], [], []
         if reads is None:
             reads = await asyncio.gather(
-                *[asyncio.to_thread(_try_read, path) for _h, path, _c in batch]
+                *[asyncio.to_thread(_try_read, mgr, path)
+                  for _h, path, _c in batch]
             )
         for i, ((h, path, compressed), raw) in enumerate(zip(batch, reads)):
             if raw is None:
+                continue
+            if raw is _READ_ERROR:
+                # unreadable on media: the copy is as lost as a content
+                # mismatch — quarantine it and let the sidecar/resync
+                # ladder re-materialize a clean one
+                await self._quarantine(h, path)
                 continue
             if compressed:
                 # decompress so the codec verifies the CONTENT hash (a
@@ -465,7 +473,10 @@ class ScrubWorker(Worker):
         self.state.corruptions += 1
         self.manager.corruptions += 1
         logger.error("scrub: corrupted block %s at %s", bytes(h).hex()[:16], path)
-        await asyncio.to_thread(_move_aside, path)
+        # manager.quarantine_path: counted (block_quarantine_total), and
+        # a failing rename deletes the bad copy instead of silently
+        # leaving it servable (the old _move_aside swallowed OSError)
+        await asyncio.to_thread(self.manager.quarantine_path, path)
         # first line of defense: rebuild locally from the RS parity
         # sidecar — with every replica down this is the ONLY repair;
         # network resync stays as the fallback
@@ -627,20 +638,51 @@ class RebalanceWorker(Worker):
             primary = mgr.block_path(mgr.data_layout.primary_dir(h), h, compressed)
             if os.path.abspath(path) == os.path.abspath(primary):
                 continue
-            await asyncio.to_thread(_move_into_place, path, primary)
+            await asyncio.to_thread(_move_into_place, mgr, path, primary)
             self.moved += 1
         self.status().progress = f"{self.iterator.progress() * 100:.1f}%"
         return WorkerState.BUSY
 
 
-def _try_read(path: str) -> Optional[bytes]:
-    """Scrub read: O_DIRECT (buffered fallback inside) — the buffered
-    path is kernel-CPU-bound on 1-core hosts (reads would steal the
-    core from the verify codec) and scrubbing through the page cache
-    evicts the GET path's working set.  See utils/direct_io.py."""
-    from ..utils.direct_io import try_read_direct
+# sentinel distinguishing "file unreadable, disk implicated" from a
+# benign concurrent deletion (None): scrub_batch quarantines the former
+_READ_ERROR = object()
 
-    return try_read_direct(path)
+
+def _try_read(mgr, path: str):
+    """Scrub read through the manager's disk seam (DiskIo.
+    read_file_direct: O_DIRECT with buffered fallback — the buffered
+    path is kernel-CPU-bound on 1-core hosts and scrubbing through the
+    page cache evicts the GET path's working set, see
+    utils/direct_io.py).  Returns the bytes; None for a vanished file
+    (deleted concurrently) or a transient resource error (EMFILE-class
+    — skip this pass, the copy is fine); ``_READ_ERROR`` for a media
+    error, after feeding the root's health accounting so a scrub
+    churning through an EIO-ing disk shows up in disk_error_total and
+    the root's breaker instead of staying silently 'ok'.
+
+    A SUCCESSFUL read reports note_ok: the streak is *consecutive*
+    errors, and on an archival node with no client GETs the scrub is
+    the only reader — without the reset, isolated bad sectors spread
+    over weeks of passes would accumulate into a streak and flip a
+    fundamentally healthy root read-only."""
+    from .health import is_media_error
+
+    try:
+        raw = mgr.disk.read_file_direct(path)
+    except FileNotFoundError:
+        return None
+    except OSError as e:
+        if not is_media_error(e):
+            logger.warning("scrub: transient read error on %s "
+                           "(errno %s: %s)", path, e.errno, e)
+            return None
+        logger.error("scrub: read of %s failed (errno %s: %s)",
+                     path, e.errno, e)
+        mgr.health.note_error(mgr._root_of(path), "scrub", e)
+        return _READ_ERROR
+    mgr.health.note_ok(mgr._root_of(path), "scrub")
+    return raw
 
 
 def _try_decompress(raw: bytes) -> Optional[bytes]:
@@ -652,16 +694,19 @@ def _try_decompress(raw: bytes) -> Optional[bytes]:
         return None
 
 
-def _move_aside(path: str) -> None:
+def _move_into_place(mgr, src: str, dst: str) -> None:
+    """Rebalance move through the manager's disk seam so FaultyDisk can
+    inject into it and a media error feeds the destination root's
+    health accounting before surfacing to the worker error handler."""
+    from .health import is_media_error
+
     try:
-        os.replace(path, path + ".corrupted")
-    except OSError:
-        pass
-
-
-def _move_into_place(src: str, dst: str) -> None:
-    os.makedirs(os.path.dirname(dst), exist_ok=True)
-    if os.path.exists(dst):
-        os.remove(src)
-    else:
-        os.replace(src, dst)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.exists(dst):
+            mgr.disk.remove(src)
+        else:
+            mgr.disk.replace(src, dst)
+    except OSError as e:
+        if is_media_error(e):
+            mgr.health.note_error(mgr._root_of(dst), "rebalance", e)
+        raise

@@ -137,9 +137,11 @@ class BlockResyncManager:
     def put_to_resync(self, h: Hash, delay_secs: float,
                       source: str = "other") -> None:
         """`source` labels the originating path (incref, corrupt_read,
-        degraded_read, serve_miss, scrub_corrupt, layout_sweep, …) for
-        the enqueue-attribution counter; internal requeues/backoffs use
-        put_to_resync_at directly and are deliberately not counted."""
+        degraded_read, serve_miss, scrub_corrupt, layout_sweep,
+        disk_error = read-path EIO failover, janitor = boot-time
+        quarantine requeue, …) for the enqueue-attribution counter;
+        internal requeues/backoffs use put_to_resync_at directly and are
+        deliberately not counted."""
         self.enqueue_counts[source] = self.enqueue_counts.get(source, 0) + 1
         if self.m_enqueue is not None:
             self.m_enqueue.inc(source=source)
@@ -312,7 +314,13 @@ class BlockResyncManager:
                     mgr.note_heal("local_sidecar")
                     return
             try:
-                block = await mgr.rpc_get_raw_block(h, for_storage=True)
+                # a pure refetch is idempotent: a bounded retry budget
+                # (shared across the replica fan-out) on transport
+                # errors, like the need_block probe above (satellite:
+                # read-path disk_error entries land here and must not
+                # give up on one connection reset)
+                block = await mgr.rpc_get_raw_block(h, for_storage=True,
+                                                    idempotent=True)
             except Exception:
                 # Replicas unreachable or damaged.  Next: the
                 # migration-aware peer sweep — after an abrupt layout

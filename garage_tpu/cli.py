@@ -376,7 +376,7 @@ async def _amain(args) -> None:
                 print(json.dumps(st, indent=2))
                 return
             print(f"==== Node: {st['node_id'][:16]}… — peer health ====")
-            rows = ["PEER\tADDR\tUP\tRTT\tFAILS\tRECONN\tTX\tRX\tBG TX%"]
+            rows = ["PEER\tADDR\tUP\tDISK\tRTT\tFAILS\tRECONN\tTX\tRX\tBG TX%"]
             for p in st["peers"]:
                 tr = p.get("traffic") or {}
                 tx = sum(v["tx_bytes"] for v in tr.values())
@@ -387,6 +387,7 @@ async def _amain(args) -> None:
                     f"{p['id'][:16]}…",
                     p["addr"] or "-",
                     "up" if p["up"] else "DOWN",
+                    p.get("disk_state") or "-",
                     f"{rtt}ms" if rtt is not None else "-",
                     str(p["consecutive_failures"]),
                     str(p["reconnects"]),
@@ -395,6 +396,23 @@ async def _amain(args) -> None:
                     f"{100.0 * bg / tx:.0f}%" if tx else "-",
                 ]))
             print(format_table(rows))
+            disk = st.get("disk")
+            if disk:
+                print(f"\n==== Local disk health: {disk['state']} "
+                      f"(quarantined {disk['quarantined']}, "
+                      f"quarantine errors {disk['quarantine_errors']}) ====")
+                drows = ["ROOT\tSTATE\tFREE"]
+                for r in disk["roots"]:
+                    free = r["free_bytes"]
+                    drows.append("\t".join([
+                        r["path"], r["state"],
+                        _fmt_bytes(free) if free is not None else "?",
+                    ]))
+                print(format_table(drows))
+                if disk["error_counts"]:
+                    print("disk errors: " + ", ".join(
+                        f"{k}={v}" for k, v in
+                        sorted(disk["error_counts"].items())))
         return
 
     if args.command == "layout":

@@ -119,6 +119,11 @@ class Garage:
             ),
         )
         self.block_manager.resync = self.block_resync
+        # crash-consistency pass over the data dirs, AFTER resync is
+        # attached (quarantined hashes re-enqueue through it): purge
+        # orphaned .tmp files from torn writes, bound the .corrupted
+        # quarantine (docs/ROBUSTNESS.md "Disk faults & degraded mode")
+        self.block_manager.startup_janitor()
         if config.codec.store_parity and config.codec.rs_data > 0:
             from ..block.parity import ParityStore, WriteParityAccumulator
 

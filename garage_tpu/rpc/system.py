@@ -65,6 +65,10 @@ class NodeStatus:
     data_total: Optional[int] = None
     meta_avail: Optional[int] = None
     meta_total: Optional[int] = None
+    # worst per-root disk health ("ok"/"degraded"/"failed"; None = peer
+    # predates the field): peers learn a node went read-only from gossip,
+    # not from their next rejected rpc_put_block (block/health.py)
+    disk_state: Optional[str] = None
 
     def pack(self):
         return dataclasses.asdict(self)
@@ -74,7 +78,7 @@ class NodeStatus:
         return cls(**{k: d.get(k) for k in (
             "hostname", "replication_factor", "layout_version",
             "layout_staging_hash", "data_avail", "data_total",
-            "meta_avail", "meta_total",
+            "meta_avail", "meta_total", "disk_state",
         )})
 
 
@@ -192,6 +196,11 @@ class System:
             for nid, addr in saved.peers:
                 self.peering.add_peer(addr, FixedBytes32(nid))
 
+        # set by BlockManager: () -> "ok"|"degraded"|"failed" (worst
+        # data-root health, gossiped in NodeStatus so peers' `cluster
+        # stats` show a remote node going read-only)
+        self.disk_state_fn: Optional[Callable[[], str]] = None
+
         self.node_status: Dict[FixedBytes32, NodeStatus] = {}
         self._discovery = None  # external (consul/k8s) backends, built lazily
         self._tasks: List[asyncio.Task] = []
@@ -250,6 +259,11 @@ class System:
         st.meta_total = disk.get("meta_total")
         st.data_avail = disk.get("data_avail")
         st.data_total = disk.get("data_total")
+        if self.disk_state_fn is not None:
+            try:
+                st.disk_state = self.disk_state_fn()
+            except Exception:  # noqa: BLE001 — gossip must never break
+                logger.exception("disk_state_fn failed")
         return st
 
     def _disk_stats(self) -> dict:

@@ -39,12 +39,15 @@ the pair by the helpers.
 from __future__ import annotations
 
 import asyncio
+import errno
 import logging
 import os
 import random
 import time
+from types import SimpleNamespace
 from typing import Dict, List, Optional, Tuple
 
+from ..block.health import DiskIo
 from ..net.latency_proxy import LatencyProxy
 from ..utils.data import Hash
 
@@ -117,6 +120,146 @@ class FaultyLink(LatencyProxy):
         return data
 
 
+class SimulatedCrash(RuntimeError):
+    """The process 'died' mid-write: NOT an OSError, so the write path
+    neither converts it to a typed StorageError nor feeds the disk
+    breaker — exactly like a real kill, the call just never returns.
+    The on-disk state at raise time (torn tmp, unrenamed tmp) is what
+    the startup janitor must clean up."""
+
+
+class FaultyDisk(DiskIo):
+    """Storage faults at BlockManager's filesystem boundary.  Wraps a
+    manager's ``DiskIo`` (``mgr.disk = FaultyDisk(mgr.disk)``) so faults
+    inject at exactly the seam the real kernel would error through — no
+    os.* monkeypatching, per-node scoping for free.  All knobs are plain
+    attributes read per-op, so tests flip them while traffic flows:
+
+      - ``read_errno`` / ``write_errno`` (+ ``*_error_prob``): EIO on
+        read, ENOSPC/EIO on write
+      - ``fsync_errno``: the write lands, durability doesn't
+      - ``crash_stage`` ∈ {tmp, rename, fsync}: SimulatedCrash at that
+        write stage, leaving the torn on-disk state a real kill would
+        (``torn_fraction`` of the tmp bytes for stage "tmp")
+      - ``bitrot_prob``: silent single-byte corruption on read (the
+        verify/scrub path must catch it by content hash)
+      - ``latency``: per-op sleep (a dying disk is slow before it is
+        dead); applied in the worker thread, never on the event loop
+      - ``statvfs_free``: synthetic free-bytes override — drives the
+        watermark state machine without actually filling a filesystem
+
+    ``path_prefix`` scopes every fault to one data root (multi-root
+    nodes degrade per root, not per node)."""
+
+    def __init__(self, inner: Optional[DiskIo] = None,
+                 rng: Optional[random.Random] = None,
+                 path_prefix: Optional[str] = None):
+        self.inner = inner or DiskIo()
+        self._rng = rng or random.Random()
+        self.path_prefix = path_prefix
+        self.clear()
+
+    def clear(self) -> None:
+        """Back to a clean pass-through disk."""
+        self.read_errno: Optional[int] = None
+        self.read_error_prob = 1.0
+        self.write_errno: Optional[int] = None
+        self.write_error_prob = 1.0
+        self.fsync_errno: Optional[int] = None
+        self.bitrot_prob = 0.0
+        self.latency = 0.0
+        self.crash_stage: Optional[str] = None
+        self.torn_fraction = 0.5
+        self.statvfs_free: Optional[int] = None
+        self.injected = {"read": 0, "write": 0, "fsync": 0,
+                         "bitrot": 0, "crash": 0}
+
+    def _applies(self, path: str) -> bool:
+        return self.path_prefix is None or path.startswith(self.path_prefix)
+
+    def _err(self, kind: str, eno: int, path: str) -> OSError:
+        self.injected[kind] += 1
+        return OSError(eno, os.strerror(eno), path)
+
+    def read_file(self, path: str) -> bytes:
+        return self._faulted_read(path, self.inner.read_file)
+
+    def read_file_direct(self, path: str) -> bytes:
+        # the scrub worker's O_DIRECT flavor: same fault surface as
+        # read_file — a dying disk errors scrubs and GETs alike
+        return self._faulted_read(path, self.inner.read_file_direct)
+
+    def _faulted_read(self, path: str, read) -> bytes:
+        if self._applies(path):
+            if self.latency:
+                time.sleep(self.latency)
+            if (self.read_errno is not None
+                    and self._rng.random() < self.read_error_prob):
+                raise self._err("read", self.read_errno, path)
+        data = read(path)
+        if (self._applies(path) and data
+                and self._rng.random() < self.bitrot_prob):
+            i = self._rng.randrange(len(data))
+            data = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+            self.injected["bitrot"] += 1
+        return data
+
+    def write_file(self, path: str, data: bytes, fsync: bool = False) -> None:
+        if self._applies(path):
+            if self.latency:
+                time.sleep(self.latency)
+            if self.crash_stage == "tmp":
+                # torn write: a prefix reaches the media, then the
+                # "process" dies before finishing — never acknowledged
+                self.injected["crash"] += 1
+                with open(path, "wb") as f:
+                    f.write(data[:int(len(data) * self.torn_fraction)])
+                raise SimulatedCrash(f"kill mid tmp-write of {path}")
+            if (self.write_errno is not None
+                    and self._rng.random() < self.write_error_prob):
+                raise self._err("write", self.write_errno, path)
+            if fsync and self.fsync_errno is not None:
+                # the data write succeeded; only durability failed —
+                # the kernel reports that exactly once, at fsync
+                self.inner.write_file(path, data, fsync=False)
+                raise self._err("fsync", self.fsync_errno, path)
+        return self.inner.write_file(path, data, fsync=fsync)
+
+    def replace(self, src: str, dst: str) -> None:
+        if self._applies(dst) and self.crash_stage == "rename":
+            # died between tmp write and rename: a COMPLETE tmp file
+            # orphaned next to a missing final — still unacknowledged
+            self.injected["crash"] += 1
+            raise SimulatedCrash(f"kill before rename {src} -> {dst}")
+        return self.inner.replace(src, dst)
+
+    def remove(self, path: str) -> None:
+        return self.inner.remove(path)
+
+    def fsync_dir(self, path: str) -> None:
+        if self._applies(path):
+            if self.crash_stage == "fsync":
+                # died at the directory fsync: write + rename landed, the
+                # PUT was NOT acked — the surviving block is a harmless
+                # duplicate-to-be, never a loss
+                self.injected["crash"] += 1
+                raise SimulatedCrash(f"kill at dir fsync of {path}")
+            if self.fsync_errno is not None:
+                raise self._err("fsync", self.fsync_errno, path)
+        return self.inner.fsync_dir(path)
+
+    def statvfs(self, path: str):
+        sv = self.inner.statvfs(path)
+        if self._applies(path) and self.statvfs_free is not None:
+            return SimpleNamespace(
+                f_bavail=max(0, int(self.statvfs_free) // sv.f_frsize),
+                f_frsize=sv.f_frsize,
+                f_blocks=sv.f_blocks,
+                f_fsid=getattr(sv, "f_fsid", 0),
+            )
+        return sv
+
+
 class FaultInjector:
     """Faults over a list of in-process Garage nodes."""
 
@@ -126,6 +269,7 @@ class FaultInjector:
             g.config for g in garages]
         self.dead: set = set()
         self.links: Dict[Tuple[int, int], FaultyLink] = {}
+        self.disks: Dict[int, FaultyDisk] = {}
 
     # --- network faults ---
 
@@ -223,6 +367,53 @@ class FaultInjector:
             await link.stop()
         self.links.clear()
 
+    # --- disk faults (docs/ROBUSTNESS.md "Disk faults & degraded mode") ---
+
+    def add_disk_faults(self, i: int, root: Optional[str] = None,
+                        rng: Optional[random.Random] = None) -> FaultyDisk:
+        """Interpose a FaultyDisk on node i's filesystem boundary (all
+        roots, or just `root`).  Idempotent per node; returns the disk
+        so the caller can flip knobs directly.  The health monitor's
+        statvfs closure is late-bound through mgr.disk, so the synthetic
+        free-space override is honored immediately."""
+        fd = self.disks.get(i)
+        if fd is None:
+            mgr = self.garages[i].block_manager
+            fd = FaultyDisk(mgr.disk, rng=rng, path_prefix=root)
+            mgr.disk = fd
+            self.disks[i] = fd
+        return fd
+
+    def flaky_disk(self, i: int, prob: float = 0.5,
+                   eno: int = errno.EIO) -> FaultyDisk:
+        """Probabilistic EIO on node i's reads AND writes — the dying-
+        disk regime the self-healing read path and the error-streak
+        breaker exist for."""
+        fd = self.add_disk_faults(i)
+        fd.read_errno = fd.write_errno = eno
+        fd.read_error_prob = fd.write_error_prob = prob
+        return fd
+
+    def fill_disk(self, i: int, free_bytes: int = 0) -> FaultyDisk:
+        """Synthetic ENOSPC: statvfs on node i reports `free_bytes`
+        free, so the watermark preflight flips its roots read-only
+        (StorageFull) without writing a single real byte."""
+        fd = self.add_disk_faults(i)
+        fd.statvfs_free = free_bytes
+        return fd
+
+    def bitrot_disk(self, i: int, prob: float) -> FaultyDisk:
+        fd = self.add_disk_faults(i)
+        fd.bitrot_prob = prob
+        return fd
+
+    def heal_disk(self, i: int) -> None:
+        """Clear every injected fault on node i's disk (the wrapper
+        stays installed — faults can be re-applied live)."""
+        fd = self.disks.get(i)
+        if fd is not None:
+            fd.clear()
+
     # --- node faults ---
 
     async def crash(self, i: int) -> None:
@@ -298,6 +489,9 @@ class FaultInjector:
         g.system.peering.start()
         self.garages[i] = g
         self.dead.discard(i)
+        # the revived manager owns a fresh DiskIo: drop the stale fault
+        # wrapper (re-install via add_disk_faults to fault the new disk)
+        self.disks.pop(i, None)
         # bounded convergence wait: drive peering ticks (both sides —
         # the live nodes' 15 s loops would otherwise win every race)
         # until every live peer's handshake landed or the budget is out

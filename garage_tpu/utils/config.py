@@ -199,6 +199,22 @@ class Config:
     # docs/DATAPLANE_PROFILE.md — it was 22% of data-plane CPU)
     metadata_fsync: bool = False
     data_fsync: bool = False
+    # --- disk-fault robustness (docs/ROBUSTNESS.md "Disk faults &
+    # degraded mode"): per-data-root ok → degraded(read-only) → failed
+    # state machine in block/health.py ---
+    # free-bytes watermark: a root with less free space preflights every
+    # block write into a typed StorageFull rejection (write quorums
+    # route around the node; reads keep flowing)
+    data_free_space_watermark: int = 128 * 1024 * 1024
+    # consecutive read/write disk errors on one root that flip it
+    # read-only (degraded); 4× this latches "failed"
+    disk_error_threshold: int = 8
+    # cooldown before a degraded root admits one half-open probe write
+    disk_error_cooldown: float = 30.0
+    # startup-janitor quarantine bound: .corrupted files beyond either
+    # budget are purged oldest-first at boot
+    quarantine_max_files: int = 128
+    quarantine_max_bytes: int = 256 * 1024 * 1024
     s3_api_bind_addr: Optional[str] = "0.0.0.0:3900"
     s3_region: str = "garage"
     root_domain: Optional[str] = None
@@ -241,11 +257,26 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         "data_replication_mode", "compression_level",
         "rpc_bind_addr", "rpc_public_addr", "rpc_secret", "bootstrap_peers",
         "db_engine", "metadata_fsync", "data_fsync", "root_domain",
+        "disk_error_threshold", "disk_error_cooldown",
     ):
         if key in raw:
             setattr(cfg, key, raw[key])
     if "block_size" in raw:
         cfg.block_size = parse_capacity(raw["block_size"])
+    # human-friendly capacities for the disk-health knobs ("100M", "1G")
+    for key in ("data_free_space_watermark", "quarantine_max_bytes"):
+        if key in raw:
+            setattr(cfg, key, parse_capacity(raw[key]))
+    if "quarantine_max_files" in raw:
+        v = raw["quarantine_max_files"]
+        # a file COUNT: capacity suffixes ("1K" → 1000) would be
+        # silently misread as counts, so only a plain integer is legal
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            raise ConfigError(
+                "quarantine_max_files must be a non-negative integer")
+        cfg.quarantine_max_files = v
+    if cfg.disk_error_threshold < 1:
+        raise ConfigError("disk_error_threshold must be >= 1")
     cfg.replication_mode = str(cfg.replication_mode)
 
     dd = raw.get("data_dir", "./data")

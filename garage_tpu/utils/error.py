@@ -37,6 +37,21 @@ class PeerUnavailable(RpcError):
     next chance)."""
 
 
+class StorageError(GarageError):
+    """Local disk storage failed or the data root is in error-streak
+    degraded (read-only) mode.  Carries a structured wire code so a
+    write quorum treats the rejection as THIS node's answer (route
+    around it, no retry, no breaker feed) — the peer is alive, its disk
+    is not."""
+
+
+class StorageFull(StorageError):
+    """Write refused by the free-space watermark preflight (or the disk
+    returned ENOSPC): the data root is read-only until space recovers.
+    Typed separately from StorageError so operators can tell 'disk
+    full' from 'disk dying' in one label."""
+
+
 class CorruptData(GarageError):
     """Block content does not match its hash (ref util/error.rs CorruptData)."""
 
@@ -76,7 +91,7 @@ _WIRE_CLASSES = {
     cls.__name__: cls
     for cls in (
         GarageError, RpcError, TimeoutError_, CorruptData, NoSuchBlock,
-        DbError, LayoutError,
+        DbError, LayoutError, StorageError, StorageFull,
     )
 }
 # every timeout flavor emits ONE code, so it must also reconstruct

@@ -61,13 +61,22 @@ class Counter:
 
 class Gauge:
     """Point-in-time value: set directly or observed via a callback at
-    render time (the reference's ValueObserver pattern)."""
+    render time (the reference's ValueObserver pattern).
+
+    `labeled_fn` is the labelled flavor of `fn`: a callback returning
+    an iterable of ({label: value}, sample) pairs, evaluated at render
+    time.  It exists for small FIXED populations whose truth lives in
+    one object (per-data-root disk state): unlike clear-then-set
+    observers it needs no scrape-side refresh hook, so any render() —
+    admin /metrics, a test, the CLI — sees current values."""
 
     def __init__(self, name: str, help: str = "",
-                 fn: Optional[Callable[[], float]] = None):
+                 fn: Optional[Callable[[], float]] = None,
+                 labeled_fn: Optional[Callable[[], object]] = None):
         self.name = name
         self.help = help
         self.fn = fn
+        self.labeled_fn = labeled_fn
         self._vals: Dict[Tuple[Tuple[str, str], ...], float] = {}
 
     def set(self, v: float, **labels) -> None:
@@ -87,6 +96,16 @@ class Gauge:
                 out.append(f"{self.name} {_num(self.fn())}")
             except Exception:  # noqa: BLE001 — observers must never break scrape
                 pass
+        if self.labeled_fn is not None:
+            try:
+                samples = sorted(
+                    (tuple(sorted(labels.items())), v)
+                    for labels, v in self.labeled_fn()
+                )
+            except Exception:  # noqa: BLE001 — observers must never break scrape
+                samples = []
+            for key, v in samples:
+                out.append(f"{self.name}{_fmt_labels(key)} {_num(v)}")
         for key, v in sorted(self._vals.items()):
             out.append(f"{self.name}{_fmt_labels(key)} {_num(v)}")
         return out
@@ -230,14 +249,16 @@ class MetricsRegistry:
         return self._get_or_create(Counter, name, help)
 
     def gauge(self, name: str, help: str = "",
-              fn: Optional[Callable[[], float]] = None) -> Gauge:
+              fn: Optional[Callable[[], float]] = None,
+              labeled_fn: Optional[Callable[[], object]] = None) -> Gauge:
         """A second registration of an existing gauge may not pass a
         DIFFERENT observer callback: the first registration's `fn` used to
         win silently, which turned a double-construction bug (two
         components observing through dead instances) into wrong metrics
         instead of a crash.  Per-instance values must use labelled
         `set()`; re-requesting an existing gauge without an observer
-        stays valid (that is the sharing path)."""
+        stays valid (that is the sharing path).  The same rule covers
+        `labeled_fn` (render-time labelled observers)."""
         m = self._by_name.get(name)
         if m is not None and fn is not None and getattr(m, "fn", None) is not fn:
             raise ValueError(
@@ -246,7 +267,13 @@ class MetricsRegistry:
                    if getattr(m, "fn", None) is not None else "")
                 + "; a second fn= observer would be silently ignored"
             )
-        return self._get_or_create(Gauge, name, help, fn)
+        if (m is not None and labeled_fn is not None
+                and getattr(m, "labeled_fn", None) is not labeled_fn):
+            raise ValueError(
+                f"gauge {name!r} already registered; a second labeled_fn= "
+                f"observer would be silently ignored"
+            )
+        return self._get_or_create(Gauge, name, help, fn, labeled_fn)
 
     def histogram(self, name: str, help: str = "",
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:

@@ -12,15 +12,21 @@ PUT/GET traffic through a sequence of network-fault phases:
   partition   hard two-way partition between the two replicas
   blackhole   one replica accepts and never responds (the case only
               adaptive timeouts catch) — breaker open/recover asserted
+  disk        one replica with a flaky disk (30% EIO reads) AND a full
+              filesystem (ENOSPC watermark): writes route around the
+              typed StorageFull rejections, reads fail over — the
+              degraded root is asserted visible (disk_root_state ≥ 1)
+              during the fault and back to ok after the heal
 
 Every phase must complete with ZERO client-visible errors (quorum 2/3
 survives each single fault); the exit code says so, and a JSON summary
-(per-phase op counts + p50/p99/max latency + breaker states) goes to
-stdout for bench comparisons.  The same rig the pytest chaos suite uses
-(tests/test_net_faults.py), runnable standalone:
+(per-phase op counts + p50/p99/max latency + breaker/disk states) goes
+to stdout for bench comparisons.  The same rig the pytest chaos suites
+use (tests/test_net_faults.py, tests/test_disk_faults.py), runnable
+standalone:
 
     JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/chaos.py [--quick]
-        [--phases latency,partition] [--secs 8]
+        [--phases latency,partition,disk] [--secs 8]
 """
 
 import argparse
@@ -35,7 +41,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-PHASES = ("baseline", "latency", "flaky", "oneway", "partition", "blackhole")
+PHASES = ("baseline", "latency", "flaky", "oneway", "partition",
+          "blackhole", "disk")
 
 
 def _apply(inj, phase):
@@ -49,6 +56,11 @@ def _apply(inj, phase):
         inj.partition(1, 2)
     elif phase == "blackhole":
         inj.blackhole_node(2)
+    elif phase == "disk":
+        # the ISSUE-5 acceptance fault: one node's disk both dying
+        # (probabilistic EIO) and full (statvfs under the watermark)
+        inj.flaky_disk(2, prob=0.3)
+        inj.fill_disk(2)
 
 
 async def run(phases, secs):
@@ -77,6 +89,12 @@ async def run(phases, secs):
                 assert st == 200, f"bucket create: {st}"
                 for phase in phases:
                     _apply(inj, phase)
+                    disk_worst = 0.0
+                    victim_health = garages[2].block_manager.health
+                    if phase == "disk":
+                        # fast-twitch disk breaker so one phase observes
+                        # degrade AND recover (default cooldown is 30 s)
+                        victim_health._tun.breaker_open_secs = 1.0
                     stats = {"puts": 0, "gets": 0, "errors": 0}
                     lats = []
                     acked = {}
@@ -110,6 +128,13 @@ async def run(phases, secs):
                         if i % 5 == 0:
                             for g in garages:
                                 await g.system.peering._tick()
+                        if phase == "disk":
+                            from garage_tpu.block.health import \
+                                DISK_STATE_VALUES
+
+                            disk_worst = max(disk_worst, max(
+                                DISK_STATE_VALUES[s]
+                                for s in victim_health.states().values()))
                     if phase == "blackhole":
                         # the breaker must have opened on the blackholed
                         # peer (fast-fail) — observable, not inferred
@@ -118,6 +143,31 @@ async def run(phases, secs):
                         stats["breaker"] = g0.system.peering.breaker_state(n2)
                         summary["ok"] &= stats["breaker"] in (
                             "open", "half_open")
+                    if phase == "disk":
+                        # the degraded (read-only) root was OBSERVED —
+                        # same truth /metrics disk_root_state renders
+                        stats["disk_state_worst"] = disk_worst
+                        summary["ok"] &= disk_worst >= 1.0
+                        body = garages[2].system.metrics.render()
+                        summary["ok"] &= "disk_root_state" in body
+                        inj.heal_disk(2)
+                        await asyncio.sleep(1.2)  # disk breaker cooldown
+                        state = None
+                        recover = time.monotonic() + 8.0
+                        while time.monotonic() < recover:
+                            # replication pushes admit the half-open
+                            # probe write that closes the disk breaker
+                            st, _b, _h = await s3.req(
+                                "PUT", f"/chaos/heal-{time.monotonic():.3f}",
+                                b"x" * 4096)
+                            if st != 200:
+                                stats["errors"] += 1
+                            state = victim_health.worst_state()
+                            if state == "ok":
+                                break
+                            await asyncio.sleep(0.3)
+                        stats["disk_state_after_heal"] = state
+                        summary["ok"] &= state == "ok"
                     inj.heal_network()
                     await inj.reconnect()
                     if phase == "blackhole":

@@ -150,7 +150,9 @@ async def main() -> None:
             for fam in ("net_peer_tx_bytes_total", "worker_state",
                         "peer_rtt_ewma_seconds", "rpc_request_counter",
                         "peer_breaker_state", "rpc_retry_total",
-                        "rpc_hedge_total"):
+                        "rpc_hedge_total", "disk_root_state",
+                        "disk_free_bytes", "disk_error_total",
+                        "block_quarantine_total"):
                 assert fam in body, f"family {fam} missing on :{port}"
     print("metrics exposition lint ok (3 nodes)")
 

@@ -11,7 +11,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/smoke.py "$@"
 # degraded-mode smoke: one hard partition between the two replicas of an
-# in-process 3-node cluster must stay client-invisible (quorum 2/3)
+# in-process 3-node cluster must stay client-invisible (quorum 2/3), and
+# one flaky-disk + ENOSPC node must go read-only (typed StorageFull) and
+# recover — all with zero client errors
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
-    --phases partition
+    --phases partition,disk
 echo "SMOKE+CHAOS OK"

@@ -36,6 +36,8 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test (run via asyncio.run)")
+    config.addinivalue_line(
+        "markers", "slow: chaos soaks / long drives, excluded from tier-1")
 
 # The axon TPU plugin overrides JAX_PLATFORMS from the environment, so force
 # the platform through the config API as well.

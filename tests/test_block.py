@@ -152,6 +152,38 @@ async def test_corrupt_block_detected_and_requeued(tmp_path):
     await shutdown(systems)
 
 
+async def test_quarantine_resync_reserve_loop(tmp_path):
+    """Corrupt a stored copy on disk (FaultInjector.corrupt_block): the
+    client read still returns correct bytes (failover), the bad copy is
+    quarantined, and after the queued resync runs a later read serves a
+    healed LOCAL copy."""
+    from garage_tpu.testing.faults import FaultInjector
+
+    systems, managers = await make_block_cluster(tmp_path)
+    data = os.urandom(120_000)
+    h = blake2s_sum(data)
+    await managers[0].rpc_put_block(h, data)
+    await asyncio.sleep(0.1)
+    inj = FaultInjector([], configs=[s.config for s in systems])
+    i, m = next((i, m) for i, m in enumerate(managers)
+                if m.is_block_present(h))
+    path, _ = m.find_block(h)
+    assert inj.corrupt_block(i, h)
+    # the client gets correct bytes — the corrupt local copy fails
+    # verify, is quarantined, and the read fails over to a replica
+    assert await m.rpc_get_block(h) == data
+    assert os.path.exists(path + ".corrupted")
+    assert not m.is_block_present(h)
+    assert m.quarantined == 1
+    assert m.resync.enqueue_counts.get("corrupt_read") == 1
+    # drive the queued refetch; a later read serves the healed copy
+    m.db.transaction(lambda tx: m.rc.block_incref(tx, h))
+    await m.resync.resync_block(h)
+    assert m.is_block_present(h)
+    assert (await m.read_block(h)).decompressed() == data
+    await shutdown(systems)
+
+
 async def test_resync_fetches_missing_block(tmp_path):
     systems, managers = await make_block_cluster(tmp_path)
     data = os.urandom(80_000)
