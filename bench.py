@@ -243,6 +243,8 @@ def bench_device_resident(codec):
         "rs_data": codec.params.rs_data,
         "rs_parity": codec.params.rs_parity,
         "device_batch_blocks": codec.device_batch_blocks,
+        "max_device_staging_mib": getattr(
+            codec.params, "max_device_staging_mib", 4096),
     }
     env = dict(os.environ)
     env["BENCH_DEVICE_SPEC"] = json.dumps(spec)
@@ -427,13 +429,34 @@ def _device_phase() -> dict:
         # numbers are already in `out` if a later, bigger rung hits an
         # HBM-exhausted window (which poisons the process — no recovery,
         # so the order IS the fallback mechanism).
+        #
+        # Each rung is CLAMPED to the documented max_device_staging_mib
+        # bound instead of being allowed to trip the exception path
+        # (r05: `fused rung 1024x1024KiB failed (JaxRuntimeError)`):
+        # production holds (hybrid_window + 1) = 2 submissions resident
+        # at once and the fused kernel's peak HBM is ≈3× its data (data
+        # + word-transpose temp + parity), so a rung may claim at most
+        # budget / (2 × 3 × block_bytes) lanes, floored to the Pallas
+        # kernel's 128-lane tile.
+        budget = int(spec.get("max_device_staging_mib", 4096)) << 20
         dbb = params.device_batch_blocks
+        done_rungs = set()
         for n, blk in ((128, BLOCK // 16), (min(dbb, 1024), BLOCK // 4),
                        (dbb, BLOCK)):
+            cap = budget // (6 * blk)
+            n_eff = min(n, max(128, cap - cap % 128))
+            if n_eff != n:
+                print(f"# device fused rung clamped {n} -> {n_eff} lanes "
+                      f"at {blk >> 10}KiB blocks "
+                      f"(max_device_staging_mib={budget >> 20})",
+                      file=sys.stderr)
+            if (n_eff, blk) in done_rungs:
+                continue
+            done_rungs.add((n_eff, blk))
             try:
-                measure_width(n, blk)
+                measure_width(n_eff, blk)
             except Exception as e:
-                print(f"# device fused rung {n}x{blk >> 10}KiB failed "
+                print(f"# device fused rung {n_eff}x{blk >> 10}KiB failed "
                       f"({type(e).__name__}); keeping "
                       f"{out['device_lanes']}-lane result",
                       file=sys.stderr)
@@ -1210,9 +1233,112 @@ def _put_solo_phase_async():
     return _put_phase_async(n=1, repl="none", prefix="put_solo")
 
 
+PUT_BATCHED_ROUNDS = 6        # interleaved A/B rounds per config
+PUT_BATCHED_ROUND_PUTS = 16   # conc8 puts per round
+
+
+async def _put_batched_phase_async() -> dict:
+    """Feeder A/B (ISSUE 6): conc8 1 MiB puts THROUGH the codec feeder
+    (continuous ragged batching of block-id hashing, ops/feeder.py) vs
+    the inline pre-feeder path, same 1-node shape.  The regular put
+    phase's conc8 numbers already ride the feeder (it is on by
+    default); this phase isolates its contribution and proves batches
+    actually formed (dispatch/batch-size stats land in the JSON).
+
+    Both clusters are alive for the whole phase and measurement windows
+    ALTERNATE between them (A/B/A/B..., order flipped each round): this
+    shared-tenancy host drifts ±15% minute to minute — more than the
+    effect under test — and pairing adjacent windows cancels the drift
+    that sequential whole-config runs would absorb as signal."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="garage_tpu_bench_fb_"))
+    out = {}
+    try:
+        clusters = {}
+        for tag, feeder_on in (("put_batched", True), ("put_inline", False)):
+            clusters[tag] = await _mk_cluster(
+                tmp / tag, n=1, repl="none",
+                codec_cfg={"backend": "cpu", "feeder": feeder_on})
+        rng = np.random.default_rng(13)
+        lat = {t: [] for t in clusters}
+        busy = {t: 0.0 for t in clusters}
+        errors = 0
+        async with aiohttp.ClientSession() as session:
+            s3 = {t: _S3(session, c[2], c[3], c[4])
+                  for t, c in clusters.items()}
+            for t in clusters:
+                st, _b, _h = await s3[t].req("PUT", "/fbbkt")
+                assert st == 200, st
+                for w in range(4):  # JIT/caches/db warm on BOTH sides
+                    await s3[t].req(
+                        "PUT", f"/fbbkt/warm{w}",
+                        rng.integers(0, 256, BLOCK,
+                                     dtype=np.uint8).tobytes())
+
+            async def window(tag, rnd):
+                nonlocal errors
+                payloads = [
+                    rng.integers(0, 256, BLOCK, dtype=np.uint8).tobytes()
+                    for _ in range(PUT_BATCHED_ROUND_PUTS)]
+                sem = asyncio.Semaphore(8)
+
+                async def one(i):
+                    nonlocal errors
+                    async with sem:
+                        t0 = time.perf_counter()
+                        st, _b, _h = await s3[tag].req(
+                            "PUT", f"/fbbkt/r{rnd}-o{i:04d}", payloads[i])
+                        lat[tag].append((time.perf_counter() - t0) * 1000.0)
+                        if st != 200:
+                            errors += 1
+
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *[one(i) for i in range(PUT_BATCHED_ROUND_PUTS)])
+                busy[tag] += time.perf_counter() - t0
+
+            for rnd in range(PUT_BATCHED_ROUNDS):
+                order = ("put_batched", "put_inline")
+                if rnd % 2:
+                    order = order[::-1]
+                for tag in order:
+                    await window(tag, rnd)
+        assert errors == 0, f"{errors} client errors in the feeder A/B"
+        for tag in clusters:
+            ls = sorted(lat[tag])
+            out[f"{tag}_conc8_p50_ms"] = round(ls[len(ls) // 2], 2)
+            out[f"{tag}_conc8_p99_ms"] = round(
+                ls[min(len(ls) - 1, int(len(ls) * 0.99))], 2)
+            out[f"{tag}_conc8_puts_per_s"] = round(
+                len(ls) / busy[tag], 1)
+        feeder = clusters["put_batched"][0][0].block_manager.feeder
+        st_ = feeder.stats()
+        out["put_batched_dispatches"] = st_["dispatches"]
+        out["put_batched_mean_batch_blocks"] = round(
+            st_["dispatched_blocks"] / max(1, st_["dispatches"]), 2)
+        out["put_batched_max_depth"] = st_["max_depth_seen"]
+        out["put_batched_dispatch_reasons"] = st_["dispatch_reasons"]
+        assert st_["dispatches"] > 0, "feeder never dispatched"
+        assert clusters["put_inline"][0][0].block_manager.feeder is None, \
+            "feeder=false must disable it"
+        for garages, server, _p, _k, _s in clusters.values():
+            await server.stop()
+            for g in garages:
+                await g.shutdown()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 _PHASES = {
     "--put-phase": _put_phase_async,
     "--put-solo-phase": _put_solo_phase_async,
+    "--put-batched-phase": _put_batched_phase_async,
     "--rs-put-phase": _rs_put_phase_async,
     "--mp-phase": _mp_phase_async,
     "--degraded-phase": _degraded_phase_async,
@@ -1424,6 +1550,62 @@ def bench_repair(batches) -> float:
     return n_cw * 2 * BLOCK / dt / 2**30
 
 
+HEADLINE_REGRESSION_FRAC = 0.8   # fail the run below 80% of best prior
+
+
+def _best_prior_headline() -> tuple:
+    """(best prior `value`, source file) across the committed BENCH_r*.json
+    round captures.  Those are driver snapshots ({n, cmd, rc, tail}) whose
+    final stdout JSON line is embedded in `tail`; a plain bench JSON
+    (top-level `value`) is accepted too."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best, src = 0.0, None
+    for p in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        v = d.get("value")
+        if v is None:
+            for line in reversed(str(d.get("tail", "")).splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        v = json.loads(line).get("value")
+                    except ValueError:
+                        v = None
+                    break
+        if isinstance(v, (int, float)) and float(v) > best:
+            best, src = float(v), os.path.basename(p)
+    return best, src
+
+
+def _headline_guard(out: dict) -> int:
+    """ROADMAP's explicit ask: regression-guard the headline in bench.py.
+    Returns a nonzero exit code (after the JSON is emitted) when `value`
+    drops more than (1 - HEADLINE_REGRESSION_FRAC) below the best prior
+    round, with a message naming both numbers."""
+    best, src = _best_prior_headline()
+    out["headline_best_prior_gibs"] = round(best, 4)
+    out["headline_best_prior_src"] = src
+    value = float(out.get("value") or 0.0)
+    if best > 0.0 and value < HEADLINE_REGRESSION_FRAC * best:
+        print(
+            f"# HEADLINE REGRESSION: value {value:.3f} GiB/s is more than "
+            f"{round((1 - HEADLINE_REGRESSION_FRAC) * 100)}% below the best "
+            f"prior round ({best:.3f} GiB/s in {src}) — failing the run. "
+            f"Attribution: gate={out.get('hybrid_gate')} "
+            f"link={out.get('hybrid_link_gibs')} GiB/s "
+            f"cpu={out.get('cpu_gibs')} GiB/s; see the `attribution` "
+            f"block in the emitted JSON for per-stage timings.",
+            file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main() -> None:
     if "--device-phase" in sys.argv:
         print(json.dumps(_device_phase()), flush=True)
@@ -1502,6 +1684,7 @@ def main() -> None:
     emit()
     out.update(run_phase_subprocess("--put-phase"))
     out.update(run_phase_subprocess("--put-solo-phase"))
+    out.update(run_phase_subprocess("--put-batched-phase"))
     out.update(run_phase_subprocess("--rs-put-phase"))
     emit()
     out.update(run_phase_subprocess("--mp-phase", timeout=MP_TIME_CAP + 180))
@@ -1568,7 +1751,10 @@ def main() -> None:
         except Exception:
             traceback.print_exc()
     attach.stop()
+    rc = _headline_guard(out)  # fields land in the JSON either way
     emit(partial=False)
+    if rc:
+        sys.exit(rc)
 
 
 if __name__ == "__main__":

@@ -662,6 +662,8 @@ class AdminRpcHandler:
         per-stage attribution of the block manager's codec."""
         out = self.garage.block_manager.codec.info()
         out["heals"] = dict(self.garage.block_manager.heal_counts)
+        feeder = self.garage.block_manager.feeder
+        out["feeder"] = feeder.stats() if feeder is not None else None
         resync = self.garage.block_manager.resync
         if resync is not None:
             out["resync_enqueues"] = dict(resync.enqueue_counts)

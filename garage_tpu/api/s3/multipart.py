@@ -34,7 +34,13 @@ from ..common import (
     s3_xml_root,
     xml_to_bytes,
 )
-from .put import Chunker, check_quotas, headers_from_request, read_and_put_blocks
+from .put import (
+    Chunker,
+    check_quotas,
+    headers_from_request,
+    read_and_put_blocks,
+    request_scope,
+)
 
 
 def decode_upload_id(s: str) -> Uuid:
@@ -119,12 +125,13 @@ async def handle_upload_part(ctx) -> web.Response:
 
     md5 = hashlib.md5()
     sha256 = hashlib.sha256()
-    chunker = Chunker(ctx.body_stream(), garage.config.block_size)
-    first = await chunker.next() or b""
     # on error the part is left unfinished; abort/lifecycle reaps it
-    total_size, _fh = await read_and_put_blocks(
-        ctx, version, part_number, first, chunker, md5, sha256
-    )
+    with request_scope(garage):
+        chunker = Chunker(ctx.body_stream(), garage.config.block_size)
+        first = await chunker.next() or b""
+        total_size, _fh = await read_and_put_blocks(
+            ctx, version, part_number, first, chunker, md5, sha256
+        )
     etag = md5.hexdigest()
     content_sha256 = ctx.verified.content_sha256
     if content_sha256 not in (None, "STREAMING") and \

@@ -32,7 +32,7 @@ from ..common import (
     xml_to_bytes,
 )
 from ..signature import AuthError, Credential, signing_key
-from .put import save_stream
+from .put import request_scope, save_stream
 
 FIELD_LIMIT = 16 * 1024          # per-field size (ref post_object.rs:37-41)
 FILE_LIMIT = 5 * 1024**3         # max file part
@@ -208,9 +208,10 @@ async def handle_post_object(server, request: web.Request,
 
     # size violations raise from inside the stream (over-max early,
     # under-min at EOF) so save_stream's cleanup aborts the version
-    etag, _size = await save_stream(
-        ctx, _limited_stream(file_part, lo, hi), headers, key
-    )
+    with request_scope(garage):
+        etag, _size = await save_stream(
+            ctx, _limited_stream(file_part, lo, hi), headers, key
+        )
 
     etag_q = f'"{etag}"'
     redirect = params.get("success_action_redirect")

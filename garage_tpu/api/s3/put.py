@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import binascii
+import contextlib
 import hashlib
 from typing import AsyncIterator, Dict, Optional, Tuple
 
@@ -32,6 +33,20 @@ from ...model.s3.version_table import Version
 from ...utils.crdt import now_msec
 from ...utils.data import Hash, block_hash, gen_uuid
 from ..common import ApiError, BadRequestError
+
+
+def request_scope(garage):
+    """Bracket one client WRITE request for the codec feeder's in-flight
+    count (ops/feeder.py).  Entered at request INTAKE — before quota
+    checks, metadata inserts and body streaming — so that by the time
+    any request's first hash window is submitted, every concurrent
+    writer is already counted and the submit's `peers` hint tells the
+    dispatcher how many submissions it may expect to coalesce.  A no-op
+    context manager when the feeder is disabled or draining."""
+    feeder = getattr(garage.block_manager, "feeder", None)
+    if feeder is None or feeder.closed:
+        return contextlib.nullcontext()
+    return feeder.request_scope()
 
 
 class Chunker:
@@ -202,6 +217,15 @@ async def read_and_put_blocks(
     garage = ctx.garage
     algo = garage.block_manager.hash_algo
     codec = garage.block_manager.codec
+    # continuous-batching feeder (ops/feeder.py): BLAKE2s block-id
+    # hashing SUBMITS here instead of running inline, so K concurrent
+    # puts coalesce into one ragged SIMD/device batch while each
+    # request's md5/sha256 (stream-sequential, unbatchable) advance in
+    # parallel with the feeder wait — the deadline is effectively free
+    # whenever the stream digests take longer than the SLO.
+    feeder = getattr(garage.block_manager, "feeder", None)
+    if feeder is not None and feeder.closed:
+        feeder = None
     offset = 0
     first_hash: Optional[Hash] = None
     put_task: Optional[asyncio.Task] = None
@@ -227,10 +251,13 @@ async def read_and_put_blocks(
         else:
             await garage.block_manager.rpc_put_block(h, data)
 
-    def hash_window(window):
+    def update_stream_digests(window):
         for b in window:
             md5.update(b)
             sha256.update(b)
+
+    def hash_window(window):
+        update_stream_digests(window)
         if len(window) >= 4:
             return codec.batch_hash(window)
         return [block_hash(b, algo) for b in window]
@@ -244,11 +271,25 @@ async def read_and_put_blocks(
                 if nb is None:
                     break
                 window.append(nb)
-            if (offset == 0 and len(window) == 1 and chunker.eof
+            fut = _try_submit(feeder, window)
+            if fut is not None:
+                # feeder path: the block-id hash is already submitted —
+                # run the stream digests OFF the loop and await both.
+                # Keeping md5/sha256 off-loop matters beyond latency:
+                # an inline digest would hold the event loop for ~4 ms
+                # per put, serializing concurrent puts' submissions past
+                # each other's SLO window so no batch ever formed; with
+                # the hop, K in-flight puts all submit within the
+                # deadline and coalesce into one ragged SIMD/device
+                # dispatch.  The feeder wait overlaps the digest work.
+                await asyncio.to_thread(update_stream_digests, window)
+                hashes = list(await asyncio.wrap_future(fut))
+            elif (offset == 0 and len(window) == 1 and chunker.eof
                     and not chunker.buf and len(window[0]) <= (1 << 20)):
-                # truly single-block body (the p50 latency case): hash
-                # inline — nothing follows to overlap with, and ≤1 MiB
-                # bounds the loop stall to less than an executor hop
+                # no feeder, truly single-block body (the p50 latency
+                # case): hash inline — nothing follows to overlap with,
+                # and ≤1 MiB bounds the loop stall to less than an
+                # executor hop
                 hashes = [hash_window(window)[0]]
             else:
                 hashes = await asyncio.to_thread(hash_window, window)
@@ -290,6 +331,25 @@ async def read_and_put_blocks(
     return offset, first_hash if first_hash is not None else Hash(b"\x00" * 32)
 
 
+def _try_submit(feeder, window):
+    """Submit a hash window to the codec feeder with the current
+    in-flight write-request count (request_scope brackets at the
+    handlers) as the `peers` hint; an unbracketed caller reads 0 and
+    passes None = unknown, which the dispatcher treats as "wait out the
+    SLO".  Returns None when the feeder is absent or closing (shutdown
+    race) — the caller hashes inline, exactly the pre-feeder
+    behavior."""
+    if feeder is None:
+        return None
+    from ...ops.feeder import FeederClosed
+
+    try:
+        return feeder.submit_hash(window,
+                                  peers=feeder.inflight_requests or None)
+    except FeederClosed:
+        return None
+
+
 def _hash_block(md5, sha256, block: bytes, algo: str) -> Hash:
     md5.update(block)
     sha256.update(block)
@@ -313,7 +373,8 @@ async def handle_put_object(ctx) -> web.Response:
     content_sha256 = ctx.verified.content_sha256
     if content_sha256 in (None, "STREAMING"):
         content_sha256 = None
-    etag, _size = await save_stream(
-        ctx, ctx.body_stream(), headers, key, content_md5, content_sha256
-    )
+    with request_scope(ctx.garage):
+        etag, _size = await save_stream(
+            ctx, ctx.body_stream(), headers, key, content_md5, content_sha256
+        )
     return web.Response(status=200, headers={"ETag": f'"{etag}"'})

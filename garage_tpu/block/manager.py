@@ -80,6 +80,24 @@ class BlockManager:
         self.hash_algo = config.codec.hash_algo
         self.compression_level = config.compression_level
         self.data_fsync = config.data_fsync
+        # continuous-batching feeder for the FOREGROUND data path: PUT
+        # block-id hashing (api/s3/put.py), write-time RS encodes
+        # (block/parity.py WriteParityAccumulator) and degraded-read RS
+        # decodes (ParityStore / model/parity_repair.py) submit here and
+        # coalesce into ragged codec batches — K concurrent puts pay ~one
+        # batched dispatch instead of K serial codec passes (ops/feeder.py)
+        self.feeder = None
+        if getattr(config.codec, "feeder", True):
+            from ..ops.feeder import CodecFeeder
+
+            self.feeder = CodecFeeder(
+                self.codec,
+                slo_ms=getattr(config.codec, "feeder_slo_ms", 2.0),
+                max_batch_blocks=getattr(
+                    config.codec, "feeder_max_batch_blocks", 256),
+                metrics=getattr(system, "metrics", None),
+                observer=self.codec.obs,
+            )
         # static block-transfer timeout ([rpc].block_rpc_timeout): the
         # ceiling/fallback the adaptive per-peer layer clamps against
         # (used to be the hardcoded BLOCK_RW_TIMEOUT literal everywhere)

@@ -229,9 +229,21 @@ class ParityStore:
         shards = np.stack(pieces)[None, :, :]  # (1, p, maxlen)
         try:
             # rows=[target_i]: a single-block repair pays for ONE decoded
-            # row, not all k (k× GF work saving)
-            data = self.codec.rs_reconstruct(
-                shards, present, rows=[target_i])[0]  # (1, maxlen)
+            # row, not all k (k× GF work saving).  Routed through the
+            # manager's codec feeder when present: concurrent degraded
+            # reads of the same loss pattern share one cached RS
+            # schedule and one ragged dispatch (ops/feeder.py); a
+            # closed/absent feeder decodes inline.  Guarded on identity:
+            # the feeder fronts the MANAGER's codec, and this store may
+            # run a different one (geometry change mid-flight, tests
+            # swapping codecs) — a mismatched (k, m) must decode direct.
+            feeder = getattr(self.manager, "feeder", None)
+            if feeder is not None and feeder.codec is self.codec:
+                data = feeder.decode_or_direct(
+                    shards, present, rows=[target_i])[0]
+            else:
+                data = self.codec.rs_reconstruct(
+                    shards, present, rows=[target_i])[0]  # (1, maxlen)
         except Exception:
             logger.exception("parity reconstruction failed for %s",
                              bytes(h).hex()[:16])
@@ -602,8 +614,16 @@ class WriteParityAccumulator:
                 blocks = [b.decompressed() for _, b in group]
                 # rs_encode_blocks zero-pads the member count to a whole
                 # codeword — exactly the partial-codeword zero-shard
-                # semantics
-                parity = self.codec.rs_encode_blocks(blocks)
+                # semantics.  Via the codec feeder when the manager has
+                # one: concurrent write-time codewords (every in-flight
+                # PUT under parity_on_write) coalesce into one ragged
+                # pointer-gather/device pass instead of one GF call each.
+                feeder = getattr(self.manager, "feeder", None) \
+                    if self.manager is not None else None
+                if feeder is not None and feeder.codec is self.codec:
+                    parity = feeder.encode_or_direct(blocks)
+                else:
+                    parity = self.codec.rs_encode_blocks(blocks)
                 if self.store is not None:
                     self.store.put_codeword(
                         hashes, [len(b) for b in blocks], parity[0])

@@ -397,6 +397,13 @@ class Garage:
         # post-decode heals would fail noisily against the closing RPC
         # layer; their persistent resync entries finish the job later
         self.block_manager.drain_heals()
+        # codec feeder: refuse new submissions and drain accepted ones
+        # (acked foreground work must complete; racing late submitters
+        # fall back to direct codec calls via the *_or_direct helpers)
+        if self.block_manager.feeder is not None:
+            import asyncio
+
+            await asyncio.to_thread(self.block_manager.feeder.shutdown)
         # quorum-write stragglers and cancelled-read losers still talk
         # through the transport: give them a bounded drain BEFORE workers
         # and the netapp go away (System.shutdown drains again, cheaply,

@@ -397,15 +397,27 @@ async def _try_codeword(garage, h: Hash, ent) -> Optional[bytes]:
         return None
 
     # decode with the ENTRY's geometry (it may predate a codec config
-    # change); only the missing row is computed
-    from ..ops.codec import CodecParams
-    from ..ops.cpu_codec import CpuCodec
-
-    codec = CpuCodec(CodecParams(rs_data=k, rs_parity=m))
+    # change); only the missing row is computed.  When the entry's
+    # geometry matches the live codec, the decode rides the manager's
+    # codec feeder — a repair storm's concurrent decodes share one
+    # cached RS schedule and one ragged dispatch (ops/feeder.py); a
+    # geometry mismatch or absent feeder decodes through a throwaway
+    # CPU codec as before.
     shards = np.stack(pieces)[None, :, :]
+    mgr = garage.block_manager
+    feeder = getattr(mgr, "feeder", None)
+    live = feeder.codec.params if feeder is not None else None
     try:
-        row = await asyncio.to_thread(
-            codec.rs_reconstruct, shards, present, [target_i])
+        if (feeder is not None and live.rs_data == k
+                and live.rs_parity == m):
+            row = await feeder.decode_async(shards, present, [target_i])
+        else:
+            from ..ops.codec import CodecParams
+            from ..ops.cpu_codec import CpuCodec
+
+            codec = CpuCodec(CodecParams(rs_data=k, rs_parity=m))
+            row = await asyncio.to_thread(
+                codec.rs_reconstruct, shards, present, [target_i])
     except Exception:
         logger.exception("distributed decode failed for %s",
                          bytes(h).hex()[:16])

@@ -159,6 +159,110 @@ class BlockCodec:
             arr[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
         return self.rs_encode(arr.reshape(-1, k, maxlen))
 
+    # --- ragged batch entry points (the CodecFeeder's dispatch surface) ---
+    #
+    # Each takes MANY independent submissions (variable block counts and
+    # sizes — "ragged" in the Ragged Paged Attention sense, PAPERS.md)
+    # and runs them as ONE fused pass, returning per-submission results.
+    # The base implementations amortize on the CPU backends (one
+    # multi-buffer hash / one pointer-gather encode over the
+    # concatenation, decode schedules shared per survivor pattern);
+    # HybridCodec overrides to route whole batches to the device when
+    # the gate is open.
+
+    def ragged_side(self) -> str:
+        """Which side a ragged batch dispatched NOW would run on —
+        metric/event attribution for the feeder.  Backends that can
+        route (hybrid) override."""
+        return "cpu"
+
+    def hash_ragged(self, groups: Sequence[Sequence[bytes]]
+                    ) -> List[List[Hash]]:
+        """Hash many submissions' blocks in one batch_hash pass:
+        returns per-submission digest lists.  Coalescing across
+        submissions is what engages the 8-way SIMD multi-buffer kernel
+        for single-block foreground requests."""
+        flat: List[bytes] = [b for g in groups for b in g]
+        if not flat:
+            return [[] for _ in groups]
+        digs = self.batch_hash(flat)
+        out: List[List[Hash]] = []
+        i = 0
+        for g in groups:
+            out.append(digs[i:i + len(g)])
+            i += len(g)
+        return out
+
+    def rs_encode_ragged(self, groups: Sequence[Sequence[bytes]]
+                         ) -> List[np.ndarray]:
+        """RS parity for many submissions in ONE pass over the
+        concatenated buffers.  Each group is padded to whole codewords
+        with empty blocks BEFORE concatenation so its parity rows stay
+        self-contained (zero data → zero parity, GF-linear), then the
+        single rs_encode_blocks call amortizes the kernel's per-call
+        setup; per-group rows are split back out and column-trimmed to
+        the group's own longest block.  Per-group result is identical to
+        rs_encode_blocks(group)."""
+        k = self.params.rs_data
+        assert k > 0 and groups
+        padded: List[bytes] = []
+        rows_per: List[int] = []
+        for g in groups:
+            assert g, "empty encode submission"
+            pad = (-len(g)) % k
+            padded.extend(list(g))
+            padded.extend([b""] * pad)
+            rows_per.append((len(g) + pad) // k)
+        parity = self.rs_encode_blocks(padded)
+        out: List[np.ndarray] = []
+        r = 0
+        for g, nr in zip(groups, rows_per):
+            ml = max(len(b) for b in g)
+            out.append(np.ascontiguousarray(parity[r:r + nr, :, :ml]))
+            r += nr
+        return out
+
+    def rs_reconstruct_ragged(self, items: Sequence[tuple]
+                              ) -> List[np.ndarray]:
+        """Many rs_reconstruct submissions, batched per RS SCHEDULE:
+        items are (shards (B, p, S), present, rows|None) tuples;
+        submissions sharing a survivor pattern (present, rows) decode
+        through one matrix application (the schedule-caching idea of
+        "Accelerating XOR-based Erasure Coding", PAPERS.md — a repair
+        storm after a node loss repeats one loss pattern).  Shards are
+        zero-padded to the key group's widest S (zero columns decode to
+        zero columns, GF-linear) and results trimmed back."""
+        out: List[Optional[np.ndarray]] = [None] * len(items)
+        keyed: dict = {}
+        for i, (shards, present, rows) in enumerate(items):
+            key = (tuple(present[: self.params.rs_data]),
+                   tuple(rows) if rows is not None else None,
+                   int(shards.shape[1]))
+            keyed.setdefault(key, []).append(i)
+        for (pres, rows, p), idxs in keyed.items():
+            if len(idxs) == 1:
+                i = idxs[0]
+                shards, present, rws = items[i]
+                out[i] = self.rs_reconstruct(shards, present, rws)
+                continue
+            max_s = max(items[i][0].shape[-1] for i in idxs)
+            total_b = sum(items[i][0].shape[0] for i in idxs)
+            stacked = np.zeros((total_b, p, max_s), dtype=np.uint8)
+            off = 0
+            for i in idxs:
+                sh = items[i][0]
+                stacked[off:off + sh.shape[0], :, : sh.shape[-1]] = sh
+                off += sh.shape[0]
+            dec = self.rs_reconstruct(
+                stacked, list(pres), list(rows) if rows is not None else None)
+            off = 0
+            for i in idxs:
+                sh = items[i][0]
+                out[i] = np.ascontiguousarray(
+                    dec[off:off + sh.shape[0], :, : sh.shape[-1]])
+                off += sh.shape[0]
+        return out  # type: ignore[return-value]
+
     def scrub_encode_batch(self, blocks: Sequence[bytes],
                            hashes: Sequence[Hash],
                            fetch_parity: bool = True):

@@ -63,6 +63,14 @@ class CpuCodec(BlockCodec):
         self._native_ptrs = get_native_gf_matmul_ptrs()
         if params.rs_data > 0:
             self._parity_mat = gf256.rs_parity_matrix(params.rs_data, params.rs_parity)
+        # decode-schedule cache, keyed by survivor pattern: building the
+        # recovery matrix (generator submatrix + GF inversion) costs more
+        # than applying it to a single small decode, and degraded reads /
+        # repair storms repeat one loss pattern for every affected
+        # codeword ("Accelerating XOR-based Erasure Coding": cache the
+        # schedule, re-run the apply).  TpuCodec has carried the same
+        # cache since round 3; the CPU path paid the inversion per call.
+        self._dec_cache: dict = {}
 
     def batch_hash(self, blocks: Sequence[bytes]) -> List[Hash]:
         # Below 4 blocks the 8-lane kernel wastes over half its lanes and
@@ -99,7 +107,13 @@ class CpuCodec(BlockCodec):
     def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int],
                        rows: Optional[Sequence[int]] = None) -> np.ndarray:
         k, m = self.params.rs_data, self.params.rs_parity
-        dec = gf256.rs_decode_matrix(k, m, present)
-        if rows is not None:
-            dec = np.ascontiguousarray(dec[list(rows)])
+        key = (tuple(present[:k]), tuple(rows) if rows is not None else None)
+        dec = self._dec_cache.get(key)
+        if dec is None:
+            dec = gf256.rs_decode_matrix(k, m, present)
+            if rows is not None:
+                dec = np.ascontiguousarray(dec[list(rows)])
+            if len(self._dec_cache) >= 512:  # bounded: loss patterns are few
+                self._dec_cache.clear()
+            self._dec_cache[key] = dec
         return self._apply(dec, np.ascontiguousarray(shards[..., :k, :], dtype=np.uint8))

@@ -762,6 +762,47 @@ class HybridCodec(BlockCodec):
         self._split_merged(merged, groups, ok, parity_np, set_result,
                            "tpu")
 
+    # --- ragged batch routing (the CodecFeeder's foreground path) ---
+
+    def ragged_side(self) -> str:
+        """Route for feeder ragged batches: the device only when it is
+        attached AND the link probe's CACHED verdict clears the gate.
+        The foreground path must never pay a cold 16 MiB probe
+        round-trip — an unprobed or stale link routes to the CPU floor
+        and the next scrub pass's probe re-opens the gate.  An
+        unmetered backend (no probe_link hook, no warm_scrub marker —
+        scripted fakes, local device) is treated as healthy, exactly
+        as _probe_link does; that verdict never enters the cache, so
+        it is re-derived here rather than read from _link_rate."""
+        if self.tpu is None:
+            return "cpu"
+        if (getattr(self.tpu, "probe_link", None) is None
+                and not hasattr(self.tpu, "warm_scrub")):
+            return "tpu"
+        with self._probe_lock:
+            rate, ts, failed = self._link_rate, self._link_ts, \
+                self._link_failed
+        if rate is None:
+            return "cpu"
+        if rate == float("inf"):
+            return "tpu"
+        if failed or time.monotonic() - ts > self._LINK_PROBE_TTL_MAX_S:
+            return "cpu"
+        return ("tpu" if rate >= self.params.hybrid_min_link_gibs
+                else "cpu")
+
+    def _ragged_target(self) -> BlockCodec:
+        return self.tpu if self.ragged_side() == "tpu" else self.cpu
+
+    def hash_ragged(self, groups):
+        return self._ragged_target().hash_ragged(groups)
+
+    def rs_encode_ragged(self, groups):
+        return self._ragged_target().rs_encode_ragged(groups)
+
+    def rs_reconstruct_ragged(self, items):
+        return self._ragged_target().rs_reconstruct_ragged(items)
+
     # --- BlockCodec interface ---
 
     def batch_hash(self, blocks: Sequence[bytes]) -> List[Hash]:

@@ -44,6 +44,8 @@ STAGES = (
     "cpu_span",        # one wide fused CPU call (verify + RS encode)
     "hedge",           # CPU redo of groups the device still held in flight
     "tail_wait",       # grace wait on the device before hedging the tail
+    "feeder_dispatch", # one ragged foreground batch (CodecFeeder) through
+                       # hash_ragged / rs_encode_ragged / rs_reconstruct_ragged
 )
 
 EVENT_RING_SIZE = 256

@@ -260,6 +260,11 @@ class TpuCodec(BlockCodec):
             self._gf_jit = jax.jit(gf_apply)
             self._scrub_jit = jax.jit(scrub_step_kernel, static_argnums=(4,))
 
+    def ragged_side(self) -> str:
+        """Feeder attribution: a bare TpuCodec runs every ragged batch
+        on the device (routing belongs to HybridCodec)."""
+        return "tpu"
+
     # --- hashing ---
     @staticmethod
     def _bucket(n: int, quantum: int = 64) -> int:

@@ -121,6 +121,16 @@ class CodecConfig:
     # Cap (MiB) on the in-flight staging claim above.  Raise it only on
     # hosts with the RAM/HBM headroom for wider windows.
     max_device_staging_mib: int = _CODEC_DEFAULTS.max_device_staging_mib
+    # --- continuous-batching feeder for the FOREGROUND data path
+    # (ops/feeder.py): in-flight PUT block-id hashing, write-time RS
+    # encodes and degraded-read decodes submit individually and are
+    # coalesced into ragged codec batches, dispatched when the batch
+    # fills or the SLO deadline expires.  K concurrent puts then pay
+    # ~one batched codec pass instead of K serial ones; a lone put
+    # waits at most feeder_slo_ms (the solo-latency regression bound).
+    feeder: bool = True
+    feeder_slo_ms: float = 2.0
+    feeder_max_batch_blocks: int = 256
 
     def make(self, compression_level: Optional[int] = 1,
              metrics=None, tracer=None, block_size: Optional[int] = None):
@@ -355,6 +365,10 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         )
     if (cfg.codec.rs_data == 0) != (cfg.codec.rs_parity == 0):
         raise ConfigError("codec.rs_data and codec.rs_parity must both be 0 or both be >0")
+    if cfg.codec.feeder_slo_ms < 0:
+        raise ConfigError("codec.feeder_slo_ms must be >= 0")
+    if cfg.codec.feeder_max_batch_blocks < 1:
+        raise ConfigError("codec.feeder_max_batch_blocks must be >= 1")
 
     # secrets: env overrides > `<key>_file` in TOML > inline value
     for key, env in _SECRET_ENV.items():
