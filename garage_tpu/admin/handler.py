@@ -402,11 +402,19 @@ class AdminRpcHandler:
                 "id": bytes(nid).hex(),
                 "hostname": status.hostname if status else None,
                 "addr": st.addr,
+                # committed-layout failure domain — the grouping key for
+                # the per-zone rollup below
+                "zone": sys.zone_of(nid),
                 "up": st.is_up,
                 # gossiped worst data-root health: a remote node gone
                 # read-only (StorageFull/-Error rejections) is visible
                 # here without waiting for a failed PUT
                 "disk_state": status.disk_state if status else None,
+                "breaker": sys.peering.breaker_state(nid),
+                # handshake-learned build (gossiped as fallback): the
+                # rolling-upgrade skew signal
+                "version": (sys.netapp.peer_versions.get(nid)
+                            or (status.version if status else None)),
                 "connected": conn is not None and not conn._closed,
                 "rtt_ewma_ms": (
                     round(st.latency * 1000.0, 3)
@@ -419,7 +427,34 @@ class AdminRpcHandler:
                     if st.last_seen is not None else None),
                 "traffic": conn.traffic_stats() if conn is not None else None,
             })
-        peers.sort(key=lambda p: (not p["up"], p["id"]))
+        # zone grouping: peers sort by zone so a zone outage reads as one
+        # contiguous block, and the rollup makes it one line
+        peers.sort(key=lambda p: (p["zone"] or "~", not p["up"], p["id"]))
+        disk_rank = {"ok": 0, "degraded": 1, "failed": 2}
+        zones: Dict[str, Dict] = {}
+        for nid_b, role in sys.layout.node_roles().items():
+            if role.capacity is None:
+                continue  # gateways store nothing — not a zone's health
+            from ..utils.data import FixedBytes32
+
+            nid = FixedBytes32(nid_b)
+            z = zones.setdefault(role.zone, {
+                "nodes": 0, "up": 0, "breaker_open": 0,
+                "worst_disk": "ok",
+            })
+            z["nodes"] += 1
+            if nid == sys.id:
+                z["up"] += 1
+                ds = self.garage.block_manager.health.worst_state()
+            else:
+                if sys.peering.is_up(nid):
+                    z["up"] += 1
+                if sys.peering.breaker_state(nid) == "open":
+                    z["breaker_open"] += 1
+                status = sys.node_status.get(nid)
+                ds = status.disk_state if status else None
+            if ds and disk_rank.get(ds, 0) > disk_rank[z["worst_disk"]]:
+                z["worst_disk"] = ds
         # local disk health: the per-root state machine + quarantine
         # counters (block/health.py) — the node-side truth behind the
         # gossiped disk_state peers see above
@@ -445,7 +480,10 @@ class AdminRpcHandler:
         }
         return {
             "node_id": bytes(sys.id).hex(),
+            "zone": sys.our_zone(),
+            "version": sys.version,
             "disk": disk,
+            "zones": zones,
             "peers": peers,
         }
 

@@ -688,6 +688,9 @@ class BlockManager:
                 # detection on this path comes from the breaker's other
                 # feeders (pings, probe-shaped calls)
                 rs_adaptive_timeout=False,
+                # hard zone_redundancy: block copies must land in enough
+                # distinct failure domains before the PUT acks
+                rs_required_zones=self.system.write_zone_requirement(who),
             ),
             make_call=send,
         )

@@ -211,17 +211,44 @@ class BlockResyncManager:
 
     # --- the convergence step (ref resync.rs:361-471) ---
 
-    async def resync_block(self, h: Hash) -> None:
+    async def resync_block(self, h: Hash) -> int:
+        """One convergence step; returns the data-plane bytes it moved
+        (pushed to peers + fetched/reconstructed locally) so callers
+        driving motion deliberately — the layout-rebalance mover — can
+        attribute traffic without a second accounting seam."""
         # per-resync tracing span (ref block/resync.rs:286-303)
         with self.manager.system.tracer.span(
             "Block resync", block=bytes(h).hex()[:16]
         ):
-            await self._resync_block_inner(h)
+            return await self._resync_block_inner(h)
 
-    async def _resync_block_inner(self, h: Hash) -> None:
+    async def rebalance_hash(self, h: Hash) -> int:
+        """Foreground convergence step driven by the rebalance mover:
+        the same logic as a queued resync, sharing the busy-set so a
+        queue worker and the mover never double-process a hash.  A
+        failed move parks the hash on the persistent queue
+        (source="rebalance") instead of raising — the mover keeps
+        walking and the retry inherits resync's backoff machinery."""
+        hb = bytes(h)
+        if hb in self.busy_set:
+            return 0
+        self.busy_set.add(hb)
+        try:
+            moved = await self.resync_block(h)
+        except Exception as e:
+            logger.warning("rebalance move of %s failed: %s",
+                           hb.hex()[:16], e)
+            self.put_to_resync(h, 5.0, source="rebalance")
+            return 0
+        finally:
+            self.busy_set.discard(hb)
+        return moved
+
+    async def _resync_block_inner(self, h: Hash) -> int:
         mgr = self.manager
         rc = mgr.rc.get(h)
         present = mgr.is_block_present(h)
+        moved = 0  # data-plane bytes pushed/fetched by this step
 
         unassigned = not mgr.is_assigned(h)
         migrating = rc.is_zero() and present and unassigned
@@ -274,6 +301,7 @@ class BlockResyncManager:
                         timeout=mgr.block_rpc_timeout,
                         body=_chunks(block.inner),
                     )
+                    moved += len(block.inner)
                 logger.info(
                     "offloaded block %s to %d nodes", bytes(h).hex()[:16], len(needy)
                 )
@@ -312,7 +340,7 @@ class BlockResyncManager:
                     await mgr.write_block(h, DataBlock.plain(data))
                     mgr.blocks_reconstructed += 1
                     mgr.note_heal("local_sidecar")
-                    return
+                    return len(data)
             try:
                 # a pure refetch is idempotent: a bounded retry budget
                 # (shared across the replica fan-out) on transport
@@ -353,10 +381,12 @@ class BlockResyncManager:
                     mgr.note_heal("distributed_decode")
                     logger.info("reconstructed block %s from DISTRIBUTED "
                                 "parity", bytes(h).hex()[:16])
-                return
+                return len(data)
             await mgr.write_block(h, block, is_parity=block.parity)
             mgr.note_heal("resync_fetch")
             logger.info("resynced missing block %s", bytes(h).hex()[:16])
+            moved += len(block.inner)
+        return moved
 
     async def next_due_in(self) -> float:
         first = self.queue.first()

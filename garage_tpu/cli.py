@@ -375,19 +375,28 @@ async def _amain(args) -> None:
             if args.json:
                 print(json.dumps(st, indent=2))
                 return
-            print(f"==== Node: {st['node_id'][:16]}… — peer health ====")
-            rows = ["PEER\tADDR\tUP\tDISK\tRTT\tFAILS\tRECONN\tTX\tRX\tBG TX%"]
+            me = (f" zone={st['zone']}" if st.get("zone") else "") + (
+                f" v{st['version']}" if st.get("version") else "")
+            print(f"==== Node: {st['node_id'][:16]}…{me} — peer health "
+                  f"(grouped by zone) ====")
+            rows = ["ZONE\tPEER\tADDR\tUP\tBRK\tDISK\tVER\tRTT\tFAILS"
+                    "\tRECONN\tTX\tRX\tBG TX%"]
             for p in st["peers"]:
                 tr = p.get("traffic") or {}
                 tx = sum(v["tx_bytes"] for v in tr.values())
                 rx = sum(v["rx_bytes"] for v in tr.values())
                 bg = tr.get("background", {}).get("tx_bytes", 0)
                 rtt = p["rtt_ewma_ms"]
+                brk = p.get("breaker")
                 rows.append("\t".join([
+                    p.get("zone") or "-",
                     f"{p['id'][:16]}…",
                     p["addr"] or "-",
                     "up" if p["up"] else "DOWN",
+                    {"closed": "-", "half_open": "half",
+                     "open": "OPEN"}.get(brk, brk or "-"),
                     p.get("disk_state") or "-",
+                    p.get("version") or "-",
                     f"{rtt}ms" if rtt is not None else "-",
                     str(p["consecutive_failures"]),
                     str(p["reconnects"]),
@@ -396,6 +405,17 @@ async def _amain(args) -> None:
                     f"{100.0 * bg / tx:.0f}%" if tx else "-",
                 ]))
             print(format_table(rows))
+            zones = st.get("zones") or {}
+            if zones:
+                # one line per failure domain: a zone outage is legible
+                # at a glance (nodes down + breakers open + sick disks
+                # all concentrate on one row)
+                print("\n==== Zones ====")
+                for zname in sorted(zones):
+                    z = zones[zname]
+                    print(f"  {zname}: {z['up']}/{z['nodes']} up, "
+                          f"worst disk {z['worst_disk']}, "
+                          f"{z['breaker_open']} breaker(s) open")
             disk = st.get("disk")
             if disk:
                 print(f"\n==== Local disk health: {disk['state']} "

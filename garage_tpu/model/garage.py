@@ -342,6 +342,38 @@ class Garage:
         marker = self._sweep_persister.load()
         if self.system.ring.digest() != (marker.digest if marker else b""):
             _spawn_sweep()
+        # Layout-change rebalance mover: the foreground, rate-bounded,
+        # observable companion to the sweep above — walks ONLY the
+        # partitions whose replica set changed (diffed here against the
+        # previous ring) and drives their blocks through the resync
+        # convergence step directly, reporting rebalance_partitions_*
+        # progress.  The sweep remains the completeness backstop (it
+        # also covers changes missed while down, via the marker).
+        from ..block.rebalance import RebalanceMover
+        from ..rpc.layout import N_PARTITIONS
+
+        self.rebalance_mover = RebalanceMover(
+            self.block_manager, self.block_resync,
+            rate_mib_s=self.config.rebalance_rate_mib,
+            metrics=self.system.metrics,
+        )
+        self.bg.spawn(self.rebalance_mover)
+
+        def _part_sets(ring):
+            return [frozenset(bytes(n) for n in ring.partition_nodes(p))
+                    for p in range(N_PARTITIONS)]
+
+        self._prev_partitions = _part_sets(self.system.ring)
+
+        def _feed_mover(ring):
+            new = _part_sets(ring)
+            changed = [p for p in range(N_PARTITIONS)
+                       if new[p] != self._prev_partitions[p]]
+            self._prev_partitions = new
+            if changed:
+                self.rebalance_mover.enqueue(changed)
+
+        self.system.on_ring_change(_feed_mover)
         self.bg_vars.register_rw(
             "resync-tranquility",
             lambda: self.block_resync.tranquility,

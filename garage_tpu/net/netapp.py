@@ -82,7 +82,13 @@ NodeID = FixedBytes32
 
 _NULL_CTX = nullcontext()
 
-MAGIC = b"GTPU/1\n"
+# Protocol v2: a length-prefixed version frame follows the auth proof
+# (NetApp.version exchange).  The magic is BUMPED with the wire change
+# so a v1 peer fails the handshake cleanly ("bad protocol magic")
+# instead of desyncing on the frame it does not expect — version skew
+# WITHIN v2 (the rolling-upgrade drill) is what the frame itself
+# carries.
+MAGIC = b"GTPU/2\n"
 _OUT_QUEUE_LIMIT = 16       # frames buffered per priority level
 _IN_STREAM_LIMIT = 128      # legacy bound (loopback streams only)
 STREAM_WINDOW = 64          # flow-control window per stream (64 × 16 KiB = 1 MiB)
@@ -776,10 +782,16 @@ class Connection:
 class NetApp:
     """The node's RPC stack: listener, dialer, endpoint registry, conn map."""
 
-    def __init__(self, privkey: Ed25519PrivateKey, secret: Optional[str] = None):
+    def __init__(self, privkey: Ed25519PrivateKey, secret: Optional[str] = None,
+                 version: Optional[str] = None):
         self.privkey = privkey
         self.id: NodeID = node_id_of(privkey)
         self.secret = (secret or "").encode()
+        # advertised in the post-auth handshake frame; peers record it as
+        # Connection.remote_version / NetApp.peer_versions (the rolling-
+        # upgrade drill's transport-level skew signal)
+        self.version = version or ""
+        self.peer_versions: Dict[NodeID, str] = {}
         self.endpoints: Dict[str, Endpoint] = {}
         self.conns: Dict[NodeID, Connection] = {}
         self.on_connected: Optional[Callable[[NodeID, bool], None]] = None
@@ -825,6 +837,25 @@ class NetApp:
             self.endpoints[path] = ep
         return ep
 
+    def forget_peer_series(self, node: NodeID) -> None:
+        """Drop the per-peer traffic counter series of a peer removed
+        from the committed layout (System calls this alongside
+        FullMeshPeering.forget_peer): a removed node's tx/rx totals
+        would otherwise scrape forever as frozen lines.  The live
+        connection's durable-label latch resets too, so goodbye traffic
+        (the node learning the layout that removed it, its final block
+        offloads) aggregates under peer="transient" instead of
+        re-minting the dropped series."""
+        self.peer_versions.pop(node, None)
+        conn = self.conns.get(node)
+        if conn is not None:
+            conn._peer_durable = False
+        if self._net_metrics is None:
+            return
+        lbl = bytes(node).hex()[:16]
+        for key in ("tx_bytes", "tx_frames", "rx_bytes", "rx_frames"):
+            self._net_metrics[key].drop_label("peer", lbl)
+
     # --- handshake ---
 
     def _transcript_mac(self, transcript: bytes, label: bytes) -> bytes:
@@ -861,7 +892,22 @@ class NetApp:
         Ed25519PublicKey.from_public_bytes(their_pub).verify(
             their_sig, transcript + their_label
         )
-        return NodeID(their_pub)
+        # post-auth version advertisement: one length-prefixed frame each
+        # way, so a mixed-version cluster (rolling upgrade in flight)
+        # knows exactly which build sits on the other end of every
+        # connection.  Exchanged AFTER authentication so an unauthorized
+        # dialer learns nothing.
+        vb = self.version.encode()[:255]
+        writer.write(bytes([len(vb)]) + vb)
+        await writer.drain()
+        vlen = (await asyncio.wait_for(reader.readexactly(1), 10.0))[0]
+        their_version = (
+            await asyncio.wait_for(reader.readexactly(vlen), 10.0)
+            if vlen else b""
+        ).decode("utf-8", "replace")
+        nid = NodeID(their_pub)
+        self.peer_versions[nid] = their_version
+        return nid
 
     # --- connection management ---
 
@@ -891,6 +937,13 @@ class NetApp:
         cur = self.conns.get(conn.remote_id)
         if cur is conn:
             del self.conns[conn.remote_id]
+            # bound peer_versions to live + durable peers: throwaway CLI
+            # connections would otherwise grow the map forever (same
+            # rationale as the 'transient' metric label); cluster peers
+            # re-advertise on reconnect and stay visible via gossip
+            fn = self.peer_durable_fn
+            if fn is not None and not fn(conn.remote_id):
+                self.peer_versions.pop(conn.remote_id, None)
             if self.on_disconnected:
                 self.on_disconnected(conn.remote_id)
 

@@ -131,6 +131,20 @@ class FullMeshPeering:
         st = self.peers.get(node)
         return st.latency if st else None
 
+    def forget_peer(self, node: NodeID) -> None:
+        """Drop a peer removed from the committed layout: peer-book
+        entry (so the scrape-time gauge refresh stops emitting its
+        series), breaker state (a re-added node must not inherit stale
+        failure history), and the event-time counter series.  The live
+        connection, if any, is left to die naturally — the peer may
+        still be draining its own goodbye traffic."""
+        self.peers.pop(node, None)
+        self.breakers.pop(node, None)
+        if self._m is not None:
+            lbl = self._label(node)
+            self._m["reconnect"].drop_label("peer", lbl)
+            self._m["ping_fail"].drop_label("peer", lbl)
+
     # --- circuit breaker surface (consulted by RpcHelper) ---
 
     def breaker(self, node: NodeID) -> CircuitBreaker:

@@ -120,6 +120,21 @@ class ClusterLayout(Migrated):
                 out[bytes(k)] = role
         return out
 
+    def zone_map(self) -> Dict[bytes, str]:
+        """node id → zone for every node in the COMMITTED layout (the
+        routing/quorum layers' source of topology truth)."""
+        return {nid: r.zone for nid, r in self.node_roles().items()}
+
+    def hard_zone_redundancy(self) -> Optional[int]:
+        """The integer zone_redundancy when the layout DEMANDS zone
+        spread from write quorums; None for "maximum", which asks
+        placement for the widest spread but keeps writes
+        availability-first when a whole zone is dark."""
+        zr = self.parameters.zone_redundancy
+        if isinstance(zr, int):
+            return min(zr, self.replication_factor)
+        return None
+
     def staged_roles(self) -> Dict[bytes, Optional[NodeRole]]:
         return {
             bytes(k): NodeRole.unpack(e.value)

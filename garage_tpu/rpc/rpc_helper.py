@@ -41,7 +41,13 @@ from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from ..utils.data import FixedBytes32
-from ..utils.error import PeerUnavailable, QuorumError, RpcError, error_code
+from ..utils.error import (
+    PeerUnavailable,
+    QuorumError,
+    RpcError,
+    ZoneQuorumError,
+    error_code,
+)
 from ..net.frame import PRIO_NORMAL
 from ..net.netapp import Endpoint, NetApp
 from ..net.peering import FullMeshPeering
@@ -71,6 +77,11 @@ class RequestStrategy:
     rs_adaptive_timeout: bool = True    # per-peer base + k·rtt clamp
     rs_hedge: bool = True               # speculative next-candidate on slow wave
     rs_hedge_delay: Optional[float] = None  # None → latency-quantile derived
+    # writes only: the acked replica set must span at least this many
+    # distinct zones (0/1 = availability-first, no topology check).
+    # Callers resolve it via System.write_zone_requirement so it is
+    # never larger than the candidate set can actually span.
+    rs_required_zones: int = 0
 
 
 class _RetryBudget:
@@ -101,6 +112,12 @@ class RpcHelper:
         self._drain_tasks: set = set()
         self._rng = random.Random()
         self.tracer = tracer
+        # topology source (set by System once the layout is known): zone
+        # of a peer / of this node, from the COMMITTED layout.  Defaults
+        # answer None, which keeps every zone feature inert — bare
+        # RpcHelper uses (tests, CLI clients) behave exactly as before.
+        self.zone_of: Callable[[NodeID], Optional[str]] = lambda _n: None
+        self.local_zone: Callable[[], Optional[str]] = lambda: None
         # per-RPC counters + latency histogram (ref rpc/metrics.rs:38)
         if metrics is not None:
             self.m_requests = metrics.counter(
@@ -120,10 +137,27 @@ class RpcHelper:
             self.m_adaptive = metrics.histogram(
                 "rpc_adaptive_timeout_seconds",
                 "Adaptive per-peer timeout chosen for outgoing RPCs")
+            self.m_zone_requorum = metrics.counter(
+                "rpc_zone_requorum_total",
+                "Quorum writes that waited past their numeric quorum "
+                "for acks to span the required zones")
+            self.m_zone_errors = metrics.counter(
+                "rpc_zone_quorum_error_total",
+                "Quorum writes failed because the acked replica set "
+                "never spanned the required zones (ZoneQuorumError)")
         else:
             self.m_requests = self.m_errors = None
             self.m_timeouts = self.m_duration = None
             self.m_retries = self.m_hedges = self.m_adaptive = None
+            self.m_zone_requorum = self.m_zone_errors = None
+
+    def set_zone_source(self, zone_of: Callable[[NodeID], Optional[str]],
+                        local_zone: Callable[[], Optional[str]]) -> None:
+        """Thread the committed layout's topology in (System calls this
+        once at construction; the callables read live state, so a layout
+        change needs no re-wiring)."""
+        self.zone_of = zone_of
+        self.local_zone = local_zone
 
     def _instrument(self, endpoint_path: str, coro_fn):
         """Wrap one RPC attempt with counters + duration (the reference's
@@ -265,20 +299,33 @@ class RpcHelper:
     # --- ordering (ref rpc_helper.rs:392-435) ---
 
     def request_order(self, nodes: Sequence[NodeID]) -> List[NodeID]:
-        """Self first, then ascending ping latency, unknown-latency next,
-        open-breaker peers last (they fast-fail, but a candidate that
-        will not answer should never latency-order into the first quorum
-        wave)."""
+        """Self first, then LOCAL-ZONE peers, then cross-zone peers —
+        each zone band ordered by ascending ping latency with
+        unknown-latency peers after measured ones — and open-breaker
+        peers last (they fast-fail, but a candidate that will not answer
+        should never order into the first quorum wave).
+
+        The zone band implements the survivor-selection policy from the
+        degraded-read literature (PAPERS.md "Boosting the Performance of
+        Degraded Reads"): serve from the nearest/healthiest survivors —
+        here, the local failure domain — and only cross a zone boundary
+        when the local copies are dark (breaker-open/timeout walks the
+        ordered list into the next zone).  Peers with no known zone (no
+        committed layout, gateway-only tests) rank with the local band,
+        which reproduces the pre-zone ordering exactly."""
+        lz = self.local_zone()
 
         def key(n: NodeID):
             if n == self.our_id:
-                return (0, 0.0)
+                return (0, 0, 0.0)
             if self.peering.breaker_state(n) == "open":
-                return (3, 0.0)
+                return (4, 0, 0.0)
+            nz = self.zone_of(n)
+            zband = 1 if (lz is None or nz is None or nz == lz) else 2
             lat = self.peering.latency(n)
             if lat is None:
-                return (2, 0.0)
-            return (1, lat)
+                return (zband, 1, 0.0)
+            return (zband, 0, lat)
 
         return sorted(nodes, key=key)
 
@@ -391,7 +438,10 @@ class RpcHelper:
                 return await self._quorum_read(
                     nodes, call_node, quorum,
                     self._hedge_delay(endpoint.path, strategy), endpoint.path)
-            return await self._quorum_write(nodes, call_node, quorum)
+            return await self._quorum_write(
+                nodes, call_node, quorum,
+                required_zones=strategy.rs_required_zones,
+                endpoint_path=endpoint.path)
 
     async def _quorum_read(self, nodes, call_node, quorum,
                            hedge_delay=None, endpoint_path="") -> List[Any]:
@@ -460,22 +510,57 @@ class RpcHelper:
                     fut.cancel()
                 self._spawn_drain(list(in_flight.keys()))
 
-    async def _quorum_write(self, nodes, call_node, quorum) -> List[Any]:
+    async def _quorum_write(self, nodes, call_node, quorum,
+                            required_zones: int = 0,
+                            endpoint_path: str = "") -> List[Any]:
         futs = {asyncio.ensure_future(call_node(n)): n for n in nodes}
         pending = set(futs.keys())
         successes: List[Any] = []
         errors: List[Any] = []
-        while pending and len(successes) < quorum:
+        ok_nodes: List[NodeID] = []
+
+        def acked_zones() -> set:
+            return {z for z in (self.zone_of(n) for n in ok_nodes)
+                    if z is not None}
+
+        def zones_ok() -> bool:
+            # 0/1 required zones: any ack spans them (and a candidate
+            # set with UNKNOWN zones can never prove a violation)
+            return required_zones <= 1 or len(acked_zones()) >= required_zones
+
+        requorumed = False
+        while pending and (len(successes) < quorum or not zones_ok()):
+            if len(successes) >= quorum and not requorumed:
+                # numeric quorum is in but the acked copies sit in too
+                # few failure domains: keep waiting on the stragglers
+                # instead of acking a write that would not survive the
+                # zones it landed in
+                requorumed = True
+                if self.m_zone_requorum is not None:
+                    self.m_zone_requorum.inc(endpoint=endpoint_path)
             done, pending = await asyncio.wait(
                 pending, return_when=asyncio.FIRST_COMPLETED
             )
             for fut in done:
                 try:
                     successes.append(fut.result())
+                    ok_nodes.append(futs[fut])
                 except Exception as e:
                     errors.append(e)
         if len(successes) < quorum:
             raise QuorumError(quorum, len(successes), errors)
+        if not zones_ok():
+            # every candidate has answered; the acks never left
+            # len(acked_zones()) zones — a whole zone is dark and the
+            # layout demands more (hard integer zone_redundancy)
+            if self.m_zone_errors is not None:
+                self.m_zone_errors.inc(endpoint=endpoint_path)
+            zs = ", ".join(sorted(acked_zones())) or "none"
+            raise ZoneQuorumError(
+                f"write acked by {len(successes)} nodes but only "
+                f"{len(acked_zones())} zone(s) [{zs}]; layout requires "
+                f"{required_zones} — a failure domain is unreachable"
+            )
         if pending:
             # drain stragglers in the background (ref rpc_helper.rs:348-382)
             self._spawn_drain(pending)

@@ -69,6 +69,11 @@ class NodeStatus:
     # predates the field): peers learn a node went read-only from gossip,
     # not from their next rejected rpc_put_block (block/health.py)
     disk_state: Optional[str] = None
+    # software version the node runs (also exchanged in the transport
+    # handshake): the rolling-upgrade drill's skew signal — `cluster
+    # stats` shows which zones still run the old build.  None = peer
+    # predates the field
+    version: Optional[str] = None
 
     def pack(self):
         return dataclasses.asdict(self)
@@ -78,7 +83,7 @@ class NodeStatus:
         return cls(**{k: d.get(k) for k in (
             "hostname", "replication_factor", "layout_version",
             "layout_staging_hash", "data_avail", "data_total",
-            "meta_avail", "meta_total", "disk_state",
+            "meta_avail", "meta_total", "disk_state", "version",
         )})
 
 
@@ -109,7 +114,13 @@ class System:
         self.node_key = load_or_gen_node_key(
             os.path.join(config.metadata_dir, "node_key")
         )
-        self.netapp = NetApp(self.node_key, config.rpc_secret)
+        # the version advertised in the transport handshake and status
+        # gossip; node_version overrides for mixed-version drills
+        from .. import __version__ as _pkg_version
+
+        self.version = getattr(config, "node_version", None) or _pkg_version
+        self.netapp = NetApp(self.node_key, config.rpc_secret,
+                             version=self.version)
         self.id = self.netapp.id
         # per-node metrics registry: every layer records into it and the
         # admin /metrics endpoint renders it (ref util/metrics.rs + the
@@ -187,6 +198,23 @@ class System:
         )
         self.ring = Ring(self.layout)
         self._ring_callbacks: List[Callable[[Ring], None]] = []
+        # committed-layout topology cache, rebuilt with the ring: feeds
+        # zone-aware request ordering and the write-quorum zone check
+        self._zone_map: Dict[bytes, str] = self.layout.zone_map()
+        self.rpc.set_zone_source(self.zone_of, self.our_zone)
+        # info-style join metric: peer → zone per the committed layout
+        # (value always 1), so Grafana can aggregate peer_up /
+        # peer_breaker_state by failure domain.  labeled_fn renders the
+        # LIVE map — members removed from the layout drop out with it.
+        self.metrics.gauge(
+            "peer_zone_info",
+            "Committed-layout zone per cluster member (constant 1; "
+            "join key for per-zone aggregations)",
+            labeled_fn=lambda: [
+                ({"peer": bytes(nid).hex()[:16], "zone": z}, 1.0)
+                for nid, z in self._zone_map.items()
+            ],
+        )
 
         self._peers_persister: Persister = Persister(
             config.metadata_dir, "peer_list", PersistedPeers
@@ -215,12 +243,53 @@ class System:
         self._ring_callbacks.append(cb)
 
     def _rebuild_ring(self):
+        old_members = set(self._zone_map)
         self.ring = Ring(self.layout)
+        self._zone_map = self.layout.zone_map()
+        # peers REMOVED from the committed layout are gone for good:
+        # drop their peer-book entries, breaker state and per-peer
+        # metric series, or `peer_up`/`peer_rtt_ewma_seconds`/
+        # `peer_breaker_state` keep reporting a node that no longer
+        # exists (and its breaker would greet a re-added node with
+        # stale failure history)
+        for nid in old_members - set(self._zone_map):
+            fb = FixedBytes32(nid)
+            if fb == self.id:
+                continue
+            self.peering.forget_peer(fb)
+            self.netapp.forget_peer_series(fb)
+            self.node_status.pop(fb, None)
         for cb in self._ring_callbacks:
             try:
                 cb(self.ring)
             except Exception:
                 logger.exception("ring-change callback failed")
+
+    # --- topology (zone failure domains; docs/ROBUSTNESS.md) ---
+
+    def zone_of(self, node) -> Optional[str]:
+        """Zone of a node per the COMMITTED layout (None when the node
+        carries no role — e.g. a pure gateway client)."""
+        return self._zone_map.get(bytes(node))
+
+    def our_zone(self) -> Optional[str]:
+        return self._zone_map.get(bytes(self.id))
+
+    def write_zone_requirement(self, nodes) -> int:
+        """How many distinct zones a write to `nodes` must span: the
+        layout's HARD (integer) zone_redundancy, capped at the zones the
+        candidate set can actually reach — so the check can always be
+        satisfied when every candidate acks, and a dark zone surfaces as
+        the typed ZoneQuorumError instead of an impossible bar.  0 under
+        "maximum" (availability-first) or when topology is unknown."""
+        hard = self.layout.hard_zone_redundancy()
+        if not hard or hard <= 1:
+            return 0
+        zones = {self._zone_map[bytes(n)] for n in nodes
+                 if bytes(n) in self._zone_map}
+        if not zones:
+            return 0
+        return min(hard, len(zones))
 
     # --- layout operations ---
 
@@ -253,6 +322,7 @@ class System:
             replication_factor=self.replication_mode.replication_factor,
             layout_version=self.layout.version,
             layout_staging_hash=bytes(self.layout.staging_hash()),
+            version=self.version,
         )
         disk = self._disk_stats()
         st.meta_avail = disk.get("meta_avail")

@@ -113,6 +113,9 @@ class Table:
             RequestStrategy(
                 rs_quorum=self.replication.write_quorum(),
                 rs_timeout=TABLE_RPC_TIMEOUT,
+                # hard integer zone_redundancy: the acked set must span
+                # the layout's failure domains (0 = availability-first)
+                rs_required_zones=self.system.write_zone_requirement(who),
             ),
         )
 
@@ -121,10 +124,13 @@ class Table:
         fails if any entry missed its write quorum."""
         per_node: Dict[FixedBytes32, List[bytes]] = {}
         per_node_keys: Dict[FixedBytes32, List[int]] = {}
+        candidates: List[List[FixedBytes32]] = []
         for i, entry in enumerate(entries):
             h = hash_partition_key(entry.partition_key)
             e_enc = entry.encode()
-            for n in self.replication.write_nodes(h):
+            who = self.replication.write_nodes(h)
+            candidates.append(who)
+            for n in who:
                 per_node.setdefault(n, []).append(e_enc)
                 per_node_keys.setdefault(n, []).append(i)
 
@@ -139,15 +145,40 @@ class Table:
             *[send(n, b) for n, b in per_node.items()], return_exceptions=True
         )
         ok_count = [0] * len(entries)
+        ok_zones = [set() for _ in entries]
         for (node, _), res in zip(per_node.items(), results):
             if not isinstance(res, Exception):
+                z = self.system.zone_of(node)
                 for i in per_node_keys[node]:
                     ok_count[i] += 1
+                    if z is not None:
+                        ok_zones[i].add(z)
         quorum = self.replication.write_quorum()
         failed = sum(1 for c in ok_count if c < quorum)
         if failed:
             raise GarageError(
                 f"insert_many: {failed}/{len(entries)} entries below write quorum"
+            )
+        # all sends are already in (gather, no early return), so the
+        # per-entry zone check costs nothing extra: an entry that met
+        # its numeric quorum inside ONE dark-zone-complement still fails
+        # typed when the layout demands spread
+        zone_failed = sum(
+            1 for i in range(len(entries))
+            if (req := self.system.write_zone_requirement(candidates[i])) > 1
+            and len(ok_zones[i]) < req
+        )
+        if zone_failed:
+            from ..utils.error import ZoneQuorumError
+
+            # same observable as the rpc_helper write path: the Grafana
+            # panel / playbook signal must see batched failures too
+            if self.system.rpc.m_zone_errors is not None:
+                self.system.rpc.m_zone_errors.inc(
+                    endpoint=self.endpoint.path)
+            raise ZoneQuorumError(
+                f"insert_many: {zone_failed}/{len(entries)} entries acked "
+                f"in fewer zones than the layout requires"
             )
 
     def _span(self, op: str):

@@ -263,13 +263,34 @@ class FaultyDisk(DiskIo):
 class FaultInjector:
     """Faults over a list of in-process Garage nodes."""
 
-    def __init__(self, garages: List, configs: Optional[List] = None):
+    def __init__(self, garages: List, configs: Optional[List] = None,
+                 zones: Optional[List[str]] = None):
         self.garages = list(garages)
         self.configs = list(configs) if configs else [
             g.config for g in garages]
         self.dead: set = set()
         self.links: Dict[Tuple[int, int], FaultyLink] = {}
         self.disks: Dict[int, FaultyDisk] = {}
+        # node index -> zone (for the zone-grained fault helpers); when
+        # not given, read from the committed layout
+        self._zones = list(zones) if zones else None
+
+    # --- zone topology -------------------------------------------------
+
+    def zone_of_index(self, i: int) -> Optional[str]:
+        if self._zones is not None:
+            return self._zones[i]
+        g = self.garages[i]
+        return g.system.zone_of(g.system.id)
+
+    def nodes_in_zone(self, zone: str) -> List[int]:
+        return [i for i in range(len(self.garages))
+                if self.zone_of_index(i) == zone]
+
+    def _zone_members(self, zone: str) -> set:
+        members = set(self.nodes_in_zone(zone))
+        assert members, f"no nodes in zone {zone!r}"
+        return members
 
     # --- network faults ---
 
@@ -361,6 +382,59 @@ class FaultInjector:
     def heal_network(self) -> None:
         for link in self.links.values():
             link.clear()
+
+    # --- zone-grained faults (zone = the production failure domain;
+    #     docs/ROBUSTNESS.md "Zone failures & rebalance").  Built on the
+    #     FaultyLink primitives above: a zone fault degrades every link
+    #     CROSSING the zone boundary and leaves intra-zone links alone —
+    #     nodes inside a dark zone still see each other, exactly like a
+    #     DC that lost its WAN uplink. ---
+
+    def _boundary_links(self, zone: str):
+        members = self._zone_members(zone)
+        for (a, b), link in self.links.items():
+            if (a in members) != (b in members):
+                yield link
+
+    def partition_zone(self, zone: str) -> None:
+        """Hard-partition a whole zone: every boundary link refuses new
+        connections and kills live ones (both sides fail fast)."""
+        for link in self._boundary_links(zone):
+            link.refuse = True
+            link.kill_connections()
+
+    def blackhole_zone(self, zone: str) -> None:
+        """Every boundary link accepts and delivers nothing — in-flight
+        cross-zone RPCs hang until the adaptive timeout fires (the
+        fault class only breakers + adaptive timeouts catch)."""
+        for link in self._boundary_links(zone):
+            link.blackhole = True
+
+    def slow_zone(self, zone: str, delay: float, jitter: float = 0.0) -> None:
+        """WAN brown-out: one-way `delay` (±jitter) on every boundary
+        link (a remote DC turning distant, not broken)."""
+        for link in self._boundary_links(zone):
+            link.delay, link.jitter = delay, jitter
+
+    def heal_zone(self, zone: str) -> None:
+        """Clear every fault on the zone's boundary links."""
+        for link in self._boundary_links(zone):
+            link.clear()
+
+    async def kill_zone(self, zone: str) -> None:
+        """Abruptly crash every node in the zone (correlated failure —
+        the regime zone_redundancy placement exists for)."""
+        for i in self.nodes_in_zone(zone):
+            if i not in self.dead:
+                await self.crash(i)
+
+    async def revive_zone(self, zone: str, wait_secs: float = 10.0) -> List:
+        """Restart every dead node of the zone from its on-disk state."""
+        out = []
+        for i in self.nodes_in_zone(zone):
+            if i in self.dead:
+                out.append(await self.revive(i, wait_secs=wait_secs))
+        return out
 
     async def stop_network(self) -> None:
         for link in self.links.values():

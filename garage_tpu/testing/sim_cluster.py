@@ -1,0 +1,526 @@
+"""SimCluster — a 20–30 node, 3–5 zone in-process cluster harness.
+
+Scales the 3-node chaos scaffolding (bench._mk_cluster + FaultInjector)
+to cluster-sized drills: per-node config generation (memory db, CPU
+codec, fast-twitch [rpc] tunables), bounded concurrent startup, a
+zone-aware applied layout, one S3 gateway, and optional FaultyLink
+interposition on every directed dial path so whole zones can be
+partitioned/blackholed/slowed/killed live (FaultInjector zone verbs).
+
+The three cluster-scale drills the ISSUE-7 acceptance names live here so
+the pytest suite (tests/test_cluster_scale.py, marked slow+cluster) and
+the standalone reproduction entrypoint (scripts/chaos.py --phases
+zone_blackhole,zone_drain,rolling) run EXACTLY the same code:
+
+  zone_blackhole_drill  one full zone dark under PUT/GET traffic —
+                        reads served local-zone-first from survivors,
+                        zero client-visible errors, boundary breakers
+                        open and recover after heal
+  zone_drain_drill      a layout change drains a whole zone while
+                        clients keep writing — rebalance mover walks the
+                        changed partitions (rebalance_partitions_done ==
+                        total), every acked object bit-identical after
+                        the drained nodes are gone
+  rolling_restart_drill nodes restart one zone at a time with a bumped
+                        version tag (handshake + gossip skew visible)
+                        under live traffic, zero client errors
+
+Invariants throughout are the chaos-soak ones: bit-identical read-back
+of every acked object, deletes stay deleted, zero client-visible errors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .faults import FAST_CHAOS_RPC, FaultInjector
+
+logger = logging.getLogger("garage_tpu.testing.sim_cluster")
+
+DEFAULT_ZONES = ("z1", "z2", "z3", "z4")
+
+
+def _zone_plan(n_nodes: int, n_zones: int) -> List[str]:
+    """Round-robin zone assignment for `n_nodes` storage nodes."""
+    zones = [f"z{i + 1}" for i in range(n_zones)]
+    return [zones[i % n_zones] for i in range(n_nodes)]
+
+
+class SimCluster:
+    """n_storage nodes spread over n_zones, plus one gateway node (index
+    0, capacity None) that fronts the S3 API — so storage zones can be
+    killed/restarted without taking the client's endpoint down."""
+
+    def __init__(self, tmp, n_storage: int = 24, n_zones: int = 4,
+                 repl: str = "3", zone_redundancy="maximum",
+                 db: str = "memory", rpc_cfg: Optional[dict] = None,
+                 rebalance_rate_mib: float = 512.0):
+        self.tmp = Path(tmp)
+        self.n_storage = n_storage
+        self.n_zones = n_zones
+        self.repl = repl
+        self.zone_redundancy = zone_redundancy
+        self.db = db
+        self.rpc_cfg = dict(rpc_cfg if rpc_cfg is not None
+                            else FAST_CHAOS_RPC)
+        self.rebalance_rate_mib = rebalance_rate_mib
+        # index 0 = gateway; storage nodes are 1..n_storage
+        self.zones: List[Optional[str]] = [None] + _zone_plan(
+            n_storage, n_zones)
+        self.garages: List = []
+        self.injector: Optional[FaultInjector] = None
+        self.server = None
+        self.port = self.key_id = self.secret = None
+
+    # --- construction ---------------------------------------------------
+
+    def _node_config(self, i: int) -> dict:
+        return {
+            "metadata_dir": str(self.tmp / f"n{i}" / "meta"),
+            "data_dir": str(self.tmp / f"n{i}" / "data"),
+            "replication_mode": self.repl,
+            "rpc_bind_addr": "127.0.0.1:0",
+            "rpc_secret": "simcluster",
+            "db_engine": self.db,
+            "bootstrap_peers": [],
+            "rebalance_rate_mib": self.rebalance_rate_mib,
+            "codec": {"rs_data": 0, "rs_parity": 0, "backend": "cpu"},
+            "rpc": dict(self.rpc_cfg),
+        }
+
+    async def start(self, faults: bool = True,
+                    startup_timeout: float = 120.0) -> None:
+        from ..api.s3.api_server import S3ApiServer
+        from ..model import Garage
+        from ..rpc.layout import ClusterLayout, LayoutParameters, NodeRole
+        from ..utils.config import config_from_dict
+
+        t0 = time.monotonic()
+        n = self.n_storage + 1
+        self.garages = [
+            Garage(config_from_dict(self._node_config(i))) for i in range(n)
+        ]
+        for g in self.garages:
+            await g.system.netapp.listen("127.0.0.1:0")
+        ports = [g.system.netapp._server.sockets[0].getsockname()[1]
+                 for g in self.garages]
+        for i, g in enumerate(self.garages):
+            g.system.config.rpc_public_addr = f"127.0.0.1:{ports[i]}"
+
+        # full-mesh dial, bounded + concurrent (i<j so each pair dials
+        # once); sequential dialing would dominate startup at 24+ nodes
+        async def dial(i, j):
+            await self.garages[i].system.netapp.connect(
+                f"127.0.0.1:{ports[j]}",
+                expected_id=self.garages[j].system.id)
+
+        pairs = [(i, j) for i in range(n) for j in range(i + 1, n)]
+        for lo in range(0, len(pairs), 64):
+            await asyncio.wait_for(
+                asyncio.gather(*[dial(i, j)
+                                 for i, j in pairs[lo:lo + 64]]),
+                timeout=max(5.0, startup_timeout - (time.monotonic() - t0)))
+
+        # zone-aware layout: gateway (capacity None) + storage roles
+        lay = self.garages[0].system.layout
+        lay.stage_parameters(LayoutParameters(self.zone_redundancy))
+        lay.stage_role(bytes(self.garages[0].system.id),
+                       NodeRole(self.zones[1] or "z1", None, ["gateway"]))
+        for i in range(1, n):
+            lay.stage_role(bytes(self.garages[i].system.id),
+                           NodeRole(self.zones[i], 1000))
+        lay.apply_staged_changes()
+        enc = lay.encode()
+        for g in self.garages:
+            g.system.layout = ClusterLayout.decode(enc)
+            g.system._rebuild_ring()
+            g.system.save_layout()
+            g.spawn_workers()
+
+        # make the peers known to each other's peer books (reconnects,
+        # revives and the fault-link migration all read from them)
+        for i, a in enumerate(self.garages):
+            for j, b in enumerate(self.garages):
+                if i != j:
+                    a.system.peering.add_peer(
+                        f"127.0.0.1:{ports[j]}", b.system.id)
+
+        self.injector = FaultInjector(self.garages, zones=self.zones)
+        # share the injector's list so a revive()'s replacement Garage is
+        # visible here too (drills read movers/metrics through it)
+        self.garages = self.injector.garages
+        if faults:
+            await self.injector.add_network_faults(
+                rng=random.Random(1009))
+            ok = await self.injector.reconnect(rounds=10)
+            if not ok:
+                logger.warning("mesh not fully re-established through "
+                               "fault links within the round budget")
+        else:
+            await self.tick()
+
+        helper = self.garages[0].helper()
+        key = await helper.create_key("sim")
+        key.params().allow_create_bucket.update(True)
+        await self.garages[0].key_table.insert(key)
+        self.server = S3ApiServer(self.garages[0])
+        await self.server.start("127.0.0.1:0")
+        self.port = self.server.port
+        self.key_id = key.key_id
+        self.secret = key.params().secret_key
+        logger.info("SimCluster up: %d nodes / %d zones in %.1fs",
+                    n, self.n_zones, time.monotonic() - t0)
+
+    async def tick(self, rounds: int = 2) -> None:
+        """Drive every live node's peering tick (pings → RTT EWMAs,
+        breaker probes) — SimCluster never starts the 15 s loops, so
+        drills control time themselves."""
+        dead = self.injector.dead if self.injector else set()
+        for _ in range(rounds):
+            await asyncio.gather(*[
+                g.system.peering._tick()
+                for i, g in enumerate(self.garages) if i not in dead
+            ], return_exceptions=True)
+            await asyncio.sleep(0.05)
+
+    async def stop(self) -> None:
+        if self.server is not None:
+            await self.server.stop()
+        if self.injector is not None:
+            await self.injector.stop_network()
+        for i, g in enumerate(self.garages):
+            if self.injector is not None and i in self.injector.dead:
+                continue
+            try:
+                await g.shutdown()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                logger.exception("node %d shutdown failed", i)
+
+    # --- helpers used by the drills ------------------------------------
+
+    def storage_indices(self) -> List[int]:
+        return list(range(1, self.n_storage + 1))
+
+    def zone_names(self) -> List[str]:
+        return [f"z{i + 1}" for i in range(self.n_zones)]
+
+    def metrics_value(self, i: int, needle: str) -> bool:
+        return needle in self.garages[i].system.metrics.render()
+
+    async def apply_layout_change(self, mutate) -> None:
+        """Stage + apply a layout change on the gateway and push it to
+        every node (the CRDT merge path a CLI `layout apply` takes).
+        `mutate(layout)` stages roles/parameters on a decoded copy."""
+        from ..rpc.layout import ClusterLayout
+
+        g0 = self.garages[0]
+        lay = ClusterLayout.decode(g0.system.layout.encode())
+        mutate(lay)
+        lay.apply_staged_changes()
+        await g0.system.update_cluster_layout(lay)
+        # deliver to every live node even if the gossip broadcast raced
+        # a fault: the drills must not depend on broadcast timing
+        enc = lay.encode()
+        dead = self.injector.dead if self.injector else set()
+        for i, g in enumerate(self.garages):
+            if i not in dead:
+                await g.system.update_cluster_layout(
+                    ClusterLayout.decode(enc))
+
+
+class TrafficStats:
+    def __init__(self):
+        self.puts = 0
+        self.gets = 0
+        self.deletes = 0
+        self.errors = 0
+        self.error_notes: List[str] = []
+        self.lats: List[float] = []
+
+    def note_error(self, what: str) -> None:
+        self.errors += 1
+        if len(self.error_notes) < 8:
+            self.error_notes.append(what)
+
+    def summary(self) -> dict:
+        lats = sorted(self.lats)
+        out = {
+            "puts": self.puts, "gets": self.gets, "deletes": self.deletes,
+            "errors": self.errors, "ops": len(lats),
+        }
+        if self.error_notes:
+            out["error_notes"] = list(self.error_notes)
+        if lats:
+            out["p50_ms"] = round(lats[len(lats) // 2] * 1000, 2)
+            out["p99_ms"] = round(
+                lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1000, 2)
+            out["max_ms"] = round(lats[-1] * 1000, 2)
+        return out
+
+
+class TrafficDriver:
+    """Sustained S3 PUT/GET/DELETE load against a SimCluster gateway,
+    verifying the chaos-soak invariants inline: every GET of an acked
+    object must be bit-identical, deleted objects must stay deleted."""
+
+    def __init__(self, cluster: SimCluster, session, bucket: str = "drill",
+                 seed: int = 4242):
+        import bench
+
+        self.cluster = cluster
+        self.s3 = bench._S3(session, cluster.port, cluster.key_id,
+                            cluster.secret)
+        self.bucket = bucket
+        self.rng = random.Random(seed)
+        self.acked: Dict[str, bytes] = {}
+        self.deleted: set = set()
+        self.stats = TrafficStats()
+        self._seq = 0
+
+    async def make_bucket(self) -> None:
+        st, _b, _h = await self.s3.req("PUT", f"/{self.bucket}")
+        assert st == 200, f"bucket create failed: {st}"
+
+    def _body(self) -> bytes:
+        n = self.rng.randrange(4 << 10, 128 << 10)
+        # cheap deterministic filler (numpy-free: the drills run with
+        # dozens of nodes on one core — keep the client light)
+        seed = self.rng.randrange(256)
+        return bytes((seed + i) & 0xFF for i in range(0, n, 7)) * 7
+
+    async def step(self, tag: str = "t") -> None:
+        """One traffic step: PUT a fresh object, GET-verify a random
+        acked one, occasionally DELETE (and verify 404 stays 404)."""
+        self._seq += 1
+        name = f"{tag}-{self._seq:05d}"
+        body = self._body()
+        t0 = time.perf_counter()
+        try:
+            st, _b, _h = await self.s3.req(
+                "PUT", f"/{self.bucket}/{name}", body)
+        except Exception as e:  # noqa: BLE001 — client sees a failure
+            self.stats.note_error(f"PUT {name}: {e!r}")
+            st = 0
+        self.stats.lats.append(time.perf_counter() - t0)
+        if st == 200:
+            self.acked[name] = body
+            self.stats.puts += 1
+        elif st:
+            self.stats.note_error(f"PUT {name}: HTTP {st}")
+        if self.acked:
+            probe = self.rng.choice(sorted(self.acked))
+            t0 = time.perf_counter()
+            try:
+                st, got, _h = await self.s3.req(
+                    "GET", f"/{self.bucket}/{probe}")
+            except Exception as e:  # noqa: BLE001
+                self.stats.note_error(f"GET {probe}: {e!r}")
+                st, got = 0, b""
+            self.stats.lats.append(time.perf_counter() - t0)
+            if st == 200 and got == self.acked[probe]:
+                self.stats.gets += 1
+            elif st:
+                self.stats.note_error(
+                    f"GET {probe}: HTTP {st} "
+                    f"({'bad body' if st == 200 else 'error'})")
+        if self.deleted and self.rng.random() < 0.2:
+            probe = self.rng.choice(sorted(self.deleted))
+            st, _b, _h = await self.s3.req("GET", f"/{self.bucket}/{probe}")
+            if st != 404:
+                self.stats.note_error(
+                    f"GET deleted {probe}: HTTP {st} (expected 404)")
+        if len(self.acked) > 4 and self.rng.random() < 0.1:
+            victim = self.rng.choice(sorted(self.acked))
+            st, _b, _h = await self.s3.req(
+                "DELETE", f"/{self.bucket}/{victim}")
+            if st in (200, 204):
+                del self.acked[victim]
+                self.deleted.add(victim)
+                self.stats.deletes += 1
+            else:
+                self.stats.note_error(f"DELETE {victim}: HTTP {st}")
+
+    async def run_for(self, secs: float, tag: str,
+                      tick_every: int = 5) -> None:
+        deadline = time.monotonic() + secs
+        i = 0
+        while time.monotonic() < deadline:
+            i += 1
+            await self.step(tag)
+            if i % tick_every == 0:
+                await self.cluster.tick(rounds=1)
+
+    async def verify_all(self) -> int:
+        """Read back EVERY acked object; returns mismatches (also
+        counted into stats.errors)."""
+        bad = 0
+        for name, body in sorted(self.acked.items()):
+            st, got, _h = await self.s3.req("GET", f"/{self.bucket}/{name}")
+            if st != 200 or got != body:
+                bad += 1
+                self.stats.note_error(f"verify {name}: HTTP {st}")
+        for name in sorted(self.deleted):
+            st, _b, _h = await self.s3.req("GET", f"/{self.bucket}/{name}")
+            if st != 404:
+                bad += 1
+                self.stats.note_error(
+                    f"verify deleted {name}: HTTP {st} (expected 404)")
+        return bad
+
+
+# --- the three cluster-scale drills -----------------------------------
+
+
+async def zone_blackhole_drill(cluster: SimCluster, traffic: TrafficDriver,
+                               secs: float, zone: str = "z2") -> dict:
+    """One full zone dark: traffic must see ZERO errors (replication
+    spans zones by placement; reads fall back across the boundary), the
+    gateway must order local-zone read candidates first, and the
+    boundary breakers must open during the fault and close after heal +
+    reconnect."""
+    inj = cluster.injector
+    g0 = cluster.garages[0]
+    out: dict = {"zone": zone}
+
+    # zone-aware routing is live on the gateway: for a partition with a
+    # local-zone replica, that replica orders before every cross-zone one
+    lz = g0.system.our_zone()
+    zone_first = checked = 0
+    for p in range(0, 256, 7):
+        nodes = g0.system.ring.partition_nodes(p)
+        order = g0.system.rpc.request_order(nodes)
+        zs = [g0.system.zone_of(nx) for nx in order]
+        if lz in zs:
+            checked += 1
+            if zs[0] == lz:
+                zone_first += 1
+    out["local_zone_first"] = f"{zone_first}/{checked}"
+    assert checked == 0 or zone_first == checked, out
+
+    inj.blackhole_zone(zone)
+    await traffic.run_for(secs, f"bh-{zone}")
+    # the dark zone must be visible in the gateway's breakers: at least
+    # one zone member's breaker left "closed" while the zone was dark
+    dark = [cluster.garages[i].system.id for i in inj.nodes_in_zone(zone)]
+    states = [g0.system.peering.breaker_state(nid) for nid in dark]
+    out["breaker_states_during"] = sorted(set(states))
+    out["breaker_opened"] = any(s != "closed" for s in states)
+
+    inj.heal_zone(zone)
+    await inj.reconnect(rounds=8)
+    open_secs = cluster.rpc_cfg.get("breaker_open_secs", 1.0)
+    await asyncio.sleep(open_secs + 0.2)
+    await traffic.run_for(max(secs / 2, 1.0), f"heal-{zone}")
+    await cluster.tick()
+    states = [g0.system.peering.breaker_state(nid) for nid in dark]
+    out["breaker_states_after"] = sorted(set(states))
+    out.update(traffic.stats.summary())
+    return out
+
+
+async def zone_drain_drill(cluster: SimCluster, traffic: TrafficDriver,
+                           secs: float, zone: str = "z3",
+                           settle_secs: float = 30.0) -> dict:
+    """Drain a whole zone via a layout change while clients keep
+    writing: the remaining zones must absorb the drained partitions
+    (rebalance mover: partitions done == total on every node), and every
+    object acked before OR during the drain must read back bit-identical
+    afterwards — including after the drained nodes are gone dark."""
+    from ..rpc.layout import NodeRole
+
+    inj = cluster.injector
+    drained = inj.nodes_in_zone(zone)
+    out: dict = {"zone": zone, "drained_nodes": len(drained)}
+
+    # seed some pre-drain data
+    await traffic.run_for(max(secs / 2, 1.0), "pre-drain")
+
+    async def change():
+        def mutate(lay):
+            for i in drained:
+                lay.stage_role(
+                    bytes(cluster.garages[i].system.id), None)
+            # zone count shrinks: "maximum" recomputes, an int must
+            # still fit — callers pick a legal zone_redundancy
+        await cluster.apply_layout_change(mutate)
+
+    # drain concurrently with live writes
+    load = asyncio.ensure_future(traffic.run_for(secs, "during-drain"))
+    await change()
+    await load
+
+    # wait until every live node's mover finished its run
+    deadline = time.monotonic() + settle_secs
+    movers = [g.rebalance_mover
+              for i, g in enumerate(cluster.garages) if i not in inj.dead]
+    while time.monotonic() < deadline:
+        busy = [m for m in movers if not m.idle()]
+        if not busy:
+            break
+        await traffic.step("drain-settle")
+        await asyncio.sleep(0.1)
+    out["rebalance"] = [
+        {"done": m.partitions_done, "total": m.partitions_total,
+         "bytes": m.bytes_moved}
+        for m in movers if m.partitions_total
+    ]
+    out["rebalance_complete"] = all(
+        m.idle() and m.partitions_done == m.partitions_total
+        for m in movers)
+    # give the confirm-before-drop offloads a moment to finish their
+    # resync pushes, then take the drained zone completely dark and
+    # verify every acked object still reads bit-identical
+    for _ in range(10):
+        if all(cluster.garages[i].block_resync.queue_len() == 0
+               for i in range(len(cluster.garages)) if i not in inj.dead):
+            break
+        await asyncio.sleep(0.3)
+    out["drained_metric_seen"] = cluster.metrics_value(
+        1, "rebalance_partitions_done")
+    inj.partition_zone(zone)
+    bad = await traffic.verify_all()
+    out["verify_mismatches_zone_dark"] = bad
+    inj.heal_zone(zone)
+    out.update(traffic.stats.summary())
+    return out
+
+
+async def rolling_restart_drill(cluster: SimCluster,
+                                traffic: TrafficDriver, secs: float,
+                                new_version: str = "0.9.1-next") -> dict:
+    """Rolling upgrade: one zone at a time, crash every node of the
+    zone, bump its version tag, revive, wait for the mesh to converge —
+    all under live traffic with zero client-visible errors.  Mid-roll,
+    the gateway must see BOTH versions in its handshake-learned
+    peer_versions (the mixed-version regime the wire format must
+    survive)."""
+    inj = cluster.injector
+    g0 = cluster.garages[0]
+    out: dict = {"zones": [], "mixed_versions_seen": False,
+                 "new_version": new_version}
+    per_zone = max(secs / max(cluster.n_zones, 1), 1.0)
+    for zone in cluster.zone_names():
+        members = inj.nodes_in_zone(zone)
+        load = asyncio.ensure_future(
+            traffic.run_for(per_zone, f"roll-{zone}"))
+        for i in members:
+            inj.configs[i].node_version = new_version
+        await inj.kill_zone(zone)
+        await asyncio.sleep(0.3)
+        await inj.revive_zone(zone, wait_secs=15.0)
+        await load
+        await cluster.tick()
+        vs = {v for v in g0.system.netapp.peer_versions.values() if v}
+        if len(vs) > 1:
+            out["mixed_versions_seen"] = True
+        out["zones"].append({"zone": zone, "restarted": len(members),
+                             "versions_seen": sorted(vs)})
+    bad = await traffic.verify_all()
+    out["verify_mismatches"] = bad
+    out.update(traffic.stats.summary())
+    return out

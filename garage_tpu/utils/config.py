@@ -225,6 +225,12 @@ class Config:
     # budget are purged oldest-first at boot
     quarantine_max_files: int = 128
     quarantine_max_bytes: int = 256 * 1024 * 1024
+    # version tag advertised in the handshake + status gossip (None =
+    # the package version); overridable for mixed-version drills
+    node_version: Optional[str] = None
+    # layout-change rebalance mover: data streamed per second ceiling
+    # (MiB/s) so a zone drain cannot starve foreground traffic
+    rebalance_rate_mib: float = 64.0
     s3_api_bind_addr: Optional[str] = "0.0.0.0:3900"
     s3_region: str = "garage"
     root_domain: Optional[str] = None
@@ -268,6 +274,7 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         "rpc_bind_addr", "rpc_public_addr", "rpc_secret", "bootstrap_peers",
         "db_engine", "metadata_fsync", "data_fsync", "root_domain",
         "disk_error_threshold", "disk_error_cooldown",
+        "node_version", "rebalance_rate_mib",
     ):
         if key in raw:
             setattr(cfg, key, raw[key])
@@ -287,6 +294,8 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         cfg.quarantine_max_files = v
     if cfg.disk_error_threshold < 1:
         raise ConfigError("disk_error_threshold must be >= 1")
+    if cfg.rebalance_rate_mib <= 0:
+        raise ConfigError("rebalance_rate_mib must be > 0")
     cfg.replication_mode = str(cfg.replication_mode)
 
     dd = raw.get("data_dir", "./data")

@@ -28,6 +28,17 @@ class TimeoutError_(RpcError):
     pass
 
 
+class ZoneQuorumError(RpcError):
+    """Write reached its NUMERIC quorum but the acked replica set does
+    not span the number of distinct zones the layout demands (hard
+    integer ``zone_redundancy``) and every remaining candidate has
+    answered or failed — a whole failure domain is dark.  Typed (and
+    wire-coded) so clients and operators can tell "cluster too slow /
+    too many nodes down" (QuorumError) from "a zone is gone and the
+    layout refuses to ack writes that would not survive losing the
+    zones we just wrote to"."""
+
+
 class PeerUnavailable(RpcError):
     """Call refused locally: the peer's circuit breaker is open, so
     dispatching would only burn a timeout.  Raised before any bytes hit
@@ -91,7 +102,7 @@ _WIRE_CLASSES = {
     cls.__name__: cls
     for cls in (
         GarageError, RpcError, TimeoutError_, CorruptData, NoSuchBlock,
-        DbError, LayoutError, StorageError, StorageFull,
+        DbError, LayoutError, StorageError, StorageFull, ZoneQuorumError,
     )
 }
 # every timeout flavor emits ONE code, so it must also reconstruct

@@ -50,6 +50,17 @@ class Counter:
     def get(self, **labels) -> float:
         return self._vals.get(tuple(sorted(labels.items())), 0.0)
 
+    def drop_label(self, name: str, value: str) -> None:
+        """Remove every series carrying label ``name == value``.  Used
+        when a labelled POPULATION member leaves for good (a peer removed
+        from the committed layout): its series would otherwise report a
+        frozen count forever, indistinguishable from a live-but-quiet
+        node."""
+        gone = [k for k in self._vals
+                if any(ln == name and lv == value for ln, lv in k)]
+        for k in gone:
+            del self._vals[k]
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         for key, v in sorted(self._vals.items()):

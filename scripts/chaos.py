@@ -18,15 +18,34 @@ PUT/GET traffic through a sequence of network-fault phases:
               degraded root is asserted visible (disk_root_state ≥ 1)
               during the fault and back to ok after the heal
 
-Every phase must complete with ZERO client-visible errors (quorum 2/3
-survives each single fault); the exit code says so, and a JSON summary
-(per-phase op counts + p50/p99/max latency + breaker/disk states) goes
-to stdout for bench comparisons.  The same rig the pytest chaos suites
-use (tests/test_net_faults.py, tests/test_disk_faults.py), runnable
-standalone:
+Zone-scale phases (ISSUE 7) run on a SEPARATE SimCluster —
+``--nodes N --zones Z`` in-process nodes plus a gateway (default 24/4,
+the acceptance shape; use --nodes 6 --zones 3 for a quick drive) — via
+the shared drill drivers in garage_tpu/testing/sim_cluster.py (the same
+code tests/test_cluster_scale.py asserts on):
+
+  zone_blackhole  one full zone dark: reads served local-zone-first
+                  from survivors, boundary breakers open then recover,
+                  zero client errors
+  zone_drain      layout change drains a zone under live PUT/GET load:
+                  rebalance mover finishes (partitions done == total),
+                  every acked object bit-identical EVEN with the
+                  drained zone subsequently partitioned away
+  rolling         rolling upgrade: restart nodes one zone at a time
+                  with a bumped version tag under live traffic; mixed
+                  versions visible in the handshake-learned peer map
+
+Every phase must complete with ZERO client-visible errors; the exit
+code says so, and a JSON summary (per-phase op counts + p50/p99/max
+latency + breaker/disk/rebalance states) goes to stdout for bench
+comparisons.  The same rig the pytest chaos suites use
+(tests/test_net_faults.py, tests/test_disk_faults.py,
+tests/test_cluster_scale.py), runnable standalone:
 
     JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/chaos.py [--quick]
         [--phases latency,partition,disk] [--secs 8]
+    JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/chaos.py \
+        --phases zone_blackhole,zone_drain,rolling --nodes 24 --zones 4
 """
 
 import argparse
@@ -43,6 +62,10 @@ sys.path.insert(0, REPO)
 
 PHASES = ("baseline", "latency", "flaky", "oneway", "partition",
           "blackhole", "disk")
+# canonical run order: the drain REMOVES a zone from the layout, so it
+# must come last — a rolling zone restart after a drain would take out
+# 2 of 3 replicas on layouts that can no longer spread wider
+ZONE_PHASES = ("zone_blackhole", "rolling", "zone_drain")
 
 
 def _apply(inj, phase):
@@ -207,21 +230,100 @@ async def run(phases, secs):
     return summary
 
 
+async def run_zone(phases, secs, n_storage, n_zones):
+    """The zone-scale drills on one SimCluster (built once, phases run
+    in order — blackhole heals before drain, drain precedes rolling)."""
+    import aiohttp
+
+    from garage_tpu.testing.sim_cluster import (
+        SimCluster,
+        TrafficDriver,
+        rolling_restart_drill,
+        zone_blackhole_drill,
+        zone_drain_drill,
+    )
+
+    summary = {"phases": {}, "ok": True,
+               "cluster": {"storage_nodes": n_storage, "zones": n_zones}}
+    with tempfile.TemporaryDirectory(prefix="garage_zone_chaos_") as tmp:
+        cluster = SimCluster(tmp, n_storage=n_storage, n_zones=n_zones)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                # ZONE_PHASES order is semantic (drain last), not the
+                # user's flag order
+                for phase in [p for p in ZONE_PHASES if p in phases]:
+                    traffic = TrafficDriver(
+                        cluster, session,
+                        bucket="drill-" + phase.replace("_", "-"))
+                    await traffic.make_bucket()
+                    if phase == "zone_blackhole":
+                        st = await zone_blackhole_drill(
+                            cluster, traffic, secs, zone="z2")
+                        summary["ok"] &= bool(st.get("breaker_opened"))
+                        summary["ok"] &= st.get(
+                            "breaker_states_after") == ["closed"]
+                    elif phase == "zone_drain":
+                        st = await zone_drain_drill(
+                            cluster, traffic, secs,
+                            zone=f"z{n_zones}")
+                        summary["ok"] &= bool(st.get("rebalance_complete"))
+                        summary["ok"] &= st.get(
+                            "verify_mismatches_zone_dark") == 0
+                    elif phase == "rolling":
+                        st = await rolling_restart_drill(
+                            cluster, traffic, secs)
+                        summary["ok"] &= bool(st.get("mixed_versions_seen"))
+                        summary["ok"] &= st.get("verify_mismatches") == 0
+                    summary["phases"][phase] = st
+                    summary["ok"] &= st.get("errors") == 0
+                    print(f"phase {phase}: {st}", file=sys.stderr)
+        finally:
+            await cluster.stop()
+    return summary
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    all_phases = PHASES + ZONE_PHASES
     ap.add_argument("--phases", default=",".join(PHASES),
-                    help="comma-separated subset of " + ",".join(PHASES))
+                    help="comma-separated subset of " + ",".join(all_phases))
     ap.add_argument("--secs", type=float, default=8.0,
                     help="traffic seconds per phase")
     ap.add_argument("--quick", action="store_true",
                     help="3 s per phase (smoke mode)")
+    ap.add_argument("--nodes", type=int, default=24,
+                    help="storage nodes for the zone_* phases "
+                         "(plus one gateway)")
+    ap.add_argument("--zones", type=int, default=4,
+                    help="zones for the zone_* phases")
     args = ap.parse_args()
     phases = [p.strip() for p in args.phases.split(",") if p.strip()]
-    bad = [p for p in phases if p not in PHASES]
+    bad = [p for p in phases if p not in all_phases]
     if bad:
         ap.error(f"unknown phases: {bad}")
     secs = 3.0 if args.quick else args.secs
-    summary = asyncio.run(run(phases, secs))
+    node_phases = [p for p in phases if p in PHASES]
+    zone_phases = [p for p in phases if p in ZONE_PHASES]
+    if zone_phases:
+        # the drills name zones z2/z{n} and a rolling restart only stays
+        # client-invisible when every partition keeps ≥2 live zones
+        # (factor-3 placement spreads over min(3, zones)), so fewer than
+        # 3 zones is an argument error, not a mid-drill assertion
+        if args.zones < 3:
+            ap.error("zone phases need --zones >= 3")
+        if args.nodes < args.zones:
+            ap.error("--nodes must be >= --zones (every zone needs a node)")
+    summary = {"phases": {}, "ok": True}
+    if node_phases:
+        s = asyncio.run(run(node_phases, secs))
+        summary["phases"].update(s["phases"])
+        summary["ok"] &= s["ok"]
+    if zone_phases:
+        s = asyncio.run(run_zone(zone_phases, secs, args.nodes, args.zones))
+        summary["phases"].update(s["phases"])
+        summary["cluster"] = s.get("cluster")
+        summary["ok"] &= s["ok"]
     print("CHAOS " + json.dumps(summary))
     if not summary["ok"]:
         sys.exit(1)

@@ -16,4 +16,10 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/smoke.py "$@"
 # recover — all with zero client errors
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
     --phases partition,disk
+# zone-scale smoke (small shape of the ISSUE-7 acceptance drive): one
+# zone blackholed, one zone drained under live load (rebalance mover
+# completes, acked objects bit-identical), one-zone-at-a-time rolling
+# restart with a bumped version — all with zero client errors
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases zone_blackhole,zone_drain,rolling --nodes 6 --zones 3
 echo "SMOKE+CHAOS OK"

@@ -38,6 +38,10 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test (run via asyncio.run)")
     config.addinivalue_line(
         "markers", "slow: chaos soaks / long drives, excluded from tier-1")
+    config.addinivalue_line(
+        "markers",
+        "cluster: ≥20-node SimCluster drives (always also marked slow so "
+        "tier-1 stays fast; select with -m cluster)")
 
 # The axon TPU plugin overrides JAX_PLATFORMS from the environment, so force
 # the platform through the config API as well.

@@ -284,3 +284,96 @@ def test_ring_lookup():
     empty_ring = Ring(ClusterLayout(replication_factor=3))
     assert not empty_ring.ready
     assert empty_ring.get_nodes(h, 3) == []
+
+
+# --- effective_zone_redundancy edge cases (ISSUE-7 satellite): the
+#     placement-time cap and the write-quorum zone check must AGREE on
+#     what a layout demands ---
+
+
+def test_zone_redundancy_maximum_single_zone():
+    """"maximum" with one zone degrades to 1 (placement possible, and
+    the quorum check must not demand spread the topology cannot give)."""
+    lay = ClusterLayout(replication_factor=3)
+    lay.stage_parameters(LayoutParameters(zone_redundancy="maximum"))
+    for i in (1, 2, 3):
+        lay.stage_role(nid(i), NodeRole("only", 1000))
+    lay.apply_staged_changes()
+    assert lay.effective_zone_redundancy() == 1
+    assert lay.hard_zone_redundancy() is None  # availability-first
+    assert not lay.check()
+
+
+def test_zone_redundancy_exceeding_zone_count_is_infeasible():
+    """An integer zone_redundancy larger than the zone count must refuse
+    to place (the layout cannot honor the promise) — while the same
+    integer ≤ zone count places and becomes the hard quorum bar."""
+    lay = ClusterLayout(replication_factor=3)
+    lay.stage_parameters(LayoutParameters(zone_redundancy=3))
+    for i, z in ((1, "z1"), (2, "z2"), (3, "z1"), (4, "z2")):
+        lay.stage_role(nid(i), NodeRole(z, 1000))
+    with pytest.raises(LayoutError):
+        lay.apply_staged_changes()
+    # zr capped at the replication factor for the quorum bar
+    lay2 = ClusterLayout(replication_factor=3)
+    lay2.parameters = LayoutParameters(zone_redundancy=7)
+    assert lay2.hard_zone_redundancy() == 3
+    lay3 = ClusterLayout(replication_factor=3)
+    lay3.stage_parameters(LayoutParameters(zone_redundancy=2))
+    for i, z in ((1, "z1"), (2, "z2"), (3, "z1"), (4, "z2")):
+        lay3.stage_role(nid(i), NodeRole(z, 1000))
+    lay3.apply_staged_changes()
+    assert lay3.hard_zone_redundancy() == 2
+    assert lay3.effective_zone_redundancy() == 2
+    assert not lay3.check()
+
+
+def test_zone_count_transition_placement_and_quorum_agree(tmp_path):
+    """A layout transition that changes the zone count: after every
+    apply, EVERY partition's placement must span at least the zones the
+    write-quorum check (System.write_zone_requirement) will demand of
+    it — otherwise a healthy cluster could not ack its own writes."""
+    from garage_tpu.rpc.system import System
+    from garage_tpu.utils.config import config_from_dict
+
+    sys_ = System(config_from_dict({
+        "metadata_dir": str(tmp_path / "meta"),
+        "data_dir": str(tmp_path / "data"),
+        "replication_mode": "3",
+        "rpc_secret": "t",
+    }))
+
+    def assert_agree(lay):
+        sys_.layout = lay
+        sys_._rebuild_ring()
+        zmap = lay.zone_map()
+        for p in range(N_PARTITIONS):
+            nodes = sys_.ring.partition_nodes(p)
+            required = sys_.write_zone_requirement(nodes)
+            spanned = {zmap[bytes(n)] for n in nodes}
+            assert len(spanned) >= required, (p, spanned, required)
+
+    # 3 zones, hard zr=2
+    lay = ClusterLayout(replication_factor=3)
+    lay.stage_parameters(LayoutParameters(zone_redundancy=2))
+    for i, z in ((1, "z1"), (2, "z2"), (3, "z3"), (4, "z1")):
+        lay.stage_role(nid(i), NodeRole(z, 1000))
+    lay.apply_staged_changes()
+    assert_agree(lay)
+
+    # transition DOWN to 2 zones (z3 node re-zoned into z1): still ≥2
+    lay.stage_role(nid(3), NodeRole("z1", 100))
+    lay.apply_staged_changes()
+    assert lay.effective_zone_redundancy() == 2
+    assert_agree(lay)
+
+    # transition to "maximum" across 2 zones: placement spans wide, the
+    # quorum check stops demanding (availability-first → required 0)
+    lay.stage_parameters(LayoutParameters(zone_redundancy="maximum"))
+    lay.apply_staged_changes()
+    assert lay.hard_zone_redundancy() is None
+    sys_.layout = lay
+    sys_._rebuild_ring()
+    for p in range(0, N_PARTITIONS, 17):
+        assert sys_.write_zone_requirement(
+            sys_.ring.partition_nodes(p)) == 0

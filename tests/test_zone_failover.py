@@ -1,0 +1,270 @@
+"""Zone failure domains: zone-aware request ordering, zone-verified
+write quorums (typed ZoneQuorumError vs availability-first), stale
+per-peer metric cleanup on layout removal, and the zone rollup in
+`cluster stats` — the ISSUE-7 tier-1 slice (the 24-node/4-zone drills
+live in tests/test_cluster_scale.py, marked slow+cluster)."""
+
+import asyncio
+import tempfile
+
+import pytest
+
+from garage_tpu.net import NetApp, gen_node_key
+from garage_tpu.net.peering import FullMeshPeering, PeerState
+from garage_tpu.rpc.rpc_helper import RequestStrategy, RpcHelper
+from garage_tpu.utils.data import FixedBytes32
+from garage_tpu.utils.error import QuorumError, ZoneQuorumError, error_code
+from garage_tpu.utils.metrics import MetricsRegistry
+from garage_tpu.utils.promlint import lint_exposition
+
+pytestmark = pytest.mark.asyncio
+
+
+def _nid(i: int) -> FixedBytes32:
+    return FixedBytes32(bytes([i]) * 32)
+
+
+def mk_helper():
+    net = NetApp(gen_node_key(), "t")
+    peering = FullMeshPeering(net)
+    metrics = MetricsRegistry()
+    return net, peering, RpcHelper(net, peering, metrics=metrics), metrics
+
+
+def set_zones(rpc, zmap: dict, local: str):
+    rpc.set_zone_source(lambda n: zmap.get(bytes(n)), lambda: local)
+
+
+# --- request ordering -------------------------------------------------------
+
+
+async def test_request_order_local_zone_first():
+    """Within non-open candidates: local-zone peers (by latency) before
+    cross-zone peers (by latency); unknown-zone peers rank local (the
+    pre-zone behavior); open-breaker peers last; self first."""
+    _net, peering, rpc, _m = mk_helper()
+    a, b, c, d = _nid(1), _nid(2), _nid(3), _nid(4)
+    zmap = {bytes(a): "z1", bytes(b): "z2", bytes(c): "z1", bytes(d): "z2"}
+    set_zones(rpc, zmap, "z1")
+    # cross-zone b is FASTER than local-zone a/c — zone still wins
+    peering.peers[a] = PeerState(latency=0.010)
+    peering.peers[b] = PeerState(latency=0.001)
+    peering.peers[c] = PeerState(latency=0.005)
+    peering.peers[d] = PeerState(latency=0.002)
+    order = rpc.request_order([a, b, c, d])
+    assert order == [c, a, b, d]
+    # an open breaker on a local-zone peer pushes it past every zone
+    br = peering.breaker(c)
+    br.state, br.opened_at = "open", br.clock()
+    assert peering.breaker_state(c) == "open"
+    order = rpc.request_order([a, b, c, d])
+    assert order == [a, b, d, c]
+    # self always first
+    order = rpc.request_order([a, rpc.our_id, b])
+    assert order[0] == rpc.our_id
+    # no zone info at all → pure latency order (pre-zone behavior)
+    rpc.set_zone_source(lambda _n: None, lambda: None)
+    br2 = peering.breakers.pop(c)  # close the breaker again
+    assert rpc.request_order([a, b, c, d]) == [b, d, c, a]
+
+
+# --- zone-verified write quorum --------------------------------------------
+
+
+def _fan_out(rpc, net, nodes, behavior, required_zones, quorum=2):
+    """try_call_many with a fake per-node call: behavior[node] is
+    ('ok', delay) or ('fail', delay)."""
+
+    async def call(node, _timeout):
+        kind, delay = behavior[bytes(node)]
+        if delay:
+            await asyncio.sleep(delay)
+        if kind == "fail":
+            raise ConnectionError("injected")
+        return node
+
+    ep = net.endpoint("t/zonewrite")
+    return rpc.try_call_many(
+        ep, nodes, None,
+        RequestStrategy(rs_quorum=quorum, rs_timeout=5.0,
+                        rs_required_zones=required_zones),
+        make_call=call,
+    )
+
+
+async def test_quorum_write_waits_for_zone_spread():
+    """Numeric quorum lands inside one zone; the write must WAIT for the
+    cross-zone straggler instead of acking — and count the re-quorum."""
+    net, _peering, rpc, m = mk_helper()
+    a, b, c = _nid(1), _nid(2), _nid(3)
+    set_zones(rpc, {bytes(a): "z1", bytes(b): "z1", bytes(c): "z2"}, "z1")
+    behavior = {bytes(a): ("ok", 0), bytes(b): ("ok", 0),
+                bytes(c): ("ok", 0.15)}
+    res = await _fan_out(rpc, net, [a, b, c], behavior, required_zones=2)
+    assert len(res) == 3  # waited for the z2 ack past quorum=2
+    assert m._by_name["rpc_zone_requorum_total"].get(
+        endpoint="t/zonewrite") == 1
+
+
+async def test_quorum_write_zone_error_is_typed():
+    """Whole z2 dark with a hard 2-zone requirement → ZoneQuorumError
+    (typed + wire-coded), not a generic quorum failure; and with NO zone
+    requirement the same fan-out acks availability-first."""
+    net, _peering, rpc, m = mk_helper()
+    a, b, c = _nid(1), _nid(2), _nid(3)
+    set_zones(rpc, {bytes(a): "z1", bytes(b): "z1", bytes(c): "z2"}, "z1")
+    behavior = {bytes(a): ("ok", 0), bytes(b): ("ok", 0),
+                bytes(c): ("fail", 0.02)}
+    with pytest.raises(ZoneQuorumError) as ei:
+        await _fan_out(rpc, net, [a, b, c], behavior, required_zones=2)
+    assert error_code(ei.value) == "ZoneQuorumError"
+    assert m._by_name["rpc_zone_quorum_error_total"].get(
+        endpoint="t/zonewrite") == 1
+    # availability-first: same dark zone, no requirement → success
+    res = await _fan_out(rpc, net, [a, b, c], behavior, required_zones=0)
+    assert len(res) == 2
+    # numeric quorum failure still reports as plain QuorumError
+    behavior = {bytes(a): ("ok", 0), bytes(b): ("fail", 0),
+                bytes(c): ("fail", 0)}
+    with pytest.raises(QuorumError) as ei:
+        await _fan_out(rpc, net, [a, b, c], behavior, required_zones=2)
+    assert not isinstance(ei.value, ZoneQuorumError)
+    await rpc.shutdown()
+
+
+# --- end-to-end: hard zone redundancy vs availability-first -----------------
+
+
+async def _mini_cluster(tmp, zone_redundancy):
+    from garage_tpu.testing.sim_cluster import SimCluster
+
+    # 3 storage nodes over 2 zones → z2 holds exactly one replica of
+    # every partition (the minimal shape where a dark zone bites)
+    c = SimCluster(tmp, n_storage=3, n_zones=2,
+                   zone_redundancy=zone_redundancy)
+    await c.start(faults=True)
+    return c
+
+
+async def test_zone_quorum_error_end_to_end(tmp_path):
+    """Hard zone_redundancy=2, the single-node zone z2 blackholed: a PUT
+    must fail with the typed zone error (visible in the gateway's
+    rpc_zone_quorum_error_total) — and succeed again after heal."""
+    import aiohttp
+
+    from garage_tpu.testing.sim_cluster import TrafficDriver
+
+    c = await _mini_cluster(tmp_path, zone_redundancy=2)
+    try:
+        async with aiohttp.ClientSession() as s:
+            t = TrafficDriver(c, s, bucket="hardzr")
+            await t.make_bucket()
+            await t.step("warm")
+            assert t.stats.errors == 0, t.stats.error_notes
+            c.injector.blackhole_zone("z2")
+            st, _b, _h = await t.s3.req("PUT", "/hardzr/dark", b"x" * 8192)
+            assert st == 500, f"expected typed zone failure, got {st}"
+            g0 = c.garages[0]
+            body = g0.system.metrics.render()
+            assert "rpc_zone_quorum_error_total{" in body
+            assert lint_exposition(body) == []
+            c.injector.heal_zone("z2")
+            await c.injector.reconnect(rounds=8)
+            st, _b, _h = await t.s3.req("PUT", "/hardzr/healed", b"y" * 8192)
+            assert st == 200, "write must succeed once the zone is back"
+    finally:
+        await c.stop()
+
+
+async def test_zone_dark_availability_first(tmp_path):
+    """Same topology + same dark zone under zone_redundancy="maximum":
+    writes degrade to availability-first and keep succeeding."""
+    import aiohttp
+
+    from garage_tpu.testing.sim_cluster import TrafficDriver
+
+    c = await _mini_cluster(tmp_path, zone_redundancy="maximum")
+    try:
+        async with aiohttp.ClientSession() as s:
+            t = TrafficDriver(c, s, bucket="softzr")
+            await t.make_bucket()
+            c.injector.blackhole_zone("z2")
+            for i in range(3):
+                await t.step("dark")
+            assert t.stats.errors == 0, t.stats.error_notes
+            assert t.stats.puts >= 3
+    finally:
+        await c.stop()
+
+
+# --- satellite: stale per-peer series cleared on layout removal -------------
+
+
+async def test_peer_series_cleared_on_layout_removal(tmp_path):
+    from garage_tpu.testing.sim_cluster import SimCluster
+
+    c = SimCluster(tmp_path, n_storage=4, n_zones=1)
+    await c.start(faults=False)
+    try:
+        g0 = c.garages[0]
+        victim = c.garages[4].system.id
+        lbl = bytes(victim).hex()[:16]
+        await c.tick()
+        g0.system.peering.observe_gauges()
+        assert f'peer_up{{peer="{lbl}"}}' in g0.system.metrics.render()
+        assert victim in g0.system.peering.peers
+        # open the victim's breaker so stale state would be visible too
+        g0.system.peering.breaker(victim)
+
+        def mutate(lay):
+            lay.stage_role(bytes(victim), None)
+
+        await c.apply_layout_change(mutate)
+        assert victim not in g0.system.peering.peers
+        # the breaker may be freshly re-created by the layout push to
+        # the still-connected node (it must learn the layout that
+        # removed it) — but the OLD breaker object and its failure
+        # history are gone
+        br = g0.system.peering.breakers.get(victim)
+        assert br is None or (br.state == "closed" and br.failures == 0)
+        g0.system.peering.observe_gauges()
+        body = g0.system.metrics.render()
+        assert f'peer="{lbl}"' not in body
+        # survivors keep their series
+        other = bytes(c.garages[1].system.id).hex()[:16]
+        assert f'peer_up{{peer="{other}"}}' in body
+        assert lint_exposition(body) == []
+    finally:
+        await c.stop()
+
+
+# --- satellite: cluster stats zone rollup -----------------------------------
+
+
+async def test_cluster_stats_zone_rollup(tmp_path):
+    from garage_tpu.admin.handler import AdminRpcHandler
+    from garage_tpu.testing.sim_cluster import SimCluster
+
+    c = SimCluster(tmp_path, n_storage=4, n_zones=2)
+    await c.start(faults=False)
+    try:
+        await c.tick()
+        st = await AdminRpcHandler(
+            c.garages[0], register_endpoint=False
+        )._cmd_cluster_stats({})
+        assert st["zone"] == "z1"          # gateway rides the first zone
+        assert st["version"]
+        zones = st["zones"]
+        assert set(zones) == {"z1", "z2"}
+        assert zones["z1"]["nodes"] == 2 and zones["z2"]["nodes"] == 2
+        assert zones["z1"]["up"] == 2 and zones["z2"]["up"] == 2
+        assert zones["z1"]["worst_disk"] == "ok"
+        assert zones["z1"]["breaker_open"] == 0
+        # peers are grouped by zone and carry zone/breaker/version
+        peers = st["peers"]
+        assert [p["zone"] for p in peers] == sorted(
+            p["zone"] for p in peers)
+        assert all(p["breaker"] == "closed" for p in peers)
+        assert any(p["version"] for p in peers)
+    finally:
+        await c.stop()
