@@ -1229,6 +1229,169 @@ async def _degraded_phase_async() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+REPAIR_STORM_OBJS = 20
+REPAIR_STORM_OBJ_MIN = 1 << 20     # varied sizes: the PPR sub-shard
+REPAIR_STORM_OBJ_MAX = 4 << 20     # truncation only shows on ragged tails
+REPAIR_STORM_SAMPLES = 8
+
+
+async def _repair_storm_phase_async() -> dict:
+    """ISSUE 8 acceptance phase: repair bandwidth under a node-kill
+    storm on an 8-node RS(4,2) EC cluster.
+
+    Two measurements: (1) per-block bytes-moved-per-byte-repaired for
+    the same sampled codewords under three repair modes — the legacy
+    fetch-everything gather (`repair_gather_everything` baseline
+    emulation), planned exact-k whole-shard, and planned PPR — with
+    bit-identical outputs asserted across modes; (2) the storm itself:
+    the heaviest non-gateway node is crashed and dropped from the
+    layout, the product resync heals through the PLANNED path, and
+    client GET p50 is measured while the storm runs.  Expected ladder:
+    ppr ≤ shard ≤ gather bytes/byte."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    from garage_tpu.testing.faults import (
+        FaultInjector,
+        crash_heaviest_and_drop,
+    )
+    from garage_tpu.utils.data import Hash
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="garage_tpu_bench_storm_"))
+    try:
+        garages, server, port, kid, secret = await _mk_cluster(
+            tmp, n=8, repl="3", data_repl="none", db="sqlite", codec_cfg={
+                "rs_data": 4, "rs_parity": 2,
+                "store_parity": True, "parity_on_write": True,
+                "parity_distribute": True, "backend": "cpu",
+            })
+        rng = np.random.default_rng(8)
+        bodies = {}
+        async with aiohttp.ClientSession() as session:
+            s3 = _S3(session, port, kid, secret)
+            st, _b, _h = await s3.req("PUT", "/stormbkt")
+            assert st == 200, st
+            for i in range(REPAIR_STORM_OBJS):
+                size = int(rng.integers(REPAIR_STORM_OBJ_MIN,
+                                        REPAIR_STORM_OBJ_MAX))
+                body = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+                st, _b, _h = await s3.req("PUT", f"/stormbkt/o{i:03d}", body)
+                assert st == 200, st
+                bodies[f"o{i:03d}"] = body
+        for g in garages:
+            if g.block_manager.ec_accumulator is not None:
+                await g.block_manager.ec_accumulator.drain()
+        await asyncio.sleep(3.0)  # let the distributor finish indexing
+
+        # --- per-mode comparative: same codewords, three repair modes ---
+        coord = garages[0]
+        mgr = coord.block_manager
+        data = coord.parity_index_table.data
+        samples, seen = [], set()
+        for _kby, raw in data.store.items(b"", None):
+            try:
+                ent = data.decode_entry(raw)
+            except Exception:
+                continue
+            if (ent.is_tombstone() or bytes(ent.member) in seen
+                    or ent.member_index >= len(ent.members)):
+                continue
+            seen.add(bytes(ent.member))
+            samples.append(ent)
+            if len(samples) >= REPAIR_STORM_SAMPLES:
+                break
+        assert samples, "no parity-index entries on the coordinator"
+        planner = mgr.repair_planner
+        assert planner is not None
+        ratios, decoded = {}, {}
+        for mode in ("gather", "shard", "ppr"):
+            if mode == "gather":
+                mgr.repair_planner = None
+                mgr.repair_gather_everything = True
+            else:
+                mgr.repair_planner = planner
+                mgr.repair_gather_everything = False
+                planner.use_ppr = (mode == "ppr")
+            f0 = sum(mgr.repair_fetch_bytes.values())
+            r0 = mgr.repair_repaired_bytes
+            for ent in samples:
+                got = await mgr.parity_reconstructor(
+                    Hash(bytes(ent.member)))
+                assert got is not None, f"{mode} reconstruction failed"
+                prev = decoded.setdefault(bytes(ent.member), got)
+                assert prev == got, f"{mode} not bit-identical"
+            moved = sum(mgr.repair_fetch_bytes.values()) - f0
+            repaired = mgr.repair_repaired_bytes - r0
+            ratios[mode] = moved / max(1, repaired)
+        mgr.repair_planner = planner
+        mgr.repair_gather_everything = False
+        planner.use_ppr = True
+
+        # --- the storm: kill the heaviest non-gateway node ---------------
+        inj = FaultInjector(garages)
+        _victim, lost, survivors = await crash_heaviest_and_drop(inj)
+        f0 = sum(sum(g.block_manager.repair_fetch_bytes.values())
+                 for g in survivors)
+        r0 = sum(g.block_manager.repair_repaired_bytes for g in survivors)
+
+        t0 = time.perf_counter()
+        lats, client_errors = [], 0
+        pending = dict(bodies)
+        deadline = time.perf_counter() + 600
+        async with aiohttp.ClientSession() as session:
+            s3 = _S3(session, port, kid, secret)
+            while pending and time.perf_counter() < deadline:
+                for name in list(pending):
+                    tq = time.perf_counter()
+                    try:
+                        st, got, _h = await asyncio.wait_for(
+                            s3.req("GET", f"/stormbkt/{name}"), 60)
+                    except Exception:
+                        client_errors += 1
+                        continue
+                    lats.append(time.perf_counter() - tq)
+                    if st == 200 and got == bodies[name]:
+                        del pending[name]
+                    else:
+                        client_errors += 1
+                if pending:
+                    await asyncio.sleep(2.0)
+        heal_s = time.perf_counter() - t0
+        moved = sum(sum(g.block_manager.repair_fetch_bytes.values())
+                    for g in survivors) - f0
+        repaired = sum(g.block_manager.repair_repaired_bytes
+                       for g in survivors) - r0
+        lats.sort()
+        out = {
+            "repair_storm_bytes_per_byte_gather": round(ratios["gather"], 3),
+            "repair_storm_bytes_per_byte_shard": round(ratios["shard"], 3),
+            "repair_storm_bytes_per_byte_ppr": round(ratios["ppr"], 3),
+            "repair_storm_bytes_per_byte_storm": round(
+                moved / max(1, repaired), 3),
+            "repair_storm_heal_s": round(heal_s, 1),
+            "repair_storm_gibs": round(lost / heal_s / 2**30, 4),
+            "repair_storm_lost_gib": round(lost / 2**30, 3),
+            "repair_storm_unhealed": len(pending),
+            "repair_storm_client_errors": client_errors,
+            "repair_storm_client_p50_ms": round(
+                lats[len(lats) // 2] * 1000, 1) if lats else 0.0,
+            "repair_storm_overfetch_bytes": sum(
+                g.block_manager.repair_overfetch_bytes for g in survivors),
+            "repair_storm_ppr_fallbacks": sum(
+                g.block_manager.repair_ppr_fallbacks for g in survivors),
+        }
+        await server.stop()
+        for i, g in enumerate(inj.garages):
+            if i not in inj.dead:
+                await g.shutdown()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _put_solo_phase_async():
     return _put_phase_async(n=1, repl="none", prefix="put_solo")
 
@@ -1342,6 +1505,7 @@ _PHASES = {
     "--rs-put-phase": _rs_put_phase_async,
     "--mp-phase": _mp_phase_async,
     "--degraded-phase": _degraded_phase_async,
+    "--repair-storm-phase": _repair_storm_phase_async,
     "--wan-phase": _wan_phase_async,
 }
 
@@ -1690,6 +1854,8 @@ def main() -> None:
     out.update(run_phase_subprocess("--mp-phase", timeout=MP_TIME_CAP + 180))
     emit()
     out.update(run_phase_subprocess("--degraded-phase", timeout=900))
+    emit()
+    out.update(run_phase_subprocess("--repair-storm-phase", timeout=900))
     emit()
     out.update(run_phase_subprocess("--wan-phase"))
     emit()

@@ -182,6 +182,20 @@ class BlockManager:
         # async h -> plain bytes | None, decoding from cross-node pieces
         self.parity_reconstructor = None
         self.blocks_reconstructed = 0
+        # bandwidth-minimal degraded-read fetch planner (exact-k survivor
+        # selection + partial-parallel repair, block/repair_plan.py);
+        # None keeps the legacy sweep-everything gather
+        self.repair_planner = None
+        if (getattr(config.codec, "repair_planner", True)
+                and config.codec.rs_data > 0):
+            from .repair_plan import RepairPlanner
+
+            hedge_ms = getattr(config.codec, "repair_hedge_ms", 0.0) or 0.0
+            self.repair_planner = RepairPlanner(
+                self,
+                use_ppr=getattr(config.codec, "repair_ppr", True),
+                hedge_delay=(hedge_ms / 1000.0) if hedge_ms > 0 else None,
+            )
 
         # metrics counters (ref block/metrics.rs:7-127)
         self.bytes_read = 0
@@ -193,6 +207,18 @@ class BlockManager:
         # source ∈ {writeback, resync_fetch, peer_sweep,
         # distributed_decode, local_sidecar}
         self.heal_counts: dict = {}
+        # repair-bandwidth accounting (block/repair_plan.py + the legacy
+        # gather in model/parity_repair.py): wire bytes fetched per
+        # reconstruction mode, bytes of repaired rows produced, fetched
+        # bytes that ended up unused, hedged replacement fetches, and
+        # PPR requests that fell back to whole-shard (mixed-version /
+        # missing-piece peers).  Plain attributes so bench/chaos read
+        # them without a metrics registry.
+        self.repair_fetch_bytes: dict = {"ppr": 0, "shard": 0, "gather": 0}
+        self.repair_repaired_bytes = 0
+        self.repair_overfetch_bytes = 0
+        self.repair_hedges = 0
+        self.repair_ppr_fallbacks = 0
         m = getattr(system, "metrics", None)
         if m is not None:
             m.gauge("block_compression_level", "Configured zstd level",
@@ -243,6 +269,29 @@ class BlockManager:
                 "block_quarantine_error_total",
                 "Quarantine renames that failed (bad copy deleted "
                 "instead so resync can refetch)")
+            self.m_repair_fetch = m.counter(
+                "repair_fetch_bytes_total",
+                "Bytes fetched for degraded reads / reconstruction, by "
+                "mode (ppr = partial-sum products, shard = whole-shard "
+                "exact-k — both wire bytes; gather = legacy "
+                "sweep-everything fallback, counted as verified plain "
+                "bytes, an upper bound on its wire cost)")
+            self.m_repair_repaired = m.counter(
+                "repair_repaired_bytes_total",
+                "Bytes of reconstructed codeword rows produced by "
+                "degraded reads / repair")
+            self.m_repair_overfetch = m.counter(
+                "repair_overfetch_bytes_total",
+                "Repair bytes fetched but discarded unused (hedge losers, "
+                "pieces beyond the k the decode needed)")
+            self.m_repair_hedge = m.counter(
+                "repair_hedge_total",
+                "Hedged replacement fetches launched by the repair "
+                "planner on stalled piece fetches")
+            self.m_repair_ppr_fb = m.counter(
+                "repair_ppr_fallback_total",
+                "PPR partial-product requests that fell back to a "
+                "whole-shard fetch (old-version or piece-less peers)")
             self.m_heal = m.counter(
                 "block_heal_total",
                 "Blocks re-materialized, by heal source (writeback = "
@@ -274,6 +323,9 @@ class BlockManager:
             self.m_read_dur = self.m_write_dur = None
             self.m_heal = None
             self.m_quarantine = self.m_quarantine_err = None
+            self.m_repair_fetch = self.m_repair_repaired = None
+            self.m_repair_overfetch = None
+            self.m_repair_hedge = self.m_repair_ppr_fb = None
 
     # --- paths ---
 
@@ -415,6 +467,36 @@ class BlockManager:
         if self.m_heal is not None:
             self.m_heal.inc(source=source)
 
+    # --- repair-bandwidth accounting (planner + legacy gather) ---
+
+    def note_repair_fetch(self, mode: str, n: int) -> None:
+        """`n` wire bytes fetched for reconstruction under `mode`
+        (ppr | shard | gather)."""
+        self.repair_fetch_bytes[mode] = (
+            self.repair_fetch_bytes.get(mode, 0) + n)
+        if self.m_repair_fetch is not None:
+            self.m_repair_fetch.inc(n, mode=mode)
+
+    def note_repair_done(self, n: int) -> None:
+        self.repair_repaired_bytes += n
+        if self.m_repair_repaired is not None:
+            self.m_repair_repaired.inc(n)
+
+    def note_repair_overfetch(self, n: int) -> None:
+        self.repair_overfetch_bytes += n
+        if self.m_repair_overfetch is not None:
+            self.m_repair_overfetch.inc(n)
+
+    def note_repair_hedge(self) -> None:
+        self.repair_hedges += 1
+        if self.m_repair_hedge is not None:
+            self.m_repair_hedge.inc()
+
+    def note_repair_ppr_fallback(self) -> None:
+        self.repair_ppr_fallbacks += 1
+        if self.m_repair_ppr_fb is not None:
+            self.m_repair_ppr_fb.inc()
+
     def is_parity_block(self, h: Hash) -> bool:
         """Was this hash ever stored here as a distributed-parity shard?"""
         return self._parity_marks.get(bytes(h)) is not None
@@ -555,7 +637,7 @@ class BlockManager:
                 f"block {hb.hex()[:16]} local copy unreadable: {e}") from e
         block = DataBlock(raw, compressed)
         try:
-            block.verify(h, self.hash_algo, codec=self.codec)
+            await self._verify_block(h, block)
         except CorruptData:
             self.corruptions += 1
             logger.error("corrupted block %s at %s", hb.hex()[:16], path)
@@ -567,6 +649,26 @@ class BlockManager:
         self._disk_errors.pop(hb, None)
         self.bytes_read += len(raw)
         return block
+
+    async def _verify_block(self, h: Hash, block: DataBlock) -> None:
+        """Read-path verify.  Plain blocks route their content hash
+        through the codec feeder when one is armed (the ROADMAP feeder
+        follow-through: until now only PUT hash / parity encode /
+        degraded decode rode it): K concurrent GET verifies coalesce
+        into one ragged multi-buffer hash pass, while the in-flight
+        request hint keeps a lone read dispatching immediately — no SLO
+        tax on solo p50.  Compressed blocks keep the inline zstd
+        frame-checksum check, and a closed/absent feeder degrades to the
+        pre-feeder inline verify."""
+        if self.feeder is not None and not block.compressed:
+            with self.feeder.request_scope() as feeder:
+                got = await feeder.hash_async(
+                    [block.inner], peers=feeder.inflight_requests or None)
+            if bytes(got[0]) != bytes(h):
+                raise CorruptData(
+                    f"hash mismatch for block {bytes(h).hex()[:16]}")
+            return
+        block.verify(h, self.hash_algo, codec=self.codec)
 
     async def delete_if_unneeded(self, h: Hash) -> None:
         """Delete the local copy if rc says it's deletable (resync path,
@@ -1122,6 +1224,47 @@ class BlockManager:
             # resync._resync_block_inner migration branch)
             return {"needed": await self.need_block(h),
                     "present": self.is_block_present(h)}, None
+        if t == "ppr":
+            # partial-parallel repair: multiply the LOCAL shard by the
+            # decode coefficient in GF(256) and ship the partial product
+            # truncated to the target row's length — one sub-shard-sized
+            # result per survivor link instead of the whole piece, and
+            # the coordinator only XOR-accumulates (block/repair_plan.py;
+            # docs/ROBUSTNESS.md "Repair bandwidth")
+            h = Hash(bytes(msg["h"]))
+            try:
+                block = await self.read_block(h)
+            except (NoSuchBlock, CorruptData) as e:
+                # same serve-miss repair signal as get_block: a vanished
+                # assigned piece re-enters the resync chain
+                if (self.resync is not None
+                        and self.rc.get(h).is_needed()
+                        and self.is_assigned(h)
+                        and not self.is_block_present(h)):
+                    self.resync.put_to_resync(h, 0.0, source="serve_miss")
+                return {"err": str(e)}, None
+            coeff = int(msg["coeff"]) & 0xFF
+            want = max(0, int(msg["len"]))
+            is_par = bool(msg.get("parity"))
+
+            def _partial():
+                raw = block.decompressed()
+                if is_par:
+                    from .parity import unpack_parity_shard
+
+                    shard = unpack_parity_shard(raw)
+                    if shard is None:
+                        return None
+                else:
+                    shard = raw
+                # coefficient-multiply through the codec's GF kernel
+                # (native GFNI when built, numpy log/exp tables else)
+                return self.codec.gf_scale(coeff, shard, want)
+
+            part = await asyncio.to_thread(_partial)
+            if part is None:
+                return {"err": "not a parity shard"}, None
+            return {"n": len(part)}, _chunks(part)
         raise GarageError(f"unknown block rpc {t!r}")
 
     # --- introspection ---

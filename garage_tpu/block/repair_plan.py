@@ -1,0 +1,634 @@
+"""RepairPlanner — repair-bandwidth-optimal degraded reads.
+
+The decode ladder's original gather (model/parity_repair.py) fetched
+every surviving data member AND every parity shard of a codeword even
+though a decode needs exactly k pieces, so one degraded read could move
+(k+m−1)/k× the necessary bytes — and every fetch walked the full
+sweep/timeout chain, dead nodes included.  This module turns every
+degraded read / reconstruction into a *planned* fetch, per the two
+PAPERS.md schemes the ROADMAP names:
+
+  1. **Exact-k survivor selection** ("Boosting the Performance of
+     Degraded Reads in RS-coded Distributed Storage Systems"): candidate
+     pieces are ranked by their best holder's `RpcHelper.peer_rank` —
+     the per-peer RTT EWMA, circuit-breaker state, and zone locality the
+     resilience layer already maintains — with data members before
+     parity (parity only fills the gap left by dead members) and
+     pieces whose every holder is breaker-open last.  Exactly k fetches
+     go out; a *ranked replacement* launches only when a fetch fails, or
+     hedges in when the wave stalls past the hedge delay.  Fetched bytes
+     that end up unused are counted in repair_overfetch_bytes_total.
+
+  2. **Partial-parallel repair / PPR** (+ the sub-shard idea of "Fast
+     Product-Matrix Regenerating Codes"): instead of shipping whole
+     shards, each survivor multiplies its local shard by the decode
+     coefficient in GF(256) — the `ppr` block RPC, served through
+     ops/gf256 / the native kernel in ops/cpu_codec — and ships the
+     partial product *truncated to the target row's length*, so a
+     reconstruction moves at most one target-row-sized partial sum per
+     survivor link and the coordinator only XOR-accumulates.  The GF
+     work parallelizes across the survivors' CPUs; min(shard, target)
+     truncation makes PPR ≤ whole-shard byte-wise.  Peers that predate
+     the endpoint (version gossip, PR 7) or answer it with "unknown
+     rpc" fall back to whole-shard fetch for that piece — mixed-version
+     clusters reconstruct bit-identically, just less cheaply.
+
+Replacement algebra: a survivor's partial c_old ⊗ shard is rescaled
+locally to any later coefficient via (c_new ⊗ c_old⁻¹) ⊗ partial, so a
+failed fetch that changes the survivor set never invalidates partials
+already in hand — the coordinator re-plans, rescales, and fetches only
+the replacement.
+
+Safety is unchanged from the gather path: whole-shard pieces are
+verified by content hash before use, partial products cannot be (they
+are not content-addressed), but the rebuilt block must hash to the
+requested id before it is returned — a corrupt partial costs a fallback,
+never wrong data.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..net.frame import PRIO_NORMAL
+from ..ops import gf256
+from ..utils.data import Hash, block_hash
+from ..utils.error import GarageError
+
+logger = logging.getLogger("garage_tpu.block.repair_plan")
+
+# Gossiped software version from which peers answer the `ppr` block RPC;
+# older peers are never sent a partial-product request.  Unknown or
+# unparseable versions are tried optimistically — an "unknown block rpc"
+# answer demotes the peer to whole-shard for the rest of the process.
+PPR_MIN_VERSION = (0, 9, 0)
+
+# c_applied sentinel: the payload is the raw (unscaled) shard bytes —
+# whole-shard fetches and PPR fallbacks land here; the coordinator
+# scales by the final coefficient itself.
+RAW = -1
+
+_VER_RE = re.compile(r"^(\d+)\.(\d+)(?:\.(\d+))?")
+
+
+def parse_version(v: Optional[str]) -> Optional[tuple]:
+    """Leading numeric (major, minor, patch) of a gossiped version tag;
+    None when absent/unparseable (suffixes like '-dev' are ignored)."""
+    if not v:
+        return None
+    m = _VER_RE.match(str(v))
+    if m is None:
+        return None
+    return (int(m.group(1)), int(m.group(2)), int(m.group(3) or 0))
+
+
+class _Piece:
+    """One fetchable codeword piece: a surviving data member or a parity
+    shard (implicit zero shards of a partial codeword are free and never
+    fetched)."""
+
+    __slots__ = ("index", "hash", "kind")
+
+    def __init__(self, index: int, hash_: bytes, kind: str):
+        self.index = index          # position in the extended codeword
+        self.hash = bytes(hash_)    # content hash == ring placement
+        self.kind = kind            # "data" | "parity"
+
+    def __repr__(self) -> str:  # debug/log friendliness
+        return f"<piece {self.index} {self.kind} {self.hash.hex()[:8]}>"
+
+
+class RepairPlanner:
+    """Plans and executes bandwidth-minimal reconstruction of one
+    codeword row.  Owned by the BlockManager; model/parity_repair.py
+    routes every distributed decode through it (falling back to the
+    legacy sweep-everything gather only if the plan comes up empty)."""
+
+    def __init__(self, manager, use_ppr: bool = True,
+                 hedge_delay: Optional[float] = None):
+        self.manager = manager
+        self.use_ppr = use_ppr
+        # None → derive from the block endpoint's observed latency
+        # quantile (same source as read hedging), 1 s static until
+        # enough samples exist
+        self.hedge_delay = hedge_delay
+        self._no_ppr: set = set()     # peers observed not to answer `ppr`
+        self._row_cache: dict = {}    # (k, m, present, target) -> row
+        self.plans = 0
+        self.hedges = 0
+        self.ppr_fallbacks = 0
+
+    # --- ranking ------------------------------------------------------------
+
+    def rank_pieces(self, pieces: Sequence[_Piece]) -> List[_Piece]:
+        """Fetch order: data members before parity (parity only fills
+        the gap left by dead members), each band ordered by the piece's
+        BEST holder under RpcHelper.peer_rank (self < local-zone <
+        cross-zone < breaker-open; measured RTT before unknown), and
+        pieces whose every holder is breaker-open dead-last — even
+        behind healthy parity, since their fetches can only burn
+        timeouts that healthy pieces avoid."""
+        rpc = self.manager.system.rpc
+
+        def key(p: _Piece):
+            nodes = self.manager.replication.read_nodes(Hash(p.hash))
+            best = min((rpc.peer_rank(n) for n in nodes),
+                       default=(9, 9, 0.0))
+            dead = 1 if best[0] >= 4 else 0
+            kind = 0 if p.kind == "data" else 1
+            return (dead, kind, best, p.index)
+
+        return sorted(pieces, key=key)
+
+    def _holder_order(self, h: Hash) -> List:
+        rpc = self.manager.system.rpc
+        return rpc.request_order(self.manager.replication.read_nodes(h))
+
+    def _hedge_after(self) -> float:
+        if self.hedge_delay is not None:
+            return self.hedge_delay
+        rpc = self.manager.system.rpc
+        d = None
+        if rpc.m_duration is not None:
+            d = rpc.m_duration.quantile(
+                rpc.tunables.hedge_quantile,
+                min_count=rpc.tunables.hedge_min_samples,
+                endpoint=self.manager.endpoint.path,
+            )
+        return max(d, 0.05) if d is not None else 1.0
+
+    # --- PPR capability gate ------------------------------------------------
+
+    def _peer_ppr_ok(self, node) -> bool:
+        if bytes(node) in self._no_ppr:
+            return False
+        ver = parse_version(self.manager.system.peer_version(node))
+        if ver is not None and ver < PPR_MIN_VERSION:
+            return False
+        return True  # unknown version: try it, demote on "unknown rpc"
+
+    @staticmethod
+    def _is_unknown_rpc(e: BaseException) -> bool:
+        return isinstance(e, GarageError) and "unknown block rpc" in str(e)
+
+    # --- decode coefficients ------------------------------------------------
+
+    def _decode_row(self, k: int, m: int, present: tuple,
+                    target: int) -> np.ndarray:
+        """Coefficients c_j with data[target] = Σ_j c_j ⊗
+        shards[present[j]].  Shares the codec's cached decode schedule
+        (ops/cpu_codec.py) when the live geometry matches the entry's;
+        a small local cache covers old-geometry entries."""
+        key = (k, m, present, target)
+        row = self._row_cache.get(key)
+        if row is not None:
+            return row
+        codec = self.manager.codec
+        if (getattr(codec, "decode_matrix", None) is not None
+                and codec.params.rs_data == k
+                and codec.params.rs_parity == m):
+            row = codec.decode_matrix(list(present), rows=[target])[0]
+        else:
+            row = gf256.rs_decode_row(k, m, list(present), target)
+        if len(self._row_cache) >= 512:
+            self._row_cache.clear()
+        self._row_cache[key] = row
+        return row
+
+    # --- fetch primitives ---------------------------------------------------
+
+    async def _read_local(self, piece: _Piece) -> Optional[bytes]:
+        """This node's own verified copy of a piece (unpacked if parity);
+        zero wire bytes."""
+        mgr = self.manager
+        h = Hash(piece.hash)
+        if not mgr.is_block_present(h):
+            return None
+        try:
+            block = await mgr.read_block(h)
+            raw = await asyncio.to_thread(block.decompressed)
+        except Exception:  # noqa: BLE001 — any local failure → fetch remote
+            return None
+        # read_block already content-verified a PLAIN block; only a
+        # compressed copy (frame-checksum-verified) needs the content
+        # hash re-checked over the decompressed bytes — off-loop and
+        # feeder-batched like every other planner verify
+        if block.compressed and not await self._verify(raw, piece.hash):
+            return None
+        if piece.kind == "parity":
+            from .parity import unpack_parity_shard
+
+            return unpack_parity_shard(raw)
+        return raw
+
+    async def _fetch_whole(self, piece: _Piece) -> Tuple[bytes, int, int]:
+        """One piece's verified shard bytes: local copy → ranked ring
+        holders → the O(cluster) sweep as the completeness backstop.
+        Returns (shard, c_applied=RAW, wire_bytes_moved)."""
+        payload, moved = await self._fetch_whole_inner(piece)
+        return payload, RAW, moved
+
+    async def _fetch_whole_inner(self, piece: _Piece) -> Tuple[bytes, int]:
+        # Deliberately NOT rpc_get_block_streaming: that path serves
+        # whole blocks to clients (decompressed iteration, bytes_read
+        # accounting, heal/decode fallbacks that would recurse into
+        # reconstruction); a piece fetch wants raw wire frames, its own
+        # byte accounting, and the parity unpack.  The resilience
+        # primitives (peer_allows fast-fail, adaptive timeout,
+        # note_result) are shared.
+        from .block import DataBlock, DataBlockHeader
+
+        mgr = self.manager
+        rpc = mgr.system.rpc
+        h = Hash(piece.hash)
+        local = await self._read_local(piece)
+        if local is not None:
+            return local, 0
+        our_id = mgr.system.id
+        for node in self._holder_order(h):
+            if bytes(node) == bytes(our_id):
+                continue  # local copy already tried
+            if not rpc.peer_allows(node):
+                # breaker open: fast-fail to the next holder — the
+                # sweep backstop below still tries everyone, so a stale
+                # verdict can delay but never hide the only copy
+                continue
+            try:
+                timeout = rpc.timeout_for(node, mgr.block_rpc_timeout)
+                resp, stream = await mgr.endpoint.call_streaming(
+                    node, {"t": "get_block", "h": piece.hash},
+                    prio=PRIO_NORMAL, timeout=timeout,
+                )
+                if resp.get("err") or stream is None:
+                    rpc.note_result(node, None)  # live handler: path works
+                    continue
+                hdr = DataBlockHeader.unpack(resp["hdr"])
+                try:
+                    body = await asyncio.wait_for(
+                        stream.read_all(), mgr.block_rpc_timeout)
+                except BaseException:
+                    await stream.aclose()  # stop the sender's pump
+                    raise
+                rpc.note_result(node, None)
+                raw = await asyncio.to_thread(
+                    DataBlock(body, hdr.compressed).decompressed)
+                if not await self._verify(raw, piece.hash):
+                    continue
+                if piece.kind == "parity":
+                    from .parity import unpack_parity_shard
+
+                    shard = unpack_parity_shard(raw)
+                    if shard is None:
+                        continue
+                    return shard, len(body)
+                return raw, len(body)
+            except asyncio.CancelledError:
+                rpc.note_result(node, asyncio.CancelledError())
+                raise
+            except Exception as e:  # noqa: BLE001 — next holder
+                rpc.note_result(node, e)
+                continue
+        # completeness backstop: after a layout change the only copy may
+        # sit on a node the ring no longer lists (sweep_get_block's
+        # raison d'être); ring holders were already tried above
+        raw = await mgr.sweep_get_block(h, try_ring=False)
+        if raw is None:
+            raise GarageError(f"piece {piece.hash.hex()[:12]} unavailable")
+        if piece.kind == "parity":
+            from .parity import unpack_parity_shard
+
+            shard = unpack_parity_shard(raw)
+            if shard is None:
+                raise GarageError(
+                    f"piece {piece.hash.hex()[:12]} not a parity shard")
+            return shard, len(raw)
+        return raw, len(raw)
+
+    async def _fetch_ppr(self, piece: _Piece, coeff: int,
+                         want: int) -> Tuple[bytes, int, int]:
+        """coeff ⊗ shard truncated to `want` bytes, computed survivor-
+        side when a PPR-capable holder has the piece; local copies scale
+        locally (zero wire bytes) and holder exhaustion falls back to a
+        whole-shard fetch.  Returns (payload, c_applied, wire_bytes)."""
+        mgr = self.manager
+        rpc = mgr.system.rpc
+        h = Hash(piece.hash)
+        local = await self._read_local(piece)
+        if local is not None:
+            return local, RAW, 0
+        msg = {"t": "ppr", "h": piece.hash, "coeff": int(coeff),
+               "len": int(want)}
+        if piece.kind == "parity":
+            msg["parity"] = True
+        our_id = mgr.system.id
+        for node in self._holder_order(h):
+            if bytes(node) == bytes(our_id):
+                continue
+            if not self._peer_ppr_ok(node) or not rpc.peer_allows(node):
+                continue  # old version / open breaker: next holder
+            try:
+                timeout = rpc.timeout_for(node, mgr.block_rpc_timeout)
+                resp, stream = await mgr.endpoint.call_streaming(
+                    node, msg, prio=PRIO_NORMAL, timeout=timeout)
+                if resp.get("err") or stream is None:
+                    # err-less but body-less answers are a MISS like the
+                    # whole-shard path treats them — XOR-accumulating a
+                    # phantom zero partial would corrupt the row and
+                    # waste the whole planned fetch on the hash check
+                    rpc.note_result(node, None)
+                    continue
+                try:
+                    body = await asyncio.wait_for(
+                        stream.read_all(), mgr.block_rpc_timeout)
+                except BaseException:
+                    await stream.aclose()
+                    raise
+                rpc.note_result(node, None)
+                if not body:
+                    continue  # empty partial: same phantom-zero hazard
+                return body, int(coeff), len(body)
+            except asyncio.CancelledError:
+                rpc.note_result(node, asyncio.CancelledError())
+                raise
+            except Exception as e:  # noqa: BLE001
+                if self._is_unknown_rpc(e):
+                    # peer predates the endpoint: remember, and never
+                    # count a version miss against its breaker
+                    self._no_ppr.add(bytes(node))
+                    rpc.note_result(node, None)
+                else:
+                    rpc.note_result(node, e)
+                continue
+        # no PPR-capable holder answered — whole-shard for this piece
+        self.ppr_fallbacks += 1
+        mgr.note_repair_ppr_fallback()
+        payload, moved = await self._fetch_whole_inner(piece)
+        return payload, RAW, moved
+
+    async def _verify(self, raw: bytes, want_hash: bytes) -> bool:
+        """Content-hash check for a fetched whole piece, batched through
+        the codec feeder when one is armed so a repair storm's many
+        concurrent piece verifies coalesce into one ragged hash pass."""
+        mgr = self.manager
+        feeder = getattr(mgr, "feeder", None)
+        if feeder is not None:
+            got = (await feeder.hash_async([raw]))[0]
+        else:
+            got = await asyncio.to_thread(block_hash, raw, mgr.hash_algo)
+        return bytes(got) == bytes(want_hash)
+
+    # --- the planned reconstruction ----------------------------------------
+
+    async def reconstruct(self, h: Hash, ent) -> Optional[bytes]:
+        """Rebuild codeword row `ent.member_index` (content hash `h`)
+        with a planned, exactly-k fetch.  Returns verified plain bytes
+        or None (callers fall back to the legacy gather)."""
+        k, m = int(ent.k), int(ent.m)
+        target = int(ent.member_index)
+        lengths = list(ent.lengths)
+        if not lengths or target >= len(ent.members):
+            return None
+        maxlen = max(lengths)
+        want = int(lengths[target])
+        if maxlen == 0 or want == 0 or k <= 0:
+            return None
+        zeros = list(range(len(ent.members), k))
+        cands = [
+            _Piece(i, ent.members[i], "data")
+            for i in range(len(ent.members)) if i != target
+        ] + [
+            _Piece(k + j, ph, "parity")
+            for j, ph in enumerate(ent.parity_hashes)
+        ]
+        needed = k - len(zeros)
+        if len(cands) < needed:
+            return None
+        self.plans += 1
+        mgr = self.manager
+        try:
+            out = await self._run(
+                self.rank_pieces(cands), zeros, k, m, target,
+                want, maxlen, needed)
+        except Exception:  # noqa: BLE001 — planner failure = fallback
+            logger.exception("planned reconstruction of %s failed",
+                             bytes(h).hex()[:16])
+            return None
+        if out is None:
+            return None
+        if not await self._verify(out, bytes(h)):
+            logger.warning("planned reconstruction of %s produced wrong "
+                           "hash", bytes(h).hex()[:16])
+            return None
+        mgr.note_repair_done(len(out))
+        return out
+
+    async def _run(self, ranked: List[_Piece], zeros: List[int], k: int,
+                   m: int, target: int, want: int, maxlen: int,
+                   needed: int) -> Optional[bytes]:
+        mgr = self.manager
+        mode = "ppr" if self.use_ppr else "shard"
+        pieces: Dict[int, _Piece] = {p.index: p for p in ranked}
+        order = [p.index for p in ranked]
+        failed: set = set()
+        trivial: set = set()                     # zero-coeff, nothing fetched
+        no_trivial: set = set()  # rejected at finalize: must really fetch
+        results: Dict[int, Tuple[Optional[bytes], int]] = {}
+        moved: Dict[int, int] = {}
+        active: Dict[asyncio.Task, int] = {}
+        hedge = self._hedge_after()
+
+        def working_set() -> List[int]:
+            return [i for i in order if i not in failed][:needed]
+
+        def coeffs(w: List[int]) -> Dict[int, int]:
+            present = tuple(sorted(w + zeros))
+            row = self._decode_row(k, m, present, target)
+            return {idx: int(row[j]) for j, idx in enumerate(present)}
+
+        def launch(i: int, cmap: Dict[int, int]) -> None:
+            p = pieces[i]
+            if mode == "ppr":
+                c = cmap.get(i)
+                if c == 0 and i not in no_trivial:
+                    # zero coefficient under the current set: the piece
+                    # contributes nothing — trivially satisfied, revisited
+                    # if a replacement changes the set
+                    results[i] = (None, 0)
+                    trivial.add(i)
+                    return
+                # a piece finalize rejected (zero here, nonzero in the
+                # final set) fetches with the neutral coefficient 1 — a
+                # raw sub-shard the finish pass rescales — so the
+                # trivial/required oscillation can never loop
+                t = asyncio.ensure_future(
+                    self._fetch_ppr(p, c or 1, want))
+            else:
+                t = asyncio.ensure_future(self._fetch_whole(p))
+            active[t] = i
+
+        try:
+            while True:
+                w = working_set()
+                if len(w) < needed:
+                    return None  # candidates exhausted
+                cmap = coeffs(w) if mode == "ppr" else {}
+                if mode == "ppr":
+                    # a replacement may have made a previously-zero
+                    # coefficient live: the piece must really be fetched
+                    for i in list(trivial):
+                        if cmap.get(i, 0) != 0:
+                            trivial.discard(i)
+                            results.pop(i, None)
+                sat = [i for i in order
+                       if i in results and i not in failed]
+                if len(sat) >= needed:
+                    final = sat[:needed]
+                    if mode == "ppr":
+                        present = tuple(sorted(final + zeros))
+                        row = self._decode_row(k, m, present, target)
+                        cfin = {idx: int(row[j])
+                                for j, idx in enumerate(present)}
+                        bad = [i for i in final
+                               if results[i][0] is None and cfin[i] != 0]
+                        if bad:
+                            no_trivial.update(bad)
+                            for i in bad:
+                                trivial.discard(i)
+                                results.pop(i, None)
+                            continue
+                    break
+                inflight = set(active.values())
+                for i in w:
+                    if i not in results and i not in inflight:
+                        launch(i, cmap)
+                        inflight.add(i)
+                if not active:
+                    continue  # launches were all trivial: re-evaluate
+                can_hedge = any(
+                    i not in failed and i not in inflight
+                    and i not in results
+                    for i in order if i not in set(w))
+                done, _ = await asyncio.wait(
+                    active.keys(),
+                    return_when=asyncio.FIRST_COMPLETED,
+                    timeout=hedge if can_hedge else None,
+                )
+                if not done:
+                    # stalled wave: hedge the next-ranked replacement —
+                    # whichever answers first forms the final set, the
+                    # loser's bytes land in the overfetch counter
+                    nxt = next(
+                        (i for i in order
+                         if i not in failed and i not in inflight
+                         and i not in results and i not in set(w)), None)
+                    if nxt is None:
+                        continue
+                    self.hedges += 1
+                    mgr.note_repair_hedge()
+                    hyp = (w[:-1] + [nxt]) if w else [nxt]
+                    launch(nxt, coeffs(hyp) if mode == "ppr" else {})
+                    continue
+                for t in done:
+                    i = active.pop(t)
+                    try:
+                        payload, c_app, nbytes = t.result()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        logger.debug("piece %s fetch failed: %s",
+                                     pieces[i], e)
+                        failed.add(i)
+                        continue
+                    results[i] = (payload, c_app)
+                    moved[i] = nbytes
+                    fmode = "shard" if (mode == "shard" or c_app == RAW) \
+                        else "ppr"
+                    if nbytes:
+                        mgr.note_repair_fetch(fmode, nbytes)
+        finally:
+            for t in list(active):
+                t.cancel()
+            if active:
+                await asyncio.gather(*active, return_exceptions=True)
+
+        # satisfied-but-unused pieces (hedge losers that completed) are
+        # pure overfetch
+        for i in results:
+            if i not in final and moved.get(i):
+                mgr.note_repair_overfetch(moved[i])
+
+        if mode == "ppr":
+            return self._finish_ppr(final, zeros, k, m, target, want,
+                                    results)
+        return await self._finish_shard(final, zeros, k, m, target, want,
+                                        maxlen, results)
+
+    def _finish_ppr(self, final: List[int], zeros: List[int], k: int,
+                    m: int, target: int, want: int,
+                    results: Dict[int, Tuple[Optional[bytes], int]]
+                    ) -> bytes:
+        """XOR-accumulate the partial sums, rescaling any partial whose
+        applied coefficient differs from the final decode row (set
+        changes, whole-shard fallbacks) via c_new ⊗ c_old⁻¹."""
+        mgr = self.manager
+        present = tuple(sorted(final + zeros))
+        row = self._decode_row(k, m, present, target)
+        cfin = {idx: int(row[j]) for j, idx in enumerate(present)}
+        acc = np.zeros(want, dtype=np.uint8)
+        scale = getattr(mgr.codec, "gf_scale", gf256.gf_scale_bytes)
+        for i in final:
+            payload, c_app = results[i]
+            c_need = cfin[i]
+            if c_need == 0 or payload is None:
+                continue
+            if c_app == RAW:
+                data = scale(c_need, payload, want)
+            elif c_app == c_need:
+                data = payload[:want]
+            else:
+                data = scale(gf256.gf_mul(c_need, gf256.gf_inv(c_app)),
+                             payload, want)
+            if data:
+                arr = np.frombuffer(data, dtype=np.uint8)
+                acc[:len(arr)] ^= arr
+        return acc.tobytes()
+
+    async def _finish_shard(self, final: List[int], zeros: List[int],
+                            k: int, m: int, target: int, want: int,
+                            maxlen: int,
+                            results: Dict[int, Tuple[Optional[bytes], int]]
+                            ) -> Optional[bytes]:
+        """Whole-shard decode of exactly the k chosen pieces — batched
+        through the manager's codec feeder when the entry's geometry
+        matches the live codec (a repair storm's concurrent decodes
+        share one cached RS schedule and one ragged dispatch)."""
+        mgr = self.manager
+        present = sorted(final + zeros)
+        zset = set(zeros)
+        arrs = []
+        for idx in present:
+            a = np.zeros(maxlen, dtype=np.uint8)
+            if idx not in zset:
+                payload = results[idx][0] or b""
+                b = payload[:maxlen]
+                a[:len(b)] = np.frombuffer(b, dtype=np.uint8)
+            arrs.append(a)
+        shards = np.stack(arrs)[None, :, :]
+        feeder = getattr(mgr, "feeder", None)
+        live = feeder.codec.params if feeder is not None else None
+        if (feeder is not None and live.rs_data == k
+                and live.rs_parity == m):
+            out = await feeder.decode_async(shards, present, [target])
+        else:
+            from ..ops.codec import CodecParams
+            from ..ops.cpu_codec import CpuCodec
+
+            codec = CpuCodec(CodecParams(rs_data=k, rs_parity=m))
+            out = await asyncio.to_thread(
+                codec.rs_reconstruct, shards, present, [target])
+        return out[0, 0].tobytes()[:want]

@@ -43,6 +43,14 @@ logger = logging.getLogger("garage_tpu.model.parity_repair")
 # keep occupying slots, so the scan must look well past the live ones.
 INDEX_SCAN_LIMIT = 64
 
+# How long after a ring change an empty index quorum read is treated as
+# possibly BLIND (new replicas not yet synced) and worth a peer sweep;
+# table sync converges well inside this on any healthy cluster.
+INDEX_SWEEP_WINDOW_S = 15 * 60.0
+# Negative-cache TTL for members whose sweep came back empty — retry
+# storms pay one O(peers) sweep per TTL, not one per attempt.
+SWEEP_EMPTY_TTL_S = 60.0
+
 # Delay between "looks dead" and the irreversible index tombstone: long
 # enough for every node's insert queue to drain a just-queued live ref
 # (the worker pushes batches immediately; seconds covers a busy node).
@@ -317,6 +325,28 @@ def make_parity_reconstructor(garage):
         finally:
             IN_PARITY_DECODE.reset(token)
 
+    # The index sweep is O(peers) with per-peer timeouts — it must not
+    # fire for every genuinely-uncovered block (pre-EC data, parity
+    # shards themselves) a resync storm walks.  Two gates: the sweep
+    # only runs while a recent ring change makes a blind quorum read
+    # PLAUSIBLE (partitions moved, table sync may lag), and a member
+    # that just swept empty is negative-cached so retry storms pay one
+    # sweep per TTL, not one per attempt.
+    sweep_empty: dict = {}
+
+    def _sweep_worthwhile(hb: bytes) -> bool:
+        changed = getattr(garage.system, "ring_changed_at", None)
+        if (changed is None
+                or time.monotonic() - changed > INDEX_SWEEP_WINDOW_S):
+            return False
+        ts = sweep_empty.get(hb)
+        if ts is not None and time.monotonic() - ts < SWEEP_EMPTY_TTL_S:
+            return False
+        if len(sweep_empty) > 4096:  # bounded: drop the oldest entries
+            for k in sorted(sweep_empty, key=sweep_empty.get)[:1024]:
+                del sweep_empty[k]
+        return True
+
     async def _reconstruct_inner(h: Hash) -> Optional[bytes]:
         try:
             entries = await garage.parity_index_table.get_range(
@@ -324,16 +354,83 @@ def make_parity_reconstructor(garage):
         except Exception:
             logger.warning("parity index unreachable for %s",
                            bytes(h).hex()[:16], exc_info=True)
-            return None
-        for ent in entries:
-            if ent.is_tombstone():
-                continue
+            entries = []
+        live = [e for e in entries if not e.is_tombstone()]
+        # tombstone-only answers are NOT blind — a returned row proves
+        # table sync already copied the partition here; only a
+        # zero-row (or failed) quorum read can be hiding synced rows
+        # on the old replicas
+        if not entries and _sweep_worthwhile(bytes(h)):
+            # The quorum read is honest but can be BLIND right after a
+            # layout change: the member's index partition was reassigned
+            # and the NEW replicas answer "no rows" until table sync
+            # copies the partition over — while the rows still sit on
+            # the old replicas.  A recoverable block would stay
+            # unrecovered for a full sync cycle (observed: the degraded
+            # bench healed on its 60 s fallback kick, not the decode
+            # ladder).  Sweep alive peers for the rows instead — same
+            # philosophy as sweep_get_block: on repair paths,
+            # completeness beats elegance.
+            live = await _sweep_index_entries(garage, h)
+            if not live:
+                sweep_empty[bytes(h)] = time.monotonic()
+        for ent in live:
             data = await _try_codeword(garage, h, ent)
             if data is not None:
                 return data
         return None
 
     return reconstruct
+
+
+async def _sweep_index_entries(garage, h: Hash) -> list:
+    """Live parity-index rows for member `h` from ANY alive peer: local
+    store first (free), then every peer ordered likely-up-first, first
+    non-empty answer wins (rows for one member are written together, so
+    any holder has the full set; CRDT-merged across duplicates)."""
+    from ..table.schema import hash_partition_key
+
+    table = garage.parity_index_table
+    ph = hash_partition_key(bytes(h))
+
+    def decode_live(raws) -> dict:
+        out: dict = {}
+        for v in raws:
+            try:
+                ent = table.data.decode_entry(bytes(v))
+            except Exception:  # noqa: BLE001 — skip undecodable rows
+                continue
+            key = bytes(ent.sort_key)
+            if key in out:
+                out[key].merge(ent)
+            else:
+                out[key] = ent
+        return {k: e for k, e in out.items() if not e.is_tombstone()}
+
+    local = decode_live(table.data.read_range(
+        Hash(bytes(ph)), None, None, INDEX_SCAN_LIMIT, False))
+    if local:
+        return list(local.values())
+    msg = {"t": "read_range", "ph": bytes(ph), "sk": None, "filter": None,
+           "limit": INDEX_SCAN_LIMIT, "rev": False}
+    rpc = garage.system.rpc
+    peers = sorted(garage.system.peering.peers.items(),
+                   key=lambda kv: not kv[1].is_up)
+    tried = []
+    for nid, _st in peers:
+        try:
+            resp = await rpc.call(
+                table.endpoint, nid, msg, timeout=10.0, idempotent=True)
+            rows = decode_live(resp.get("vs", []))
+            if rows:
+                return list(rows.values())
+            tried.append(f"{bytes(nid).hex()[:8]}:empty")
+        except Exception as e:  # noqa: BLE001 — next peer
+            tried.append(f"{bytes(nid).hex()[:8]}:{type(e).__name__}")
+    if tried:
+        logger.info("index sweep for %s found nothing: %s",
+                    bytes(h).hex()[:12], tried)
+    return []
 
 
 async def _fetch_verified(garage, mh: bytes) -> Optional[bytes]:
@@ -352,6 +449,20 @@ async def _try_codeword(garage, h: Hash, ent) -> Optional[bytes]:
     if maxlen == 0 or target_i >= len(ent.members):
         return None
 
+    mgr = garage.block_manager
+    # planned, bandwidth-minimal path first (block/repair_plan.py):
+    # exact-k fetches ranked by RTT/breaker/zone, partial-sum (PPR)
+    # reconstruction when peers support it.  A planner miss falls
+    # through to the legacy gather below — its sweep-everything fetch
+    # is the completeness backstop (pieces stranded on non-ring nodes
+    # after layout churn), so a plan that comes up empty must not cost
+    # recoverability the old path had.
+    planner = getattr(mgr, "repair_planner", None)
+    if planner is not None:
+        data = await planner.reconstruct(h, ent)
+        if data is not None:
+            return data
+
     pieces, present = [], []
 
     def pad(raw: bytes) -> np.ndarray:
@@ -362,10 +473,17 @@ async def _try_codeword(garage, h: Hash, ent) -> Optional[bytes]:
     # surviving data members (fetched concurrently — they live on
     # different nodes, and a dead node costs a full timeout serially)
     others = [i for i in range(len(ent.members)) if i != target_i]
+    was_local = [mgr.is_block_present(Hash(ent.members[i])) for i in others]
     fetched = await asyncio.gather(
         *[_fetch_verified(garage, ent.members[i]) for i in others])
-    for i, raw in zip(others, fetched):
-        if raw is None or len(present) >= k:
+    for i, raw, loc in zip(others, fetched, was_local):
+        if raw is None:
+            continue
+        if not loc:
+            mgr.note_repair_fetch("gather", len(raw))
+        if len(present) >= k:
+            if not loc:  # only WIRE bytes count as overfetch waste
+                mgr.note_repair_overfetch(len(raw))
             continue
         pieces.append(pad(raw))
         present.append(i)
@@ -375,21 +493,40 @@ async def _try_codeword(garage, h: Hash, ent) -> Optional[bytes]:
             break
         pieces.append(np.zeros(maxlen, dtype=np.uint8))
         present.append(i)
-    # parity blocks as needed (verified blobs carry the salt header —
-    # strip it to get the shard bytes; see block/parity.py placement)
+    # parity blocks LAZILY, exactly the gap left by dead members — the
+    # old gather fetched all m unconditionally, moving (and discarding)
+    # up to (m-1) extra shards per degraded read.  Anything fetched
+    # beyond k still lands in repair_overfetch_bytes_total so residual
+    # waste is measured, not assumed away.  (`repair_gather_everything`
+    # restores the fetch-everything behavior — the bench's baseline
+    # emulation knob, never set in production.)
     if len(present) < k:
         from ..block.parity import unpack_parity_shard
 
-        pfetched = await asyncio.gather(
-            *[_fetch_verified(garage, ph) for ph in ent.parity_hashes])
-        for j, raw in enumerate(pfetched):
-            if raw is None or len(present) >= k:
-                continue
-            shard = unpack_parity_shard(raw)
-            if shard is None:
-                continue
-            pieces.append(pad(shard))
-            present.append(k + j)
+        pqueue = list(enumerate(ent.parity_hashes))
+        everything = bool(getattr(mgr, "repair_gather_everything", False))
+        while len(present) < k and pqueue:
+            need = len(pqueue) if everything else k - len(present)
+            batch, pqueue = pqueue[:need], pqueue[need:]
+            plocal = [mgr.is_block_present(Hash(ph)) for _j, ph in batch]
+            pfetched = await asyncio.gather(
+                *[_fetch_verified(garage, ph) for _j, ph in batch])
+            for (j, _ph), raw, loc in zip(batch, pfetched, plocal):
+                if raw is None:
+                    continue
+                if not loc:
+                    mgr.note_repair_fetch("gather", len(raw))
+                if len(present) >= k:
+                    if not loc:  # only WIRE bytes count as overfetch
+                        mgr.note_repair_overfetch(len(raw))
+                    continue
+                shard = unpack_parity_shard(raw)
+                if shard is None:
+                    if not loc:
+                        mgr.note_repair_overfetch(len(raw))
+                    continue
+                pieces.append(pad(shard))
+                present.append(k + j)
     if len(present) < k:
         logger.info(
             "codeword for %s unrecoverable: %d of %d pieces survive",
@@ -404,7 +541,6 @@ async def _try_codeword(garage, h: Hash, ent) -> Optional[bytes]:
     # geometry mismatch or absent feeder decodes through a throwaway
     # CPU codec as before.
     shards = np.stack(pieces)[None, :, :]
-    mgr = garage.block_manager
     feeder = getattr(mgr, "feeder", None)
     live = feeder.codec.params if feeder is not None else None
     try:
@@ -427,4 +563,5 @@ async def _try_codeword(garage, h: Hash, ent) -> Optional[bytes]:
         logger.warning("distributed decode of %s produced wrong hash",
                        bytes(h).hex()[:16])
         return None
+    mgr.note_repair_done(len(out))
     return out

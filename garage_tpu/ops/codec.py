@@ -143,6 +143,16 @@ class BlockCodec:
         device — the scrub/resync producers batch)."""
         return bool(self.batch_verify([block], [hash])[0])
 
+    def gf_scale(self, coeff: int, buf: bytes,
+                 limit: Optional[int] = None) -> bytes:
+        """coeff ⊗ buf over GF(2^8), truncated to `limit` — the
+        partial-parallel-repair kernel (survivor-side partial product and
+        coordinator-side rescale, block/repair_plan.py).  CpuCodec
+        overrides with the native GFNI kernel when built."""
+        from . import gf256
+
+        return gf256.gf_scale_bytes(coeff, buf, limit)
+
     def rs_encode_blocks(self, blocks: Sequence[bytes]) -> np.ndarray:
         """RS parity straight from a list of block buffers:
         (ceil(B/k), m, maxlen), blocks zero-extended to maxlen, the batch

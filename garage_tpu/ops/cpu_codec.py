@@ -104,8 +104,26 @@ class CpuCodec(BlockCodec):
         return self._native_ptrs(
             self._parity_mat, list(blocks) + [b""] * pad, maxlen)
 
-    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int],
-                       rows: Optional[Sequence[int]] = None) -> np.ndarray:
+    def gf_scale(self, coeff: int, buf: bytes,
+                 limit: Optional[int] = None) -> bytes:
+        b = buf[:limit] if limit is not None else buf
+        if coeff == 0 or not b:
+            return b""
+        if coeff == 1:
+            return bytes(b)
+        if self._native is not None:
+            mat = np.array([[coeff]], dtype=np.uint8)
+            arr = np.ascontiguousarray(
+                np.frombuffer(b, dtype=np.uint8)).reshape(1, -1)
+            return self._native(mat, arr)[0].tobytes()
+        return gf256.gf_scale_bytes(coeff, b)
+
+    def decode_matrix(self, present: Sequence[int],
+                      rows: Optional[Sequence[int]] = None) -> np.ndarray:
+        """Cached recovery matrix for one survivor pattern (optionally
+        sliced to `rows`) — the decode schedule shared by rs_reconstruct
+        and the repair planner's partial-sum coefficient rows
+        (block/repair_plan.py needs the row without running a decode)."""
         k, m = self.params.rs_data, self.params.rs_parity
         key = (tuple(present[:k]), tuple(rows) if rows is not None else None)
         dec = self._dec_cache.get(key)
@@ -116,4 +134,10 @@ class CpuCodec(BlockCodec):
             if len(self._dec_cache) >= 512:  # bounded: loss patterns are few
                 self._dec_cache.clear()
             self._dec_cache[key] = dec
+        return dec
+
+    def rs_reconstruct(self, shards: np.ndarray, present: Sequence[int],
+                       rows: Optional[Sequence[int]] = None) -> np.ndarray:
+        k = self.params.rs_data
+        dec = self.decode_matrix(present, rows)
         return self._apply(dec, np.ascontiguousarray(shards[..., :k, :], dtype=np.uint8))

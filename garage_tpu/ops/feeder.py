@@ -229,11 +229,12 @@ class CodecFeeder:
         except FeederClosed:
             return self.codec.rs_reconstruct(shards, present, rows)
 
-    async def hash_async(self, blocks: Sequence[bytes]):
+    async def hash_async(self, blocks: Sequence[bytes],
+                         peers: Optional[int] = None):
         import asyncio
 
         try:
-            fut = self.submit_hash(blocks)
+            fut = self.submit_hash(blocks, peers=peers)
         except FeederClosed:
             return await asyncio.to_thread(
                 self.codec.batch_hash, list(blocks))

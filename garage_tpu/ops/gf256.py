@@ -22,7 +22,7 @@ is again a (bit-)matmul with the inverted matrix.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -142,6 +142,28 @@ def rs_decode_matrix(k: int, m: int, present: Sequence[int]) -> np.ndarray:
     g = rs_generator_matrix(k, m)
     sub = g[rows]  # (k, k): shards[rows] = sub @ data
     return gf_matrix_inverse(sub)
+
+
+def rs_decode_row(k: int, m: int, present: Sequence[int],
+                  row: int) -> np.ndarray:
+    """One row of the recovery matrix: the k coefficients c_j with
+    data[row] = Σ_j c_j ⊗ shards[present[j]].  This is the wire contract
+    of partial-parallel repair (block/repair_plan.py): the coordinator
+    hands survivor j its coefficient c_j, each survivor ships
+    c_j ⊗ shard_j, and the coordinator only XOR-accumulates."""
+    return rs_decode_matrix(k, m, present)[row]
+
+
+def gf_scale_bytes(c: int, buf: bytes, limit: Optional[int] = None) -> bytes:
+    """c ⊗ buf elementwise over GF(2^8), truncated to the first `limit`
+    bytes — the survivor-side partial-product kernel.  c == 0 returns
+    b'' (a zero contribution ships no bytes)."""
+    b = buf[:limit] if limit is not None else buf
+    if c == 0 or not b:
+        return b""
+    if c == 1:
+        return bytes(b)
+    return gf_mul_vec(c, np.frombuffer(b, dtype=np.uint8)).tobytes()
 
 
 # --- byte-domain (CPU) kernels ---------------------------------------------

@@ -313,21 +313,25 @@ class RpcHelper:
         ordered list into the next zone).  Peers with no known zone (no
         committed layout, gateway-only tests) rank with the local band,
         which reproduces the pre-zone ordering exactly."""
+        return sorted(nodes, key=self.peer_rank)
+
+    def peer_rank(self, n: NodeID) -> tuple:
+        """The candidate-ordering score request_order sorts by, exposed
+        so planners can rank non-node resources by their best holder
+        (block/repair_plan.py ranks codeword pieces with it): band 0 =
+        self, 1 = local zone / unknown zone, 2 = cross-zone, 4 = breaker
+        open; within a band, measured latency before unknown."""
+        if n == self.our_id:
+            return (0, 0, 0.0)
+        if self.peering.breaker_state(n) == "open":
+            return (4, 0, 0.0)
         lz = self.local_zone()
-
-        def key(n: NodeID):
-            if n == self.our_id:
-                return (0, 0, 0.0)
-            if self.peering.breaker_state(n) == "open":
-                return (4, 0, 0.0)
-            nz = self.zone_of(n)
-            zband = 1 if (lz is None or nz is None or nz == lz) else 2
-            lat = self.peering.latency(n)
-            if lat is None:
-                return (zband, 1, 0.0)
-            return (zband, 0, lat)
-
-        return sorted(nodes, key=key)
+        nz = self.zone_of(n)
+        zband = 1 if (lz is None or nz is None or nz == lz) else 2
+        lat = self.peering.latency(n)
+        if lat is None:
+            return (zband, 1, 0.0)
+        return (zband, 0, lat)
 
     # --- single + many (ref rpc_helper.rs:121-172) ---
 

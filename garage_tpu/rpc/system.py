@@ -246,6 +246,11 @@ class System:
         old_members = set(self._zone_map)
         self.ring = Ring(self.layout)
         self._zone_map = self.layout.zone_map()
+        # when partitions last moved (monotonic): repair paths use this
+        # to decide whether an empty quorum read may be BLIND (new
+        # replicas not yet synced) and worth a cluster sweep — see
+        # model/parity_repair._sweep_index_entries
+        self.ring_changed_at = time.monotonic()
         # peers REMOVED from the committed layout are gone for good:
         # drop their peer-book entries, breaker state and per-peer
         # metric series, or `peer_up`/`peer_rtt_ewma_seconds`/
@@ -557,6 +562,17 @@ class System:
             partitions_quorum=p_quorum,
             partitions_all_ok=p_all,
         )
+
+    def peer_version(self, nid) -> Optional[str]:
+        """Software version `nid` last gossiped (status exchange), ours
+        for self, None when unknown — the capability gate mixed-version
+        rollouts key on (e.g. block/repair_plan.py sends the `ppr`
+        partial-product RPC only to peers new enough to answer it, and
+        falls back to whole-shard fetch otherwise)."""
+        if bytes(nid) == bytes(self.id):
+            return self.version
+        st = self.node_status.get(FixedBytes32(bytes(nid)))
+        return st.version if st is not None else None
 
     def get_known_nodes(self) -> List[dict]:
         """Peer list for status displays (ids as hex, JSON-safe)."""

@@ -642,3 +642,41 @@ class FaultInjector:
             f.seek(at)
             f.write(bytes([(b[0] if b else 0) ^ 0xFF]))
         return True
+
+
+async def crash_heaviest_and_drop(inj: FaultInjector, skip=(0,),
+                                  resync_workers: int = 4):
+    """Shared repair-storm opener (bench --repair-storm-phase and
+    scripts/chaos.py repair_storm): crash the heaviest data holder not
+    in `skip` (typically the gateway), drop it from the committed
+    layout, hand every survivor the new ring and a raised resync worker
+    count.  Returns (victim_index, lost_bytes, survivors) — the heal
+    itself is the product's own layout-sweep/resync path, which the
+    callers then observe in their own ways."""
+    from ..rpc.layout import ClusterLayout
+
+    garages = inj.garages
+    sizes = []
+    for i in range(len(garages)):
+        if i in skip or i in inj.dead:
+            continue
+        n = sum(os.path.getsize(p) for p in inj._block_files(i))
+        sizes.append((n, i))
+    lost, victim = max(sizes)
+    await inj.crash(victim)
+    # inj.dead includes the new victim AND any earlier casualties — a
+    # second storm on the same injector must not touch closed nodes
+    src = next(g for i, g in enumerate(garages) if i not in inj.dead)
+    lay = ClusterLayout.decode(src.system.layout.encode())
+    lay.stage_role(bytes(garages[victim].system.id), None)
+    lay.apply_staged_changes()
+    enc = lay.encode()
+    survivors = []
+    for i, g in enumerate(garages):
+        if i in inj.dead:
+            continue
+        g.system.layout = ClusterLayout.decode(enc)
+        g.system._rebuild_ring()
+        g.block_resync.set_n_workers(resync_workers)
+        survivors.append(g)
+    return victim, lost, survivors

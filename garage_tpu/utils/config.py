@@ -131,6 +131,18 @@ class CodecConfig:
     feeder: bool = True
     feeder_slo_ms: float = 2.0
     feeder_max_batch_blocks: int = 256
+    # --- repair-bandwidth-optimal degraded reads (block/repair_plan.py):
+    # exact-k survivor selection ranked by RTT EWMA / breaker state /
+    # zone locality, hedged ranked replacements, and partial-parallel
+    # repair (survivors ship GF-scaled partial sums via the `ppr` block
+    # RPC instead of whole shards).  repair_planner=False restores the
+    # legacy sweep-everything gather; repair_ppr=False keeps exact-k
+    # planning but fetches whole shards.  repair_hedge_ms > 0 pins the
+    # stalled-fetch hedge delay; 0 derives it from the block endpoint's
+    # observed latency quantile.
+    repair_planner: bool = True
+    repair_ppr: bool = True
+    repair_hedge_ms: float = 0.0
 
     def make(self, compression_level: Optional[int] = 1,
              metrics=None, tracer=None, block_size: Optional[int] = None):
@@ -378,6 +390,8 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         raise ConfigError("codec.feeder_slo_ms must be >= 0")
     if cfg.codec.feeder_max_batch_blocks < 1:
         raise ConfigError("codec.feeder_max_batch_blocks must be >= 1")
+    if cfg.codec.repair_hedge_ms < 0:
+        raise ConfigError("codec.repair_hedge_ms must be >= 0")
 
     # secrets: env overrides > `<key>_file` in TOML > inline value
     for key, env in _SECRET_ENV.items():

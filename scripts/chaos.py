@@ -66,6 +66,10 @@ PHASES = ("baseline", "latency", "flaky", "oneway", "partition",
 # must come last — a rolling zone restart after a drain would take out
 # 2 of 3 replicas on layouts that can no longer spread wider
 ZONE_PHASES = ("zone_blackhole", "rolling", "zone_drain")
+# node-kill repair storm on its own EC cluster (ISSUE 8): heal must
+# complete with zero client errors AND the planned repair path must move
+# no more bytes per repaired byte than the whole-shard exact-k baseline
+STORM_PHASES = ("repair_storm",)
 
 
 def _apply(inj, phase):
@@ -230,6 +234,156 @@ async def run(phases, secs):
     return summary
 
 
+async def run_repair_storm(secs):
+    """ISSUE 8 CI drill: one node of a 6-node RS(2,2) EC cluster (meta
+    "3", data "none", write-time distributed parity) is crashed and
+    dropped from the layout while client PUT/GET traffic keeps running.
+    Asserts: the storm stays CLIENT-INVISIBLE (zero errors — degraded
+    reads decode through the repair planner), every acked object heals
+    bit-identically, and the planned path's repair bytes-per-byte stays
+    at or under the whole-shard exact-k baseline of k."""
+    import aiohttp
+    import numpy as np
+
+    import bench
+    from garage_tpu.testing.faults import (
+        FAST_CHAOS_RPC,
+        FaultInjector,
+        crash_heaviest_and_drop,
+    )
+
+    rng = random.Random(808)
+    nprng = np.random.default_rng(88)
+    summary = {"phases": {}, "ok": True}
+    stats = {"puts": 0, "gets": 0, "errors": 0}
+    with tempfile.TemporaryDirectory(prefix="garage_storm_") as tmp:
+        from pathlib import Path
+
+        garages, server, port, kid, secret = await bench._mk_cluster(
+            Path(tmp), n=6, repl="3", data_repl="none", db="memory",
+            codec_cfg={"rs_data": 2, "rs_parity": 2,
+                       "store_parity": True, "parity_on_write": True,
+                       "parity_distribute": True, "backend": "cpu"},
+            rpc_cfg=FAST_CHAOS_RPC)
+        inj = FaultInjector(garages)
+        try:
+            async with aiohttp.ClientSession() as session:
+                s3 = bench._S3(session, port, kid, secret)
+                st, _b, _h = await s3.req("PUT", "/storm")
+                assert st == 200, f"bucket create: {st}"
+                acked = {}
+                for i in range(10):
+                    body = nprng.integers(
+                        0, 256, rng.randrange(256 << 10, 1 << 20),
+                        dtype=np.uint8).tobytes()
+                    st, _b, _h = await s3.req(
+                        "PUT", f"/storm/seed-{i:03d}", body)
+                    if st == 200:
+                        acked[f"seed-{i:03d}"] = body
+                        stats["puts"] += 1
+                    else:
+                        stats["errors"] += 1
+                for g in garages:
+                    if g.block_manager.ec_accumulator is not None:
+                        await g.block_manager.ec_accumulator.drain()
+                await asyncio.sleep(1.5)  # distributor indexing
+
+                # kill the heaviest non-gateway data holder, drop it
+                # from the layout — the product's own heal path runs
+                _victim, _lost, survivors = await crash_heaviest_and_drop(
+                    inj, resync_workers=2)
+
+                def fetched():
+                    return sum(
+                        sum(g.block_manager.repair_fetch_bytes.values())
+                        for g in survivors)
+
+                def repaired_bytes():
+                    return sum(g.block_manager.repair_repaired_bytes
+                               for g in survivors)
+
+                f0, r0 = fetched(), repaired_bytes()
+                # live traffic THROUGH the storm
+                lats = []
+                deadline = time.monotonic() + secs
+                i = 0
+                while time.monotonic() < deadline:
+                    i += 1
+                    body = nprng.integers(
+                        0, 256, rng.randrange(64 << 10, 256 << 10),
+                        dtype=np.uint8).tobytes()
+                    t0 = time.perf_counter()
+                    st, _b, _h = await s3.req(
+                        "PUT", f"/storm/live-{i:04d}", body)
+                    lats.append(time.perf_counter() - t0)
+                    if st == 200:
+                        acked[f"live-{i:04d}"] = body
+                        stats["puts"] += 1
+                    else:
+                        stats["errors"] += 1
+                    probe = rng.choice(sorted(acked))
+                    t0 = time.perf_counter()
+                    st, got, _h = await s3.req("GET", f"/storm/{probe}")
+                    lats.append(time.perf_counter() - t0)
+                    if st == 200 and got == acked[probe]:
+                        stats["gets"] += 1
+                    else:
+                        stats["errors"] += 1
+                # heal completion: every acked object bit-identical
+                pending = dict(acked)
+                heal_deadline = time.monotonic() + 120
+                while pending and time.monotonic() < heal_deadline:
+                    for name in list(pending):
+                        try:
+                            st, got, _h = await asyncio.wait_for(
+                                s3.req("GET", f"/storm/{name}"), 30)
+                        except Exception:
+                            stats["errors"] += 1
+                            continue
+                        if st == 200 and got == pending[name]:
+                            del pending[name]
+                        else:
+                            stats["errors"] += 1
+                    if pending:
+                        await asyncio.sleep(1.0)
+                stats["unhealed"] = len(pending)
+                summary["ok"] &= len(pending) == 0
+                moved = fetched() - f0
+                repaired = repaired_bytes() - r0
+                k = garages[0].config.codec.rs_data
+                stats["repaired_bytes"] = repaired
+                stats["repair_bytes_per_byte"] = round(
+                    moved / max(1, repaired), 3)
+                stats["repair_ppr_fallbacks"] = sum(
+                    g.block_manager.repair_ppr_fallbacks
+                    for g in survivors)
+                stats["repair_overfetch_bytes"] = sum(
+                    g.block_manager.repair_overfetch_bytes
+                    for g in survivors)
+                # planned path ≤ whole-shard exact-k baseline (k fetched
+                # bytes per repaired byte; small slack for wire headers)
+                summary["ok"] &= repaired > 0
+                summary["ok"] &= (
+                    stats["repair_bytes_per_byte"] <= k + 0.25)
+                lats.sort()
+                stats["ops"] = len(lats)
+                if lats:
+                    stats["p50_ms"] = round(
+                        lats[len(lats) // 2] * 1000, 2)
+                    stats["p99_ms"] = round(
+                        lats[min(len(lats) - 1,
+                                 int(len(lats) * 0.99))] * 1000, 2)
+                summary["phases"]["repair_storm"] = stats
+                summary["ok"] &= stats["errors"] == 0
+                print(f"phase repair_storm: {stats}", file=sys.stderr)
+        finally:
+            await server.stop()
+            for i, g in enumerate(inj.garages):
+                if i not in inj.dead:
+                    await g.shutdown()
+    return summary
+
+
 async def run_zone(phases, secs, n_storage, n_zones):
     """The zone-scale drills on one SimCluster (built once, phases run
     in order — blackhole heals before drain, drain precedes rolling)."""
@@ -285,7 +439,7 @@ async def run_zone(phases, secs, n_storage, n_zones):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    all_phases = PHASES + ZONE_PHASES
+    all_phases = PHASES + ZONE_PHASES + STORM_PHASES
     ap.add_argument("--phases", default=",".join(PHASES),
                     help="comma-separated subset of " + ",".join(all_phases))
     ap.add_argument("--secs", type=float, default=8.0,
@@ -305,6 +459,7 @@ def main():
     secs = 3.0 if args.quick else args.secs
     node_phases = [p for p in phases if p in PHASES]
     zone_phases = [p for p in phases if p in ZONE_PHASES]
+    storm_phases = [p for p in phases if p in STORM_PHASES]
     if zone_phases:
         # the drills name zones z2/z{n} and a rolling restart only stays
         # client-invisible when every partition keeps ≥2 live zones
@@ -323,6 +478,10 @@ def main():
         s = asyncio.run(run_zone(zone_phases, secs, args.nodes, args.zones))
         summary["phases"].update(s["phases"])
         summary["cluster"] = s.get("cluster")
+        summary["ok"] &= s["ok"]
+    if storm_phases:
+        s = asyncio.run(run_repair_storm(secs))
+        summary["phases"].update(s["phases"])
         summary["ok"] &= s["ok"]
     print("CHAOS " + json.dumps(summary))
     if not summary["ok"]:

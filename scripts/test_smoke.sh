@@ -22,4 +22,10 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
 # restart with a bumped version — all with zero client errors
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
     --phases zone_blackhole,zone_drain,rolling --nodes 6 --zones 3
+# repair-storm smoke (small shape of the ISSUE-8 acceptance drive): one
+# node of an EC cluster killed under live load — heal completes with
+# zero client errors and the planned repair path moves no more than the
+# whole-shard exact-k baseline (bytes/byte ≤ k)
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases repair_storm
 echo "SMOKE+CHAOS OK"

@@ -1,0 +1,420 @@
+"""RepairPlanner — exact-k fetch planning + partial-parallel repair.
+
+Unit half: a stub-fetch planner over hand-built codewords proves the
+plan never requests more than k pieces up front, ranks breaker-open and
+cross-zone survivors last, hedges a ranked replacement when a fetch
+stalls, replaces failed fetches, and XOR-accumulates PPR partial sums
+bit-identically (including coefficient rescale after a survivor-set
+change).
+
+Cluster half: a real EC cluster drill proves the planned path and the
+`ppr` block RPC reconstruct bit-identically end to end, and that a
+mixed-version peer (gossiping a pre-PPR version) falls back to
+whole-shard fetch without losing correctness.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from garage_tpu.block.repair_plan import (
+    RAW,
+    RepairPlanner,
+    _Piece,
+    parse_version,
+)
+from garage_tpu.ops import gf256
+from garage_tpu.utils.data import Hash, blake2s_sum
+from garage_tpu.utils.error import GarageError
+
+pytestmark = pytest.mark.asyncio
+
+
+# --- unit-half fakes ---------------------------------------------------------
+
+
+class FakeRpc:
+    def __init__(self, ranks=None):
+        self.ranks = ranks or {}
+        self.m_duration = None
+
+    def peer_rank(self, n):
+        return self.ranks.get(bytes(n), (1, 1, 0.0))
+
+    def request_order(self, nodes):
+        return sorted(nodes, key=self.peer_rank)
+
+
+class FakeSystem:
+    def __init__(self, ranks=None):
+        self.rpc = FakeRpc(ranks)
+        self.id = b"\x00" * 32
+
+    def peer_version(self, nid):
+        return None
+
+
+class FakeReplication:
+    def __init__(self, holders):
+        self.holders = holders  # piece hash -> [node ids]
+
+    def read_nodes(self, h):
+        return self.holders.get(bytes(h), [b"\x01" * 32])
+
+
+class FakeManager:
+    def __init__(self, holders=None, ranks=None):
+        self.system = FakeSystem(ranks)
+        self.replication = FakeReplication(holders or {})
+        self.codec = object()   # no decode_matrix → gf256 fallback
+        self.feeder = None
+        self.hash_algo = "blake2s"
+        self.block_rpc_timeout = 1.0
+        self.counters = {"fetch": {}, "repaired": 0, "overfetch": 0,
+                         "hedges": 0, "ppr_fallbacks": 0}
+
+    def is_block_present(self, h):
+        return False
+
+    def note_repair_fetch(self, mode, n):
+        f = self.counters["fetch"]
+        f[mode] = f.get(mode, 0) + n
+
+    def note_repair_done(self, n):
+        self.counters["repaired"] += n
+
+    def note_repair_overfetch(self, n):
+        self.counters["overfetch"] += n
+
+    def note_repair_hedge(self):
+        self.counters["hedges"] += 1
+
+    def note_repair_ppr_fallback(self):
+        self.counters["ppr_fallbacks"] += 1
+
+
+class StubPlanner(RepairPlanner):
+    """Planner with the network replaced by a shard dictionary; per-piece
+    behavior ('stall' | 'fail') drives the hedging/replacement tests."""
+
+    def __init__(self, mgr, shards, **kw):
+        super().__init__(mgr, **kw)
+        self.shards = shards          # piece hash -> unpacked shard bytes
+        self.behavior = {}            # piece hash -> "stall" | "fail"
+        self.fetch_log = []
+
+    async def _maybe(self, piece):
+        b = self.behavior.get(piece.hash)
+        if b == "stall":
+            await asyncio.sleep(30)
+        if b == "fail":
+            raise GarageError("injected piece failure")
+
+    async def _fetch_whole(self, piece):
+        self.fetch_log.append(("whole", piece.index))
+        await self._maybe(piece)
+        sh = self.shards[piece.hash]
+        return sh, RAW, len(sh)
+
+    async def _fetch_ppr(self, piece, coeff, want):
+        self.fetch_log.append(("ppr", piece.index, coeff))
+        await self._maybe(piece)
+        body = gf256.gf_scale_bytes(coeff, self.shards[piece.hash], want)
+        return body, int(coeff), len(body)
+
+
+class Ent:
+    def __init__(self, k, m, member_index, members, lengths, parity_hashes):
+        self.k, self.m = k, m
+        self.member_index = member_index
+        self.members = members
+        self.lengths = lengths
+        self.parity_hashes = parity_hashes
+
+
+def make_codeword(k=3, m=2, sizes=(900, 700, 500), seed=7):
+    """A real RS(k, m) codeword over random members of varying length:
+    (ent, shards{hash: bytes}, member bytes)."""
+    rng = np.random.default_rng(seed)
+    datas = [rng.integers(0, 256, s, dtype=np.uint8).tobytes()
+             for s in sizes]
+    maxlen = max(sizes)
+    arr = np.zeros((k, maxlen), dtype=np.uint8)
+    for i, d in enumerate(datas):
+        arr[i, :len(d)] = np.frombuffer(d, dtype=np.uint8)
+    parity = gf256.gf_matmul_blocks(gf256.rs_parity_matrix(k, m), arr[None])[0]
+    mh = [bytes(blake2s_sum(d)) for d in datas]
+    ph = [bytes(blake2s_sum(parity[j].tobytes())) for j in range(m)]
+    shards = {h: d for h, d in zip(mh, datas)}
+    shards.update({h: parity[j].tobytes() for j, h in enumerate(ph)})
+    ent = Ent(k, m, 0, mh, list(sizes), ph)
+    return ent, shards, datas
+
+
+async def test_planner_requests_exactly_k_pieces_up_front():
+    ent, shards, datas = make_codeword()
+    target = Hash(ent.members[0])
+    for use_ppr in (False, True):
+        mgr = FakeManager()
+        pl = StubPlanner(mgr, shards, use_ppr=use_ppr)
+        out = await pl.reconstruct(target, ent)
+        assert out == datas[0]
+        # k = 3, no implicit zeros → exactly 3 fetches, never the 4th
+        # candidate (4 = 2 surviving members + 2 parity)
+        assert len(pl.fetch_log) == 3, pl.fetch_log
+        kinds = {f[0] for f in pl.fetch_log}
+        assert kinds == ({"ppr"} if use_ppr else {"whole"})
+        assert mgr.counters["repaired"] == len(datas[0])
+        assert mgr.counters["overfetch"] == 0
+    # PPR moves only target-row-sized partials: ≤ whole-shard bytes
+    ppr_bytes = sum(len(gf256.gf_scale_bytes(1, shards[h], ent.lengths[0]))
+                    for h in list(shards)[:3])
+    assert ppr_bytes <= sum(len(v) for v in list(shards.values())[:3])
+
+
+async def test_ppr_moves_fewer_bytes_than_whole_shard():
+    # target is the SHORT member: partials truncate to its length
+    ent, shards, datas = make_codeword(sizes=(300, 1000, 1000))
+    target = Hash(ent.members[0])
+    mgr_p = FakeManager()
+    out = await StubPlanner(mgr_p, shards, use_ppr=True).reconstruct(
+        target, ent)
+    assert out == datas[0]
+    mgr_s = FakeManager()
+    out2 = await StubPlanner(mgr_s, shards, use_ppr=False).reconstruct(
+        target, ent)
+    assert out2 == datas[0]
+    ppr = sum(mgr_p.counters["fetch"].values())
+    shard = sum(mgr_s.counters["fetch"].values())
+    assert 0 < ppr < shard, (ppr, shard)
+    # exact bound: k partials of ≤ target-length each
+    assert ppr <= ent.k * ent.lengths[0]
+
+
+async def test_breaker_open_and_cross_zone_rank_last():
+    n_local, n_cross, n_open, n_par = (b"\x11" * 32, b"\x22" * 32,
+                                       b"\x33" * 32, b"\x44" * 32)
+    ranks = {
+        n_local: (1, 0, 0.002),   # local zone, fast
+        n_cross: (2, 0, 0.001),   # cross-zone (faster RTT, still later)
+        n_open: (4, 0, 0.0),      # breaker open
+        n_par: (1, 0, 0.005),     # healthy parity holder
+    }
+    pieces = [
+        _Piece(0, b"A" * 32, "data"),   # held by open-breaker peer only
+        _Piece(1, b"B" * 32, "data"),   # cross-zone
+        _Piece(2, b"C" * 32, "data"),   # local zone
+        _Piece(3, b"D" * 32, "parity"),  # healthy parity
+    ]
+    holders = {b"A" * 32: [n_open], b"B" * 32: [n_cross],
+               b"C" * 32: [n_local], b"D" * 32: [n_par]}
+    mgr = FakeManager(holders=holders, ranks=ranks)
+    got = [p.index for p in RepairPlanner(mgr).rank_pieces(pieces)]
+    # local data < cross-zone data < healthy parity < breaker-open data
+    assert got == [2, 1, 3, 0], got
+
+
+async def test_hedged_replacement_fires_on_stalled_fetch():
+    ent, shards, datas = make_codeword(k=2, m=2, sizes=(640, 480))
+    target = Hash(ent.members[0])
+    for use_ppr in (False, True):
+        mgr = FakeManager()
+        pl = StubPlanner(mgr, shards, use_ppr=use_ppr, hedge_delay=0.05)
+        pl.behavior[ent.members[1]] = "stall"  # the surviving data member
+        out = await pl.reconstruct(target, ent)
+        assert out == datas[0]
+        assert pl.hedges >= 1
+        assert mgr.counters["hedges"] >= 1
+        # the stalled fetch was abandoned: decode came from the two
+        # parity pieces (2 completed fetches + the stalled one launched)
+        assert len(pl.fetch_log) == 3, pl.fetch_log
+
+
+async def test_failed_fetch_launches_ranked_replacement():
+    ent, shards, datas = make_codeword()
+    target = Hash(ent.members[0])
+    for use_ppr in (False, True):
+        mgr = FakeManager()
+        pl = StubPlanner(mgr, shards, use_ppr=use_ppr, hedge_delay=5.0)
+        pl.behavior[ent.members[2]] = "fail"
+        out = await pl.reconstruct(target, ent)
+        assert out == datas[0]
+        # 3 initial + 1 replacement for the failed piece
+        assert len(pl.fetch_log) == 4, pl.fetch_log
+
+
+async def test_ppr_rescale_after_set_change_is_bit_identical():
+    """A failed fetch changes the survivor set AFTER partials were
+    computed under the old coefficients — the coordinator must rescale
+    them (c_new ⊗ c_old⁻¹) rather than refetch."""
+    ent, shards, datas = make_codeword(k=4, m=2,
+                                       sizes=(1000, 900, 800, 700))
+    target = Hash(ent.members[0])
+    mgr = FakeManager()
+    pl = StubPlanner(mgr, shards, use_ppr=True, hedge_delay=5.0)
+    pl.behavior[ent.members[3]] = "fail"  # forces parity replacement
+    out = await pl.reconstruct(target, ent)
+    assert out == datas[0]
+    assert len(pl.fetch_log) == 5  # 4 planned + 1 replacement
+
+
+async def test_ppr_whole_shard_fallback_is_raw_scaled():
+    """A piece whose PPR fetch degrades to whole-shard (RAW sentinel)
+    still lands in a bit-identical XOR accumulation."""
+    ent, shards, datas = make_codeword()
+    target = Hash(ent.members[0])
+
+    class FallbackPlanner(StubPlanner):
+        async def _fetch_ppr(self, piece, coeff, want):
+            if piece.index == 1:  # this survivor "predates" the endpoint
+                self.ppr_fallbacks += 1
+                self.manager.note_repair_ppr_fallback()
+                sh = self.shards[piece.hash]
+                self.fetch_log.append(("whole", piece.index))
+                return sh, RAW, len(sh)
+            return await super()._fetch_ppr(piece, coeff, want)
+
+    mgr = FakeManager()
+    pl = FallbackPlanner(mgr, shards, use_ppr=True)
+    out = await pl.reconstruct(target, ent)
+    assert out == datas[0]
+    assert pl.ppr_fallbacks == 1
+    assert mgr.counters["ppr_fallbacks"] == 1
+
+
+def test_parse_version():
+    assert parse_version("0.9.0") == (0, 9, 0)
+    assert parse_version("0.8.3-old") == (0, 8, 3)
+    assert parse_version("1.2") == (1, 2, 0)
+    assert parse_version(None) is None
+    assert parse_version("devbuild") is None
+
+
+# --- cluster half: real `ppr` RPC + mixed-version fallback -------------------
+
+
+async def _wait_indexed(garages, hs, secs=25.0):
+    """Wait until every member hash has a live parity-index entry."""
+    deadline = asyncio.get_event_loop().time() + secs
+    entries = {}
+    while asyncio.get_event_loop().time() < deadline:
+        entries = {}
+        for h in hs:
+            ents = await garages[0].parity_index_table.get_range(
+                bytes(h), None)
+            live = [e for e in ents if not e.is_tombstone()]
+            entries[bytes(h)] = live[0] if live else None
+        if all(entries.values()):
+            return entries
+        await asyncio.sleep(0.1)
+    raise AssertionError("write-time parity never distributed")
+
+
+async def test_cluster_ppr_drill_bit_identical_with_mixed_version(tmp_path):
+    """EC cluster drill: the planned PPR path reconstructs bit-identical
+    bytes over the real `ppr` RPC; with one peer gossiping a pre-PPR
+    version, its pieces fall back to whole-shard fetch and the result is
+    STILL bit-identical."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_model import make_ec_cluster, shutdown
+
+    garages = await make_ec_cluster(tmp_path, 5, rs=(2, 2))
+    try:
+        datas = [os.urandom(30_000 + 1013 * i) for i in range(8)]
+        hs = [blake2s_sum(d) for d in datas]
+        for h, d in zip(hs, datas):
+            await garages[0].block_manager.rpc_put_block(h, d)
+        for g in garages:
+            await g.block_manager.ec_accumulator.drain()
+        entries = await _wait_indexed(garages, hs)
+
+        # gossip the status so peer versions are known cluster-wide
+        for g in garages:
+            await g.system.rpc.broadcast(
+                g.system.endpoint,
+                {"t": "advertise_status",
+                 "status": g.system._local_status().pack(),
+                 "peers": g.system._peer_book()},
+                timeout=5.0)
+
+        # coordinate from a node that does NOT hold the first block
+        def holder_of(bh):
+            return bytes(garages[0].block_manager.replication.write_nodes(
+                Hash(bh))[0])
+
+        coord = next(g for g in garages
+                     if bytes(g.system.id) != holder_of(hs[0]))
+        planner = coord.block_manager.repair_planner
+        assert planner is not None and planner.use_ppr
+
+        before = dict(coord.block_manager.repair_fetch_bytes)
+        out = await planner.reconstruct(Hash(hs[0]), entries[bytes(hs[0])])
+        assert out == datas[0], "PPR reconstruction not bit-identical"
+        after = coord.block_manager.repair_fetch_bytes
+        assert after.get("ppr", 0) > before.get("ppr", 0), \
+            "no partial products moved"
+
+        # mixed-version: one OTHER node gossips a pre-PPR version; the
+        # planner must stop sending it `ppr` and whole-shard its pieces
+        old = next(g for g in garages
+                   if bytes(g.system.id) != bytes(coord.system.id))
+        old.system.version = "0.1.0"
+        await old.system.rpc.broadcast(
+            old.system.endpoint,
+            {"t": "advertise_status",
+             "status": old.system._local_status().pack(),
+             "peers": old.system._peer_book()},
+            timeout=5.0)
+        assert parse_version(
+            coord.system.peer_version(old.system.id)) == (0, 1, 0)
+
+        # deterministic capability-gate check: fetch a piece whose SOLE
+        # holder (data replication "none") is the old-version node — the
+        # planner must refuse to send it `ppr` and fall back to a
+        # whole-shard fetch (RAW), still moving the verified bytes
+        from garage_tpu.block.repair_plan import _Piece
+
+        old_piece = next(
+            (bytes(h) for h in hs
+             if holder_of(bytes(h)) == bytes(old.system.id)), None)
+        assert old_piece is not None, "no block landed on the old node"
+        c2 = next(g for g in garages
+                  if bytes(g.system.id) != bytes(old.system.id)
+                  and not g.block_manager.is_block_present(
+                      Hash(old_piece)))
+        pl2 = c2.block_manager.repair_planner
+        fb_before = c2.block_manager.repair_ppr_fallbacks
+        payload, c_app, moved = await pl2._fetch_ppr(
+            _Piece(0, old_piece, "data"), 7, 4096)
+        from garage_tpu.block.repair_plan import RAW as RAW_SENTINEL
+        assert c_app == RAW_SENTINEL, "old-version peer was sent ppr"
+        assert moved > 0 and payload, "fallback moved no bytes"
+        assert c2.block_manager.repair_ppr_fallbacks == fb_before + 1
+
+        # and end-to-end: every codeword touching the old node still
+        # reconstructs bit-identically through the planner
+        planner._row_cache.clear()
+        hit = 0
+        for h in hs:
+            ent = entries[bytes(h)]
+            piece_hashes = ([m for m in ent.members
+                             if bytes(m) != bytes(h)]
+                            + list(ent.parity_hashes))
+            on_old = any(holder_of(p) == bytes(old.system.id)
+                         for p in piece_hashes)
+            if not on_old:
+                continue
+            c = next(g for g in garages
+                     if bytes(g.system.id) != holder_of(bytes(h)))
+            got = await c.block_manager.repair_planner.reconstruct(
+                Hash(h), ent)
+            assert got == datas[hs.index(h)], \
+                "mixed-version reconstruction not bit-identical"
+            hit += 1
+        assert hit > 0, "no codeword touched the old-version node"
+    finally:
+        await shutdown(garages)
