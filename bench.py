@@ -678,7 +678,7 @@ MP_TIME_CAP = 300.0
 
 async def _mk_cluster(tmp, n=1, repl="none", codec_cfg=None, quotas=None,
                       data_repl=None, db="native", wan_delay=None,
-                      proxies_out=None, rpc_cfg=None):
+                      proxies_out=None, rpc_cfg=None, api_cfg=None):
     """n in-process Garage daemons with an applied layout + one S3 server
     on node 0; returns (garages, server, port, key_id, secret)."""
     from garage_tpu.api.s3.api_server import S3ApiServer
@@ -703,6 +703,8 @@ async def _mk_cluster(tmp, n=1, repl="none", codec_cfg=None, quotas=None,
             cfg["codec"] = dict(codec_cfg)
         if rpc_cfg:
             cfg["rpc"] = dict(rpc_cfg)
+        if api_cfg:
+            cfg["api"] = dict(api_cfg)
         garages.append(Garage(config_from_dict(cfg)))
     for g in garages:
         await g.system.netapp.listen("127.0.0.1:0")
@@ -1498,6 +1500,103 @@ async def _put_batched_phase_async() -> dict:
     return out
 
 
+async def _overload_phase_async() -> dict:
+    """Saturation baseline (ISSUE 10): goodput + foreground p99 + shed
+    rate at 1×/2×/4× the admission gate's capacity, on a 3-replica
+    cluster whose gateway caps in-flight requests at a small watermark.
+    The defined-overload contract this measures: offered load beyond
+    capacity turns into typed 503 SlowDown sheds (cheap, early), NOT
+    into queueing — so goodput should hold ≈ capacity and admitted p99
+    should stay flat across the ladder.  Gives the next perf PR a
+    saturation reference to compare scheduling changes against."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    cap = 4          # [api] max_inflight on every node (gateway matters)
+    level_secs = 6.0
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="garage_tpu_bench_ovl_"))
+    try:
+        garages, server, port, kid, secret = await _mk_cluster(
+            tmp, n=3, repl="3", db="memory",
+            codec_cfg={"backend": "cpu", "rs_data": 0, "rs_parity": 0},
+            api_cfg={"max_inflight": cap, "governor_tau": 0.5})
+        g0 = garages[0]
+        rng = np.random.default_rng(23)
+        payload = rng.integers(0, 256, 64 << 10, dtype=np.uint8).tobytes()
+        out: dict = {}
+        async with aiohttp.ClientSession() as session:
+            s3 = _S3(session, port, kid, secret)
+            st, _b, _h = await s3.req("PUT", "/ovl")
+            assert st == 200, st
+
+            async def drive(mult: int) -> dict:
+                lats, shed, errs = [], 0, 0
+                seq = [0]
+                deadline = time.monotonic() + level_secs
+
+                async def worker():
+                    nonlocal shed, errs
+                    while time.monotonic() < deadline:
+                        seq[0] += 1
+                        name = f"x{mult}-{seq[0]:06d}"
+                        t0 = time.perf_counter()
+                        try:
+                            st, _b, _h = await asyncio.wait_for(
+                                s3.req("PUT", f"/ovl/{name}", payload), 30.0)
+                        except Exception:  # noqa: BLE001 — hang/transport
+                            errs += 1
+                            continue
+                        took = time.perf_counter() - t0
+                        if st == 200:
+                            lats.append(took)
+                        elif st == 503:
+                            shed += 1
+                            await asyncio.sleep(0.02)
+                        else:
+                            errs += 1
+
+                t_run0 = time.monotonic()
+                await asyncio.gather(
+                    *[worker() for _ in range(mult * cap)])
+                dt = time.monotonic() - t_run0
+                lats.sort()
+                offered = len(lats) + shed + errs
+                return {
+                    "offered_x": mult,
+                    "goodput_puts_s": round(len(lats) / dt, 2),
+                    "offered_puts_s": round(offered / dt, 2),
+                    "p50_ms": round(
+                        lats[len(lats) // 2] * 1000, 2) if lats else None,
+                    "p99_ms": round(
+                        lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+                        * 1000, 2) if lats else None,
+                    "shed": shed,
+                    "shed_rate": round(shed / max(offered, 1), 4),
+                    "errors": errs,
+                    "throttle_ratio": round(g0.governor.ratio(), 3),
+                }
+
+            levels = [await drive(m) for m in (1, 2, 4)]
+        gate = g0.admission.stats()
+        return {"overload": {
+            "max_inflight": cap,
+            "levels": levels,
+            "admitted_total": gate["admitted_total"],
+            "shed_total": gate["shed_total"],
+        }}
+    finally:
+        try:
+            await server.stop()
+            for g in garages:
+                await g.shutdown()
+        except Exception:
+            traceback.print_exc()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 _PHASES = {
     "--put-phase": _put_phase_async,
     "--put-solo-phase": _put_solo_phase_async,
@@ -1507,6 +1606,7 @@ _PHASES = {
     "--degraded-phase": _degraded_phase_async,
     "--repair-storm-phase": _repair_storm_phase_async,
     "--wan-phase": _wan_phase_async,
+    "--overload-phase": _overload_phase_async,
 }
 
 
@@ -1856,6 +1956,8 @@ def main() -> None:
     out.update(run_phase_subprocess("--degraded-phase", timeout=900))
     emit()
     out.update(run_phase_subprocess("--repair-storm-phase", timeout=900))
+    emit()
+    out.update(run_phase_subprocess("--overload-phase"))
     emit()
     out.update(run_phase_subprocess("--wan-phase"))
     emit()

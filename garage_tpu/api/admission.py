@@ -1,0 +1,129 @@
+"""AdmissionGate — bounded in-flight work at the API front door.
+
+Past saturation a storage node has exactly two choices per new request:
+queue it (converting overload into a timeout storm — every queued
+request ages toward its client's deadline while making every other
+request slower) or shed it immediately with a typed, retryable answer.
+Garage answers 503 SlowDown; so do we, at the earliest possible point —
+before signature verification, before the request trace, before a byte
+of body is read — with correct S3 error XML, a RequestId (minted here,
+since the shed happens before request_trace runs) and a Retry-After
+hint.
+
+The gate bounds two things: concurrent requests (``max_inflight``) and
+committed request-body bytes (``max_inflight_bytes``, from the declared
+Content-Length — the memory watermark).  Admission is checked ONCE at
+intake: an admitted request is never shed mid-flight, so streaming
+bodies (upload or download) always run to completion; the token is
+released when the handler finishes, transfer included.
+
+Single-threaded by construction (the aiohttp handlers run on one event
+loop), so the counters need no locks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils.overload import OverloadTunables
+
+__all__ = ["AdmissionGate", "AdmissionToken"]
+
+
+class AdmissionToken:
+    """One admitted request's claim on the gate; release exactly once
+    (idempotent — a finally block racing an explicit release is fine)."""
+
+    __slots__ = ("_gate", "nbytes", "_released")
+
+    def __init__(self, gate: "AdmissionGate", nbytes: int):
+        self._gate = gate
+        self.nbytes = nbytes
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._gate._inflight -= 1
+        self._gate._inflight_bytes -= self.nbytes
+
+
+class AdmissionGate:
+    def __init__(self, tun: Optional[OverloadTunables] = None, metrics=None):
+        self.tun = tun or OverloadTunables()
+        self._inflight = 0
+        self._inflight_bytes = 0
+        self.admitted_total = 0
+        self.shed_total = 0
+        if metrics is not None:
+            metrics.gauge(
+                "api_inflight_requests",
+                "Client requests currently admitted and in flight "
+                "(admission-gate occupancy numerator)",
+                fn=lambda: float(self._inflight))
+            self.m_admission = metrics.counter(
+                "api_admission_total",
+                "Admission-gate verdicts at the API front door "
+                "(verdict = admit | shed)")
+        else:
+            self.m_admission = None
+
+    # --- the gate ---------------------------------------------------------
+
+    def try_admit(self, nbytes: int = 0) -> Optional[AdmissionToken]:
+        """Admit (→ token, release when the request FULLY finishes) or
+        shed (→ None; caller answers 503 SlowDown).  Watermark 0 =
+        unlimited.  The bytes watermark never sheds when the gate is
+        empty — one over-sized request must degrade to "admitted alone",
+        not to a permanently unservable request class."""
+        t = self.tun
+        shed = False
+        if t.max_inflight and self._inflight >= t.max_inflight:
+            shed = True
+        elif (t.max_inflight_bytes and self._inflight > 0
+              and self._inflight_bytes + nbytes > t.max_inflight_bytes):
+            shed = True
+        if shed:
+            self.shed_total += 1
+            if self.m_admission is not None:
+                self.m_admission.inc(verdict="shed")
+            return None
+        self._inflight += 1
+        self._inflight_bytes += nbytes
+        self.admitted_total += 1
+        if self.m_admission is not None:
+            self.m_admission.inc(verdict="admit")
+        return AdmissionToken(self, nbytes)
+
+    # --- introspection (governor signal + admin API) ----------------------
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    @property
+    def inflight_bytes(self) -> int:
+        return self._inflight_bytes
+
+    def occupancy(self) -> float:
+        """Gate fullness in [0, 1] — the load governor's primary
+        foreground-pressure signal.  Max of the two watermark ratios;
+        0 when both watermarks are disabled."""
+        t = self.tun
+        occ = 0.0
+        if t.max_inflight:
+            occ = self._inflight / t.max_inflight
+        if t.max_inflight_bytes:
+            occ = max(occ, self._inflight_bytes / t.max_inflight_bytes)
+        return occ
+
+    def stats(self) -> dict:
+        return {
+            "inflight": self._inflight,
+            "inflight_bytes": self._inflight_bytes,
+            "admitted_total": self.admitted_total,
+            "shed_total": self.shed_total,
+            "max_inflight": self.tun.max_inflight,
+            "max_inflight_bytes": self.tun.max_inflight_bytes,
+        }

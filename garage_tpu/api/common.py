@@ -90,6 +90,21 @@ class NotImplementedError_(ApiError):
     code = "NotImplemented"
 
 
+class SlowDownError(ApiError):
+    """Node past its admission watermarks: the request was shed at
+    intake, unserved (S3's throttle answer — clients back off and
+    retry).  `retry_after` rides the Retry-After header via
+    error_response."""
+
+    status = 503
+    code = "SlowDown"
+
+    def __init__(self, message: str = "service is overloaded; slow down",
+                 retry_after: int = 1):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
 def error_xml(err: Exception, resource: str = "", request_id: str = "") -> bytes:
     """S3 error body (ref common_error.rs rendering)."""
     code = getattr(err, "code", "InternalError")
@@ -99,6 +114,70 @@ def error_xml(err: Exception, resource: str = "", request_id: str = "") -> bytes
     ET.SubElement(root, "Resource").text = resource
     ET.SubElement(root, "RequestId").text = request_id
     return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+
+
+def admit_request(gate, request):
+    """Admission-gate intake shared by the S3 and K2V servers →
+    ``(token, None)`` when admitted (release the token when the request
+    FULLY finishes, streaming included) or ``(None, response)`` when
+    shed — the ready-to-return 503 SlowDown with Retry-After and a
+    minted RequestId.  Gate None (overload protection unwired, e.g.
+    bare test servers) admits everything."""
+    if gate is None:
+        return None, None
+    try:
+        nbytes = int(request.headers.get("Content-Length") or 0)
+    except ValueError:
+        nbytes = 0
+    token = gate.try_admit(max(nbytes, 0))
+    if token is not None:
+        return token, None
+    return None, error_response(
+        SlowDownError(
+            "node is past its admission watermarks; retry with backoff",
+            retry_after=gate.tun.retry_after),
+        request.path)
+
+
+def request_deadline_budget(config) -> Optional[float]:
+    """The per-request deadline budget the API servers arm, from
+    ``[rpc] deadline_default``; None = deadlines disabled."""
+    rpc_tun = getattr(config, "rpc", None)
+    if rpc_tun is not None and rpc_tun.deadline_default > 0:
+        return rpc_tun.deadline_default
+    return None
+
+
+def gen_request_id() -> str:
+    """A fresh x-amz-request-id.  request_trace mints one per traced
+    request; error paths that answer BEFORE a trace exists (the
+    admission gate's shed) mint one here so every response — even a
+    rejection — carries a RequestId a support ticket can quote."""
+    return os.urandom(16).hex()
+
+
+def error_response(err: Exception, resource: str = "",
+                   request_id: str = ""):
+    """The ONE way an API server renders an error to the client: S3
+    error XML body + the `x-amz-request-id` header (always — error
+    responses are never prepared streaming responses, so the header can
+    always be set here instead of relying on each caller's post-hoc
+    header pass) + `Retry-After` on every 503 (SlowDown sheds, deadline
+    expiries) so well-behaved clients back off instead of hammering an
+    overloaded node."""
+    from aiohttp import web
+
+    status = int(getattr(err, "status", 500))
+    rid = request_id or gen_request_id()
+    headers = {"x-amz-request-id": rid}
+    if status == 503:
+        headers["Retry-After"] = str(int(getattr(err, "retry_after", 1)))
+    return web.Response(
+        status=status,
+        body=error_xml(err, resource, rid),
+        content_type="application/xml",
+        headers=headers,
+    )
 
 
 def xml_to_bytes(root: ET.Element) -> bytes:

@@ -24,14 +24,18 @@ from aiohttp import web
 
 from ..model.helper import NoSuchBucket, NoSuchKey
 from ..model.k2v.causality import CausalContext
+from ..utils.error import GarageError
+from ..utils.tracing import deadline_scope
 from .common import (
     AccessDeniedError,
     ApiError,
     BadRequestError,
     NoSuchBucketError,
     NoSuchKeyError,
-    error_xml,
+    admit_request,
+    error_response,
     int_param,
+    request_deadline_budget,
     request_trace,
     start_site,
 )
@@ -47,6 +51,10 @@ class K2VApiServer:
         self.garage = garage
         self.helper = garage.helper()
         self.region = garage.config.s3_region
+        # node-wide admission gate + request deadline budget, shared with
+        # the S3 server (docs/ROBUSTNESS.md "Overload & brownout")
+        self.gate = getattr(garage, "admission", None)
+        self.deadline_s = request_deadline_budget(garage.config)
         self._runner: Optional[web.AppRunner] = None
 
     async def start(self, bind_addr: str) -> None:
@@ -66,34 +74,54 @@ class K2VApiServer:
             await self._runner.cleanup()
 
     async def handle_request(self, request: web.Request) -> web.StreamResponse:
-        trace, rid = request_trace(
-            self.garage.system.tracer, "K2V", "k2v", request)
-        with trace:
-            resp = await self._handle_with_errors(request, rid)
-            trace.set_attr("status", resp.status)
-            if not resp.prepared:
-                resp.headers["x-amz-request-id"] = rid
-            return resp
+        # admission first, before signature/trace/body — shed typed
+        # (503 SlowDown + Retry-After + RequestId) instead of queueing
+        token, shed = admit_request(self.gate, request)
+        if shed is not None:
+            return shed
+        try:
+            trace, rid = request_trace(
+                self.garage.system.tracer, "K2V", "k2v", request)
+            # long polls legitimately outlive the default request budget:
+            # give them their requested window on top of it.  The value is
+            # client-controlled: only FINITE values in [0, 600] extend —
+            # nan would poison every downstream deadline comparison and
+            # the event loop's timer heap, and a negative value must not
+            # silently shrink the budget
+            budget = self.deadline_s
+            if budget is not None and "timeout" in request.query:
+                try:
+                    t = float(request.query["timeout"])
+                except ValueError:
+                    t = 0.0
+                if t == t and t > 0:
+                    budget += min(t, 600.0)
+            with trace, deadline_scope(budget):
+                resp = await self._handle_with_errors(request, rid)
+                trace.set_attr("status", resp.status)
+                if not resp.prepared:
+                    resp.headers["x-amz-request-id"] = rid
+                return resp
+        finally:
+            if token is not None:
+                token.release()
 
     async def _handle_with_errors(self, request, rid: str) -> web.StreamResponse:
         try:
             return await self._handle(request)
-        except (ApiError, NoSuchBucket, NoSuchKey) as e:
+        except (ApiError, NoSuchBucket, NoSuchKey, GarageError) as e:
             status = getattr(e, "status", 500)
-            return web.Response(
-                status=status,
-                body=error_xml(e, request.path, rid),
-                content_type="application/xml",
-            )
+            if status >= 500 and status != 503:
+                logger.exception("K2V API internal error")
+            else:
+                logger.debug("K2V API error %s: %s", status, e)
+            return error_response(e, request.path, rid)
         except ConnectionError as e:  # incl. ConnectionResetError
             logger.debug("client disconnected mid-request: %s", e)
             raise
         except Exception as e:  # noqa: BLE001
             logger.exception("K2V API error")
-            return web.Response(
-                status=500, body=error_xml(e, request.path, rid),
-                content_type="application/xml",
-            )
+            return error_response(e, request.path, rid)
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         headers = {k.lower(): v for k, v in request.headers.items()}

@@ -23,6 +23,7 @@ from ...model.helper import (
     NoSuchKey,
 )
 from ...utils.metrics import maybe_time
+from ...utils.tracing import deadline_scope
 from ..common import (
     AccessDeniedError,
     ApiError,
@@ -30,9 +31,11 @@ from ..common import (
     BucketAlreadyExistsError,
     BucketNotEmptyError,
     NoSuchBucketError,
-    error_xml,
+    admit_request,
+    error_response,
     host_to_bucket,
     parse_bucket_key,
+    request_deadline_budget,
     request_trace,
     start_site,
 )
@@ -54,6 +57,11 @@ class S3ApiServer:
         self.helper = garage.helper()
         self.region = garage.config.s3_region
         self.root_domain = garage.config.root_domain
+        # overload protection (docs/ROBUSTNESS.md "Overload & brownout"):
+        # the node-wide admission gate (shared with the K2V server — one
+        # node, one capacity) and the per-request deadline budget
+        self.gate = getattr(garage, "admission", None)
+        self.deadline_s = request_deadline_budget(garage.config)
         self._runner: Optional[web.AppRunner] = None
         # metrics (ref generic_server.rs:63-95)
         self.request_counter = 0
@@ -97,19 +105,38 @@ class S3ApiServer:
         self.request_counter += 1
         if self._m is not None:
             self._m["requests"].inc(api="s3")
-        # fresh trace per request (ref generic_server.rs:187-200); child
-        # spans (table ops, quorum RPCs, block IO — on EVERY node the
-        # request touches, via the propagated context) parent under it.
-        # The request id returned to the client IS the trace id, so a
-        # quoted x-amz-request-id is the trace lookup key.
-        trace, rid = request_trace(
-            self.garage.system.tracer, "S3", "s3", request)
-        with trace, maybe_time(self._m and self._m["duration"], api="s3"):
-            resp = await self._handle_with_errors(request, rid)
-            trace.set_attr("status", resp.status)
-            if not resp.prepared:
-                resp.headers["x-amz-request-id"] = rid
-            return resp
+        # admission control BEFORE any per-request work (signature, trace,
+        # body): past the watermarks the request is shed with a typed
+        # 503 SlowDown + Retry-After instead of queueing toward its
+        # client's timeout.  Admission is decided once — an admitted
+        # request (streaming bodies included) is never shed mid-transfer.
+        token, shed = admit_request(self.gate, request)
+        if shed is not None:
+            self.error_counter += 1
+            if self._m is not None:
+                self._m["errors"].inc(api="s3", status="503")
+            return shed
+        try:
+            # fresh trace per request (ref generic_server.rs:187-200);
+            # child spans (table ops, quorum RPCs, block IO — on EVERY
+            # node the request touches, via the propagated context)
+            # parent under it.  The request id returned to the client IS
+            # the trace id, so a quoted x-amz-request-id is the trace
+            # lookup key.  The deadline scope arms the request's
+            # end-to-end budget: every nested RPC hop carries what is
+            # left and sheds typed once it runs out.
+            trace, rid = request_trace(
+                self.garage.system.tracer, "S3", "s3", request)
+            with trace, deadline_scope(self.deadline_s), \
+                    maybe_time(self._m and self._m["duration"], api="s3"):
+                resp = await self._handle_with_errors(request, rid)
+                trace.set_attr("status", resp.status)
+                if not resp.prepared:
+                    resp.headers["x-amz-request-id"] = rid
+                return resp
+        finally:
+            if token is not None:
+                token.release()
 
     async def _handle_with_errors(self, request, rid: str) -> web.StreamResponse:
         try:
@@ -125,25 +152,21 @@ class S3ApiServer:
             status = getattr(e, "status", 500)
             if self._m is not None:
                 self._m["errors"].inc(api="s3", status=str(status))
-            if status >= 500:
+            if status >= 500 and status != 503:
                 logger.exception("S3 API internal error")
             else:
+                # 503s (deadline expiry, overload shed) are the defined
+                # past-saturation behavior, not an internal fault — a
+                # stack trace per shed would melt the log under exactly
+                # the load the gate exists to survive
                 logger.debug("S3 API error %s: %s", status, e)
-            return web.Response(
-                status=status,
-                body=error_xml(e, request.path, rid),
-                content_type="application/xml",
-            )
+            return error_response(e, request.path, rid)
         except Exception as e:  # noqa: BLE001 — uniform 500 rendering
             self.error_counter += 1
             if self._m is not None:
                 self._m["errors"].inc(api="s3", status="500")
             logger.exception("S3 API unexpected error")
-            return web.Response(
-                status=500,
-                body=error_xml(e, request.path, rid),
-                content_type="application/xml",
-            )
+            return error_response(e, request.path, rid)
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         headers = {k.lower(): v for k, v in request.headers.items()}

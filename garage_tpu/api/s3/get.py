@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 from aiohttp import web
 
 from ...utils.data import Hash, Uuid
+from ...utils.tracing import refresh_deadline
 from ..common import (
     ApiError,
     BadRequestError,
@@ -310,6 +311,12 @@ async def _stream_blocks_range(
                 lo, hi = max(c0, s0), min(c1, s1)
                 if hi > lo:
                     await resp.write(item[lo - c0 : hi - c0])
+                    # the client drained bytes: it is demonstrably alive,
+                    # so the request deadline renews — the budget bounds
+                    # time-since-progress, never total transfer time
+                    # (a multi-GiB download must not be shed at the 30 s
+                    # mark).  Pumps spawned from here on inherit it.
+                    refresh_deadline(garage.config.rpc.deadline_default)
         await resp.write_eof()
     except ConnectionError as e:
         # the client hung up mid-download — normal operation (aborted

@@ -32,6 +32,7 @@ from ...model.s3.object_table import (
 from ...model.s3.version_table import Version
 from ...utils.crdt import now_msec
 from ...utils.data import Hash, block_hash, gen_uuid
+from ...utils.tracing import refresh_deadline
 from ..common import ApiError, BadRequestError
 
 
@@ -271,6 +272,13 @@ async def read_and_put_blocks(
                 if nb is None:
                     break
                 window.append(nb)
+            # the client delivered another window of body bytes: it is
+            # demonstrably alive, so the request deadline renews — the
+            # budget bounds time-since-progress, never total upload time
+            # (a multi-GiB PUT must not be shed at the 30 s mark).  The
+            # per-block put_one tasks spawned below inherit the renewed
+            # budget at creation.
+            refresh_deadline(garage.config.rpc.deadline_default)
             fut = _try_submit(feeder, window)
             if fut is not None:
                 # feeder path: the block-id hash is already submitted —

@@ -48,10 +48,15 @@ MOVE_BATCH = 8
 
 class RebalanceMover(Worker):
     def __init__(self, manager, resync, rate_mib_s: float = 64.0,
-                 metrics=None):
+                 metrics=None, governor=None):
         self.manager = manager
         self.resync = resync
         self.rate_bytes = max(float(rate_mib_s), 0.001) * (1 << 20)
+        # load governor (utils/overload.py): scales the effective pacing
+        # rate by the background throttle ratio, so a drain under client
+        # overload cedes bandwidth beyond the static rate ceiling and
+        # speeds back up when foreground pressure clears
+        self.governor = governor
         self._pending: List[int] = []   # partitions left, walk order
         self._queued = set()
         self._cursor: Optional[bytes] = None  # rc-tree key inside head
@@ -172,8 +177,13 @@ class RebalanceMover(Worker):
         st.queue_length = len(self._pending)
         if moved:
             # pacing: sleep the time this slice's bytes "cost" at the
-            # configured rate, so a drain shares the wire with clients
-            await asyncio.sleep(min(moved / self.rate_bytes, 5.0))
+            # configured rate, so a drain shares the wire with clients;
+            # the governor's throttle ratio shrinks the effective rate
+            # further while foreground pressure is high
+            rate = self.rate_bytes
+            if self.governor is not None:
+                rate *= max(self.governor.ratio(), 1e-3)
+            await asyncio.sleep(min(moved / rate, 5.0))
         return WorkerState.BUSY
 
     async def wait_for_work(self) -> None:

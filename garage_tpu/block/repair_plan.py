@@ -503,7 +503,19 @@ class RepairPlanner:
                             continue
                     break
                 inflight = set(active.values())
+                # dynamic background yielding: under foreground pressure
+                # the load governor shrinks how many of the exact-k
+                # fetches run CONCURRENTLY (never below 1 — repairs still
+                # finish, just serialized), so a repair storm's fan-out
+                # cedes wire/CPU to client traffic and widens back out
+                # when pressure clears
+                limit = needed
+                gov = getattr(mgr, "governor", None)
+                if gov is not None:
+                    limit = max(1, int(needed * gov.ratio() + 0.9999))
                 for i in w:
+                    if len(active) >= limit:
+                        break
                     if i not in results and i not in inflight:
                         launch(i, cmap)
                         inflight.add(i)

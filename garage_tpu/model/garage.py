@@ -262,7 +262,44 @@ class Garage:
             self.k2v_item_table,
         ]
 
+        # --- overload protection (docs/ROBUSTNESS.md "Overload &
+        # brownout"): the front-door admission gate and the background
+        # load governor, wired to the live pressure signals this node
+        # already produces ---
+        from ..api.admission import AdmissionGate
+        from ..utils.overload import LoadGovernor
+
+        self.admission = AdmissionGate(config.api, metrics=self.system.metrics)
+        self.governor = LoadGovernor(config.api, metrics=self.system.metrics)
+        self.governor.add_signal("admission", self.admission.occupancy)
+        feeder = self.block_manager.feeder
+        if feeder is not None:
+            depth_full = max(config.api.governor_feeder_depth_full, 1)
+            self.governor.add_signal(
+                "feeder_depth",
+                lambda: len(feeder._pending) / depth_full)
+        health = getattr(self.block_manager, "health", None)
+        if health is not None:
+            # a sick disk is mild pressure (scrub/resync hammering a
+            # degraded root steals the IO the foreground needs) — but
+            # CAPPED below governor_high on purpose: a disk can stay
+            # failed for days awaiting replacement, and parking ALL
+            # background work at min_ratio for that long would throttle
+            # the very re-replication that restores redundancy.  Disk
+            # state alone therefore throttles partially, never fully;
+            # only live foreground signals can drive the ratio to the
+            # floor.
+            _disk_p = {"ok": 0.0, "degraded": 0.5, "failed": 0.5}
+            self.governor.add_signal(
+                "disk", lambda: _disk_p.get(health.worst_state(), 0.0))
+        # netapp write loops feed per-frame queue waits (HOL pressure)
+        self.system.netapp.queue_wait_hook = self.governor.note_queue_wait
+        # repair-storm fetch concurrency clamps against the same ratio
+        self.block_manager.governor = self.governor
+
         self.bg = BackgroundRunner()
+        # background workers duty-cycle against foreground pressure
+        self.bg.governor = self.governor
         self.bg_vars = BgVars()
         self.scrub_worker: Optional[ScrubWorker] = None
 
@@ -356,6 +393,7 @@ class Garage:
             self.block_manager, self.block_resync,
             rate_mib_s=self.config.rebalance_rate_mib,
             metrics=self.system.metrics,
+            governor=self.governor,
         )
         self.bg.spawn(self.rebalance_mover)
 

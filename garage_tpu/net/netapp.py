@@ -49,8 +49,12 @@ from ..utils.data import FixedBytes32
 from ..utils.error import RpcError, error_code, remote_error
 from ..utils.tracing import (
     TraceContext,
+    arm_deadline,
     current_trace_context,
+    deadline_expired,
+    disarm_deadline,
     inherited_priority,
+    remaining_budget,
     reset_remote_context,
     set_remote_context,
 )
@@ -276,8 +280,18 @@ class _OutMux:
         self.queues = [deque() for _ in range(N_PRIO)]
         self.cv = asyncio.Condition()
         self.closed = False
+        # request frames dropped in-queue because their deadline passed
+        # before they reached the wire (docs/ROBUSTNESS.md "Overload &
+        # brownout"): under head-of-line pressure the doomed work is shed
+        # HERE instead of burning wire bytes + a remote handler on it
+        self.expired_drops = 0
 
-    async def put(self, frame: Frame):
+    async def put(self, frame: Frame, deadline: Optional[float] = None,
+                  on_drop=None):
+        """`deadline` (absolute time.monotonic) marks a frame droppable
+        once expired; `on_drop` (sync, no await) is invoked if the writer
+        discards it — K_REQ senders fail their response future there so
+        the caller sees a typed DeadlineExceeded immediately."""
         async with self.cv:
             while (
                 len(self.queues[frame.prio]) >= _OUT_QUEUE_LIMIT and not self.closed
@@ -285,18 +299,37 @@ class _OutMux:
                 await self.cv.wait()
             if self.closed:
                 raise RpcError("connection closed")
-            self.queues[frame.prio].append((frame, time.perf_counter()))
+            self.queues[frame.prio].append(
+                (frame, time.perf_counter(), deadline, on_drop))
             self.cv.notify_all()
 
     async def pop(self) -> Optional[Tuple[Frame, float]]:
-        """→ (frame, enqueue_perf_counter) or None when closed+drained."""
+        """→ (frame, enqueue_perf_counter) or None when closed+drained.
+        Queued frames whose deadline already passed are discarded (their
+        on_drop hook runs) instead of being written — the client is gone;
+        the wire slot goes to a frame someone still waits for."""
         async with self.cv:
             while True:
+                popped = False
                 for q in self.queues:
-                    if q:
-                        entry = q.popleft()
+                    while q:
+                        frame, t_enq, deadline, on_drop = q.popleft()
+                        popped = True
+                        if (deadline is not None
+                                and time.monotonic() >= deadline):
+                            self.expired_drops += 1
+                            if on_drop is not None:
+                                try:
+                                    on_drop()
+                                except Exception:  # noqa: BLE001
+                                    pass
+                            continue
                         self.cv.notify_all()
-                        return entry
+                        return frame, t_enq
+                if popped:
+                    # dropped expired entries freed queue slots: writers
+                    # blocked in put() must recheck before we sleep
+                    self.cv.notify_all()
                 if self.closed:
                     return None
                 await self.cv.wait()
@@ -451,12 +484,36 @@ class Connection:
             hdr_obj["tc"] = TraceContext(
                 ctx.trace_id, ctx.span_id, prio
             ).pack()
+        # end-to-end deadline propagation: the REMAINING request budget
+        # (relative seconds — peer clocks are not comparable) rides next
+        # to the trace context; the serving node re-arms its task-local
+        # deadline from it so further hops inherit an ever-shrinking
+        # budget instead of a fresh 30 s per hop
+        budget = remaining_budget()
+        expires_at: Optional[float] = None
+        if budget is not None:
+            hdr_obj["dl"] = round(budget, 4)
+            expires_at = time.monotonic() + budget
         header = msgpack.packb(hdr_obj, use_bin_type=True)
         fut = asyncio.get_running_loop().create_future()
         self._pending[sid] = fut
+
+        def _expired_in_queue():
+            # the writer dropped our K_REQ before it hit the wire: fail
+            # the caller immediately with the typed budget error instead
+            # of letting it burn its (already tiny) timeout
+            if not fut.done():
+                from ..utils.error import DeadlineExceeded
+
+                fut.set_exception(DeadlineExceeded(
+                    f"request {path} to {self.remote_id.hex_short()} "
+                    f"expired in the outgoing queue"))
+
         try:
             await self._out.put(
-                Frame(K_REQ, prio, sid, struct.pack(">I", len(header)) + header + msg_bytes)
+                Frame(K_REQ, prio, sid, struct.pack(">I", len(header)) + header + msg_bytes),
+                deadline=expires_at,
+                on_drop=_expired_in_queue,
             )
             pump = None
             if body is not None:
@@ -552,11 +609,16 @@ class Connection:
                 frame, t_enq = entry
                 self.tx_frames[frame.prio] += 1
                 self.tx_bytes[frame.prio] += HDR_SIZE + len(frame.payload)
+                waited = time.perf_counter() - t_enq
+                hook = self.netapp.queue_wait_hook
+                if hook is not None:
+                    try:
+                        hook(waited)
+                    except Exception:  # noqa: BLE001 — governor must not kill IO
+                        pass
                 if nm is not None:
                     prio_name = PRIO_NAMES[frame.prio]
-                    nm["queue_wait"].observe(
-                        time.perf_counter() - t_enq, prio=prio_name
-                    )
+                    nm["queue_wait"].observe(waited, prio=prio_name)
                     nm["tx_frames"].inc(peer=self._peer_label, prio=prio_name)
                     nm["tx_bytes"].inc(
                         HDR_SIZE + len(frame.payload),
@@ -696,6 +758,16 @@ class Connection:
         # contextvar never leaks across requests.
         tctx = TraceContext.unpack(header.get("tc")) if header.get("tc") else None
         token = set_remote_context(tctx) if tctx is not None else None
+        # deadline propagation, server side: re-arm the caller's remaining
+        # budget task-locally so this handler's own work and further hops
+        # clamp to it.  Malformed values from a hostile peer are ignored
+        # (like a bad tc) — they must never break dispatch.
+        dl = header.get("dl")
+        dtoken = None
+        if isinstance(dl, (int, float)) and not isinstance(dl, bool):
+            budget = float(dl)
+            if budget == budget and -1.0 <= budget <= 86400.0:  # finite, sane
+                dtoken = arm_deadline(budget)
         tracer = self.netapp.tracer
         if tracer is not None and tctx is not None:
             span = tracer.span_from_context(
@@ -709,6 +781,8 @@ class Connection:
             with span:
                 await self._handle_request_inner(sid, prio, path, msg, body)
         finally:
+            if dtoken is not None:
+                disarm_deadline(dtoken)
             if token is not None:
                 reset_remote_context(token)
 
@@ -720,6 +794,14 @@ class Connection:
         try:
             if ep is None or ep.handler is None:
                 raise RpcError(f"no handler for endpoint {path!r}")
+            if deadline_expired():
+                # the caller's budget ran out while this request sat in
+                # queues: answer the typed error without running the
+                # handler — the client is gone, the work would be waste
+                from ..utils.error import DeadlineExceeded
+
+                raise DeadlineExceeded(
+                    f"budget exhausted before handler {path}")
             msg_obj = msgpack.unpackb(msg, raw=False)
             resp_obj, resp_body = await ep.handler(self.remote_id, msg_obj, body)
             resp = msgpack.packb(resp_obj, use_bin_type=True)
@@ -806,6 +888,9 @@ class NetApp:
         # set by System: NodeID -> bool, True when the peer has a known
         # dialable address (metric series worth keeping per-peer)
         self.peer_durable_fn: Optional[Callable[[NodeID], bool]] = None
+        # set by the model layer: per-frame queue-wait seconds feed the
+        # load governor's HOL-pressure signal (utils/overload.py)
+        self.queue_wait_hook: Optional[Callable[[float], None]] = None
 
     def set_metrics(self, registry) -> None:
         """Attach per-peer traffic + queue-wait instruments (called by

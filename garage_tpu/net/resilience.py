@@ -74,6 +74,15 @@ class ResilienceTunables:
     # put_block/get_block/need_block — used to be hardcoded 60.0 in
     # block/resync.py and block/manager.py)
     block_rpc_timeout: float = 60.0
+    # --- end-to-end deadline propagation (docs/ROBUSTNESS.md "Overload
+    # & brownout"): the API front door stamps each client request with
+    # deadline_default seconds of budget; every RPC hop carries the
+    # REMAINING budget and clamps its timeout to it; a hop whose
+    # remaining budget is at or under deadline_floor fast-fails typed
+    # (DeadlineExceeded) instead of dispatching work whose client is
+    # gone.  deadline_default <= 0 disables request deadlines entirely.
+    deadline_default: float = 30.0
+    deadline_floor: float = 0.01
 
 
 def adaptive_timeout(
@@ -108,10 +117,14 @@ def is_transport_error(e: BaseException) -> bool:
     answer: timeouts, connection loss/refusal, and local RpcErrors.  An
     error reconstructed from a K_ERR/K_RESP wire code (``remote_code``
     set) proves the peer answered — the transport is fine, so it neither
-    feeds the breaker nor earns a retry to the same node."""
-    from ..utils.error import RpcError
+    feeds the breaker nor earns a retry to the same node.  Likewise a
+    DeadlineExceeded indicts the CALLER's budget, not the path: no
+    breaker feed, no retry (the budget is gone either way)."""
+    from ..utils.error import DeadlineExceeded, RpcError
 
     if getattr(e, "remote_code", None):
+        return False
+    if isinstance(e, DeadlineExceeded):
         return False
     if isinstance(e, (TimeoutError, asyncio.TimeoutError)):
         return True

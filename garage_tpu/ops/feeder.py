@@ -68,13 +68,20 @@ class FeederClosed(RuntimeError):
 
 class _Item:
     __slots__ = ("kind", "payload", "blocks", "nbytes", "future", "ts",
-                 "peers")
+                 "peers", "deadline")
 
     def __init__(self, kind, payload, blocks, nbytes, peers=None):
         self.kind = kind
         self.payload = payload
         self.blocks = blocks
         self.nbytes = nbytes
+        # end-to-end request deadline (absolute time.monotonic), captured
+        # from the submitter's task-local budget (utils/tracing): an
+        # expired submission is failed typed at dispatch instead of
+        # spending codec time on a request whose client already gave up
+        from ..utils.tracing import current_deadline
+
+        self.deadline = current_deadline()
         # how many concurrent submitters the CALLER can see (e.g. the
         # S3 layer's in-flight put count).  Three regimes: an explicit
         # peers <= 1 means PROVABLY alone — dispatch immediately, the
@@ -110,6 +117,7 @@ class CodecFeeder:
         self.dispatched_blocks = 0
         self.dispatch_reasons: dict = {}
         self.max_depth_seen = 0
+        self.expired = 0  # submissions shed: deadline passed pre-dispatch
         if metrics is not None:
             self.m_depth = metrics.gauge(
                 "codec_feeder_depth",
@@ -329,11 +337,22 @@ class CodecFeeder:
 
     def _dispatch(self, batch: List[_Item], reason: str) -> None:
         now = time.perf_counter()
+        mono = time.monotonic()
         by_kind: dict = {}
         for it in batch:
             # claim the future first: a caller-cancelled submission is
             # excluded from the computation entirely
             if not it.future.set_running_or_notify_cancel():
+                continue
+            if it.deadline is not None and mono >= it.deadline:
+                # the submitter's request budget ran out while this sat
+                # in the feeder: shed it typed instead of burning codec
+                # time on an answer nobody is waiting for
+                from ..utils.error import DeadlineExceeded
+
+                self.expired += 1
+                it.future.set_exception(DeadlineExceeded(
+                    f"codec {it.kind} submission expired in the feeder"))
                 continue
             by_kind.setdefault(it.kind, []).append(it)
             if self.m_wait is not None:
@@ -412,6 +431,7 @@ class CodecFeeder:
                 "max_depth_seen": self.max_depth_seen,
                 "inflight_requests": self._inflight,
                 "submits": self.submits,
+                "expired": self.expired,
                 "dispatches": self.dispatches,
                 "dispatched_blocks": self.dispatched_blocks,
                 "dispatch_reasons": dict(self.dispatch_reasons),

@@ -42,12 +42,14 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from ..utils.data import FixedBytes32
 from ..utils.error import (
+    DeadlineExceeded,
     PeerUnavailable,
     QuorumError,
     RpcError,
     ZoneQuorumError,
     error_code,
 )
+from ..utils.tracing import clamp_to_budget, deadline_expired, remaining_budget
 from ..net.frame import PRIO_NORMAL
 from ..net.netapp import Endpoint, NetApp
 from ..net.peering import FullMeshPeering
@@ -145,11 +147,16 @@ class RpcHelper:
                 "rpc_zone_quorum_error_total",
                 "Quorum writes failed because the acked replica set "
                 "never spanned the required zones (ZoneQuorumError)")
+            self.m_deadline = metrics.counter(
+                "rpc_deadline_exceeded_total",
+                "RPC dispatches shed or aborted because the request's "
+                "end-to-end deadline budget ran out")
         else:
             self.m_requests = self.m_errors = None
             self.m_timeouts = self.m_duration = None
             self.m_retries = self.m_hedges = self.m_adaptive = None
             self.m_zone_requorum = self.m_zone_errors = None
+            self.m_deadline = None
 
     def set_zone_source(self, zone_of: Callable[[NodeID], Optional[str]],
                         local_zone: Callable[[], Optional[str]]) -> None:
@@ -182,6 +189,8 @@ class RpcHelper:
                 code = error_code(e)
                 if code == "Timeout":
                     self.m_timeouts.inc(endpoint=endpoint_path)
+                elif code == "DeadlineExceeded" and self.m_deadline is not None:
+                    self.m_deadline.inc(endpoint=endpoint_path)
                 self.m_errors.inc(endpoint=endpoint_path, error=code)
                 raise
             finally:
@@ -220,7 +229,10 @@ class RpcHelper:
             return
         if err is None:
             self.peering.record_rpc_success(node)
-        elif isinstance(err, asyncio.CancelledError):
+        elif isinstance(err, (asyncio.CancelledError, DeadlineExceeded)):
+            # no verdict about the peer: a cancelled call never finished,
+            # and a deadline expiry indicts the caller's budget — either
+            # way, only release a consumed half-open probe slot
             self.peering.breaker_release(node)
         elif is_transport_error(err):
             self.peering.record_rpc_failure(node)
@@ -263,13 +275,45 @@ class RpcHelper:
             retries = self.tunables.retry_max if strategy.rs_idempotent else 0
         attempt = 0
         while True:
+            # deadline gate FIRST (before peer_allows, which may consume
+            # the breaker's half-open probe slot): work whose client has
+            # already timed out is shed here, before any bytes move
+            rem = remaining_budget()
+            if rem is not None and rem <= self.tunables.deadline_floor:
+                if self.m_deadline is not None:
+                    self.m_deadline.inc(endpoint=endpoint_path)
+                raise DeadlineExceeded(
+                    f"budget exhausted ({rem * 1000:.1f} ms left) before "
+                    f"dispatch of {endpoint_path}")
             if not self.peer_allows(node):
                 # fast-fail: no timeout burned, next candidate launches now
                 raise PeerUnavailable(
                     f"breaker open for {bytes(node).hex()[:8]}")
             timeout = self.timeout_for(
                 node, strategy.rs_timeout, strategy.rs_adaptive_timeout)
-            fn = self._instrument(endpoint_path, lambda: raw_call(timeout))
+            # per-hop timeout clamped to the remaining request budget: a
+            # hop may finish early or fail early, never outlive its client
+            clamped = clamp_to_budget(timeout)
+            budget_bound = clamped is not None and (
+                timeout is None or clamped < timeout)
+
+            async def attempt_once(_t=clamped, _bb=budget_bound):
+                try:
+                    return await raw_call(_t)
+                except (TimeoutError, asyncio.TimeoutError, RpcError) as e:
+                    # a timeout caused by the BUDGET clamp (not the peer
+                    # being slow relative to its own allowance) is the
+                    # request's deadline expiring: reclassify typed so it
+                    # neither feeds the breaker nor earns a retry, and the
+                    # API layer renders 503 instead of 500
+                    if (_bb and deadline_expired()
+                            and error_code(e) == "Timeout"):
+                        raise DeadlineExceeded(
+                            f"request budget expired during "
+                            f"{endpoint_path}") from e
+                    raise
+
+            fn = self._instrument(endpoint_path, attempt_once)
             try:
                 result = await fn()
             except asyncio.CancelledError:
@@ -447,6 +491,22 @@ class RpcHelper:
                 required_zones=strategy.rs_required_zones,
                 endpoint_path=endpoint.path)
 
+    @staticmethod
+    def _quorum_fail(quorum: int, successes: int, errors: list):
+        """The no-quorum exception: typed DeadlineExceeded when the
+        request's budget is what killed it (every per-node failure was a
+        budget expiry, or the budget is gone outright) — the API layer
+        then answers the defined 503 + Retry-After instead of an
+        anonymous 500 QuorumError, and the client backs off instead of
+        instantly re-queueing against a saturated cluster."""
+        if deadline_expired() or (
+                errors and all(isinstance(e, DeadlineExceeded)
+                               for e in errors)):
+            raise DeadlineExceeded(
+                f"request budget exhausted before quorum "
+                f"({successes}/{quorum} ok)")
+        raise QuorumError(quorum, successes, errors)
+
     async def _quorum_read(self, nodes, call_node, quorum,
                            hedge_delay=None, endpoint_path="") -> List[Any]:
         ordered = self.request_order(nodes)
@@ -465,7 +525,7 @@ class RpcHelper:
                     next_i += 1
                     in_flight[asyncio.ensure_future(call_node(n))] = n
                 if not in_flight:
-                    raise QuorumError(quorum, len(successes), errors)
+                    self._quorum_fail(quorum, len(successes), errors)
                 # hedging: if the wave is slower than the endpoint's
                 # latency quantile AND an unsent candidate remains, launch
                 # it speculatively instead of waiting for a failure
@@ -552,7 +612,7 @@ class RpcHelper:
                 except Exception as e:
                     errors.append(e)
         if len(successes) < quorum:
-            raise QuorumError(quorum, len(successes), errors)
+            self._quorum_fail(quorum, len(successes), errors)
         if not zones_ok():
             # every candidate has answered; the acks never left
             # len(acked_zones()) zones — a whole zone is dark and the

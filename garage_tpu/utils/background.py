@@ -100,6 +100,12 @@ class BackgroundRunner:
         self.tasks: Dict[int, asyncio.Task] = {}
         self._next_id = 0
         self.stopping = asyncio.Event()
+        # load governor (utils/overload.py), set by the model layer:
+        # when foreground pressure is high, BUSY workers are duty-cycled
+        # (sleep proportional to their last slice) so resync/scrub/GC/
+        # sync sweeps cede CPU and wire to client traffic, and resume
+        # full rate when pressure clears.  None = never throttle.
+        self.governor = None
 
     def spawn(self, worker: Worker) -> int:
         wid = self._next_id
@@ -126,10 +132,21 @@ class BackgroundRunner:
         status = worker.status()
         while not self.stopping.is_set():
             try:
+                t0 = time.monotonic()
                 state = await worker.work()
                 status.iterations += 1
                 status.consecutive_errors = 0
                 status.state = state
+                if state == WorkerState.BUSY and self.governor is not None:
+                    # dynamic background yielding: at throttle ratio r the
+                    # worker runs r of the wall clock (sleep after each
+                    # slice), so foreground overload visibly pushes
+                    # background bytes/s down — and full rate resumes as
+                    # soon as the governor's pressure clears
+                    pause = self.governor.bg_pause(time.monotonic() - t0)
+                    if pause > 0:
+                        status.state = WorkerState.THROTTLED
+                        await self._sleep_or_stop(pause)
             except asyncio.CancelledError:
                 raise
             except Exception as e:

@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 
 from ..net.resilience import ResilienceTunables
 from ..ops.codec import CodecParams as _CodecParams
+from .overload import OverloadTunables
 
 _CODEC_DEFAULTS = _CodecParams()
 
@@ -255,9 +256,15 @@ class Config:
     k2v_api_bind_addr: Optional[str] = None
     codec: CodecConfig = field(default_factory=CodecConfig)
     # [rpc] — degraded-mode resilience tunables (adaptive timeouts,
-    # retry/backoff, read hedging, per-peer circuit breaker, and the
-    # static block-transfer timeout); see docs/ROBUSTNESS.md
+    # retry/backoff, read hedging, per-peer circuit breaker, the
+    # static block-transfer timeout, and the end-to-end request
+    # deadline budget); see docs/ROBUSTNESS.md
     rpc: ResilienceTunables = field(default_factory=ResilienceTunables)
+    # [api] — overload protection at the front door: admission-gate
+    # watermarks (max in-flight requests/bytes → 503 SlowDown past
+    # them) and the background load governor's thresholds; see
+    # docs/ROBUSTNESS.md "Overload & brownout"
+    api: OverloadTunables = field(default_factory=OverloadTunables)
     consul_discovery: Optional[ConsulDiscoveryConfig] = None
     kubernetes_discovery: Optional[KubernetesDiscoveryConfig] = None
     # raw parsed TOML for anything not modeled
@@ -373,6 +380,25 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         raise ConfigError("rpc.hedge_quantile must be in (0, 1)")
     if cfg.rpc.breaker_failure_threshold < 1:
         raise ConfigError("rpc.breaker_failure_threshold must be >= 1")
+    if cfg.rpc.deadline_floor < 0:
+        raise ConfigError("rpc.deadline_floor must be >= 0")
+
+    api = dict(raw.get("api", {}))
+    known = {f.name for f in dataclasses.fields(OverloadTunables)}
+    bad = set(api) - known
+    if bad:
+        raise ConfigError(f"unknown [api] keys: {sorted(bad)}")
+    if "max_inflight_bytes" in api:
+        api["max_inflight_bytes"] = parse_capacity(api["max_inflight_bytes"])
+    cfg.api = OverloadTunables(**api)
+    if cfg.api.max_inflight < 0:
+        raise ConfigError("api.max_inflight must be >= 0 (0 = unlimited)")
+    if cfg.api.max_inflight_bytes < 0:
+        raise ConfigError("api.max_inflight_bytes must be >= 0")
+    if not 0.0 < cfg.api.governor_min_ratio <= 1.0:
+        raise ConfigError("api.governor_min_ratio must be in (0, 1]")
+    if not 0.0 <= cfg.api.governor_low < cfg.api.governor_high:
+        raise ConfigError("api.governor_low must be in [0, governor_high)")
 
     codec = raw.get("codec", {})
     known = {f.name for f in dataclasses.fields(CodecConfig)}

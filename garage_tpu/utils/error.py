@@ -39,6 +39,22 @@ class ZoneQuorumError(RpcError):
     zones we just wrote to"."""
 
 
+class DeadlineExceeded(RpcError):
+    """The request's end-to-end deadline budget ran out: the client has
+    (or is about to have) timed out, so no layer should spend another
+    cycle on the request.  Raised locally when a hop's remaining budget
+    is below the dispatch floor, by the netapp out-queue when a queued
+    request frame expires before reaching the wire, and by the codec
+    feeder for expired submissions; wire-coded so a remote hop's verdict
+    round-trips typed.  Deliberately NOT a transport error: the peer did
+    nothing wrong — it must never feed the circuit breaker or earn a
+    retry.  The API layer renders it 503 (the S3 throttle status, with
+    Retry-After) so clients back off instead of re-queueing instantly."""
+
+    status = 503          # API rendering (api/common error_response)
+    code = "DeadlineExceeded"
+
+
 class PeerUnavailable(RpcError):
     """Call refused locally: the peer's circuit breaker is open, so
     dispatching would only burn a timeout.  Raised before any bytes hit
@@ -103,6 +119,7 @@ _WIRE_CLASSES = {
     for cls in (
         GarageError, RpcError, TimeoutError_, CorruptData, NoSuchBlock,
         DbError, LayoutError, StorageError, StorageFull, ZoneQuorumError,
+        DeadlineExceeded,
     )
 }
 # every timeout flavor emits ONE code, so it must also reconstruct

@@ -96,6 +96,105 @@ _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar
     "garage_tpu_current_span", default=None
 )
 
+# --- end-to-end request deadlines (docs/ROBUSTNESS.md "Overload &
+# brownout") -----------------------------------------------------------
+#
+# The API front door arms a deadline for each client request; every
+# nested RPC carries the REMAINING budget in its request header (`dl`,
+# net/netapp.py — relative seconds, never an absolute timestamp: peer
+# clocks are not comparable), the receiving node re-arms its own
+# task-local deadline from it, and each layer clamps its work to what is
+# left.  Work whose client has already timed out is shed at the earliest
+# seam it reaches (RpcHelper dispatch, the netapp out-queue, the codec
+# feeder) with the typed DeadlineExceeded instead of burning capacity on
+# an answer nobody is waiting for.  Task-local like the span context, so
+# concurrent requests cannot cross budgets.
+
+_deadline: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "garage_tpu_deadline", default=None  # absolute time.monotonic() seconds
+)
+
+
+def arm_deadline(budget_s: Optional[float]):
+    """Install a deadline `budget_s` seconds from now for the current
+    task; returns the reset token.  When a deadline is already armed the
+    TIGHTER of the two wins — a nested hop can shrink the budget, never
+    extend it.  budget_s None installs nothing (token still valid) —
+    deadline DISABLING is the caller's decision; a budget <= 0 arms an
+    already-expired deadline (a hop that received zero budget must
+    fast-fail, not run uncapped)."""
+    cur = _deadline.get()
+    if budget_s is None:
+        return _deadline.set(cur)
+    new = time.monotonic() + budget_s
+    return _deadline.set(new if cur is None else min(cur, new))
+
+
+def disarm_deadline(token) -> None:
+    _deadline.reset(token)
+
+
+def refresh_deadline(budget_s: Optional[float]) -> None:
+    """Progress-based deadline renewal: reset the CURRENT task's armed
+    deadline to `budget_s` from now.  The deadline exists to shed work
+    whose client has departed — a client actively streaming body bytes
+    (or draining response bytes) is demonstrably alive, so the streaming
+    handlers renew the budget on every unit of observed progress; the
+    budget then bounds time-since-last-progress, not total transfer time
+    (a multi-GiB PUT must not be killed at the 30 s mark mid-stream).
+    No-op when no deadline is armed (deadlines disabled) or budget_s is
+    None.  Unlike arm_deadline this may EXTEND — only the layer that
+    observes client progress is entitled to do that."""
+    if budget_s is None or _deadline.get() is None:
+        return
+    _deadline.set(time.monotonic() + budget_s)
+
+
+def current_deadline() -> Optional[float]:
+    """The task's absolute (time.monotonic) deadline, or None."""
+    return _deadline.get()
+
+
+def remaining_budget() -> Optional[float]:
+    """Seconds of budget left for the current task's request; negative
+    once expired, None when no deadline is armed."""
+    d = _deadline.get()
+    return None if d is None else d - time.monotonic()
+
+
+def deadline_expired() -> bool:
+    d = _deadline.get()
+    return d is not None and time.monotonic() >= d
+
+
+def clamp_to_budget(timeout: Optional[float]) -> Optional[float]:
+    """A per-hop timeout never longer than the remaining request budget.
+    None timeout + armed deadline → the budget itself (an untimed call
+    must still end with its client)."""
+    rem = remaining_budget()
+    if rem is None:
+        return timeout
+    rem = max(rem, 0.001)
+    return rem if timeout is None else min(timeout, rem)
+
+
+class deadline_scope:
+    """``with deadline_scope(budget_s):`` — arm for the block, restore on
+    exit.  The API servers bracket each client request with one."""
+
+    __slots__ = ("budget", "_token")
+
+    def __init__(self, budget_s: Optional[float]):
+        self.budget = budget_s
+
+    def __enter__(self):
+        self._token = arm_deadline(self.budget)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        disarm_deadline(self._token)
+        return False
+
 # Trace context extracted from an INCOMING RPC frame: set by the netapp
 # handler task so server-side spans parent on the caller's span even when
 # this node's tracer is export-disabled (the context is still forwarded

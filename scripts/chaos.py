@@ -34,6 +34,18 @@ code tests/test_cluster_scale.py asserts on):
   rolling         rolling upgrade: restart nodes one zone at a time
                   with a bumped version tag under live traffic; mixed
                   versions visible in the handshake-learned peer map
+  compound        zone blackhole + flaky disk (read EIO) at ONCE —
+                  zero client errors through the compound fault, full
+                  recovery (breakers closed, disk ok, bit-identical)
+
+Overload phase (ISSUE 10) runs on its own small SimCluster with a tiny
+admission watermark:
+
+  overload        offered load at 1× then 4× the gateway's admission
+                  capacity: rejects all typed SlowDown/DeadlineExceeded
+                  (no hangs, no untyped 500s), admitted p99 within 3×
+                  the at-capacity baseline, background_throttle_ratio
+                  drops then recovers, zero acked-data loss
 
 Every phase must complete with ZERO client-visible errors; the exit
 code says so, and a JSON summary (per-phase op counts + p50/p99/max
@@ -64,12 +76,20 @@ PHASES = ("baseline", "latency", "flaky", "oneway", "partition",
           "blackhole", "disk")
 # canonical run order: the drain REMOVES a zone from the layout, so it
 # must come last — a rolling zone restart after a drain would take out
-# 2 of 3 replicas on layouts that can no longer spread wider
-ZONE_PHASES = ("zone_blackhole", "rolling", "zone_drain")
+# 2 of 3 replicas on layouts that can no longer spread wider.  compound
+# (zone blackhole + flaky disk at once) runs after the plain blackhole
+# and heals everything it injects before the rolling restart.
+ZONE_PHASES = ("zone_blackhole", "compound", "rolling", "zone_drain")
 # node-kill repair storm on its own EC cluster (ISSUE 8): heal must
 # complete with zero client errors AND the planned repair path must move
 # no more bytes per repaired byte than the whole-shard exact-k baseline
 STORM_PHASES = ("repair_storm",)
+# ISSUE 10 overload drill: its own SimCluster with a tiny admission
+# watermark so "4× past capacity" is reachable from one client process —
+# every reject typed SlowDown/DeadlineExceeded, admitted p99 within 3×
+# the at-capacity baseline, background_throttle_ratio cedes + recovers,
+# zero acked-data loss
+OVERLOAD_PHASES = ("overload",)
 
 
 def _apply(inj, phase):
@@ -384,6 +404,39 @@ async def run_repair_storm(secs):
     return summary
 
 
+async def run_overload(secs, n_storage=3, n_zones=3):
+    """ISSUE-10 acceptance: a SimCluster whose gateway admits at most 2
+    concurrent requests is driven at 1× then 4× offered load; the
+    overload_drill asserts typed sheds only, bounded admitted p99,
+    background ceding + recovery, and bit-identical read-back."""
+    import aiohttp
+
+    from garage_tpu.testing.sim_cluster import SimCluster, overload_drill
+
+    summary = {"phases": {}, "ok": True}
+    with tempfile.TemporaryDirectory(prefix="garage_overload_") as tmp:
+        cluster = SimCluster(
+            tmp, n_storage=n_storage, n_zones=n_zones,
+            extra_cfg={"api": {"max_inflight": 2,
+                               "governor_tau": 0.5}})
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                st = await overload_drill(cluster, session, secs)
+                summary["phases"]["overload"] = st
+                for key in ("p99_within_3x", "sheds_observed",
+                            "throttle_dropped", "throttle_recovered",
+                            "admission_metric_seen",
+                            "throttle_metric_seen"):
+                    summary["ok"] &= bool(st.get(key))
+                summary["ok"] &= st.get("errors") == 0
+                summary["ok"] &= st.get("verify_mismatches") == 0
+                print(f"phase overload: {st}", file=sys.stderr)
+        finally:
+            await cluster.stop()
+    return summary
+
+
 async def run_zone(phases, secs, n_storage, n_zones):
     """The zone-scale drills on one SimCluster (built once, phases run
     in order — blackhole heals before drain, drain precedes rolling)."""
@@ -392,6 +445,7 @@ async def run_zone(phases, secs, n_storage, n_zones):
     from garage_tpu.testing.sim_cluster import (
         SimCluster,
         TrafficDriver,
+        compound_drill,
         rolling_restart_drill,
         zone_blackhole_drill,
         zone_drain_drill,
@@ -417,6 +471,14 @@ async def run_zone(phases, secs, n_storage, n_zones):
                         summary["ok"] &= bool(st.get("breaker_opened"))
                         summary["ok"] &= st.get(
                             "breaker_states_after") == ["closed"]
+                    elif phase == "compound":
+                        st = await compound_drill(
+                            cluster, traffic, secs, zone="z2")
+                        summary["ok"] &= bool(st.get("disk_errors_injected"))
+                        summary["ok"] &= st.get(
+                            "breaker_states_after") == ["closed"]
+                        summary["ok"] &= st.get("disk_state_after") == "ok"
+                        summary["ok"] &= st.get("verify_mismatches") == 0
                     elif phase == "zone_drain":
                         st = await zone_drain_drill(
                             cluster, traffic, secs,
@@ -439,7 +501,7 @@ async def run_zone(phases, secs, n_storage, n_zones):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    all_phases = PHASES + ZONE_PHASES + STORM_PHASES
+    all_phases = PHASES + ZONE_PHASES + STORM_PHASES + OVERLOAD_PHASES
     ap.add_argument("--phases", default=",".join(PHASES),
                     help="comma-separated subset of " + ",".join(all_phases))
     ap.add_argument("--secs", type=float, default=8.0,
@@ -460,6 +522,7 @@ def main():
     node_phases = [p for p in phases if p in PHASES]
     zone_phases = [p for p in phases if p in ZONE_PHASES]
     storm_phases = [p for p in phases if p in STORM_PHASES]
+    overload_phases = [p for p in phases if p in OVERLOAD_PHASES]
     if zone_phases:
         # the drills name zones z2/z{n} and a rolling restart only stays
         # client-invisible when every partition keeps ≥2 live zones
@@ -481,6 +544,10 @@ def main():
         summary["ok"] &= s["ok"]
     if storm_phases:
         s = asyncio.run(run_repair_storm(secs))
+        summary["phases"].update(s["phases"])
+        summary["ok"] &= s["ok"]
+    if overload_phases:
+        s = asyncio.run(run_overload(secs))
         summary["phases"].update(s["phases"])
         summary["ok"] &= s["ok"]
     print("CHAOS " + json.dumps(summary))

@@ -28,4 +28,15 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
 # whole-shard exact-k baseline (bytes/byte ≤ k)
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
     --phases repair_storm
+# compound-failure smoke (ISSUE-10 satellite, ROADMAP scenario list):
+# zone blackhole + flaky disk AT ONCE on a SimCluster — zero client
+# errors through the compound fault and full recovery after heal
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases compound --nodes 6 --zones 3
+# overload smoke (ISSUE-10 acceptance): 4× past the gateway's admission
+# capacity — every reject typed SlowDown/DeadlineExceeded (no hangs, no
+# untyped 500s), admitted p99 within 3× the at-capacity baseline,
+# background_throttle_ratio cedes and recovers, zero acked-data loss
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases overload
 echo "SMOKE+CHAOS OK"

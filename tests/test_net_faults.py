@@ -133,6 +133,12 @@ async def test_faulty_link_connection_resets():
         try:
             conn = await a.connect(f"127.0.0.1:{link.port}", expected_id=b.id)
             await conn.ping(timeout=0.5)
+            # a sub-ms loopback connect+ping can win the race against the
+            # 0–10 ms reset timer: wait out the timer's full window, then
+            # ping again — by now the reset MUST have landed, so this
+            # second ping on the killed connection has to raise
+            await asyncio.sleep(0.02)
+            await conn.ping(timeout=0.5)
         except Exception:
             failed = True
             break

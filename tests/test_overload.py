@@ -1,0 +1,546 @@
+"""Overload robustness (ISSUE 10): deadline propagation, admission
+control, and the background load governor.  Tier-1, deterministic —
+governor transitions run on an injected clock, deadline arithmetic uses
+explicit budgets, the multi-hop proof rides two in-process netapps."""
+
+import asyncio
+import time
+
+import pytest
+
+from garage_tpu.api.admission import AdmissionGate
+from garage_tpu.api.common import SlowDownError, error_response
+from garage_tpu.net import NetApp, gen_node_key
+from garage_tpu.net.netapp import Frame, _OutMux, node_id_of
+from garage_tpu.net.frame import K_DATA, K_REQ, PRIO_NORMAL
+from garage_tpu.net.peering import FullMeshPeering
+from garage_tpu.net.resilience import ResilienceTunables, is_transport_error
+from garage_tpu.rpc.rpc_helper import RequestStrategy, RpcHelper
+from garage_tpu.utils.config import ConfigError, config_from_dict
+from garage_tpu.utils.error import (
+    DeadlineExceeded,
+    TimeoutError_,
+    error_code,
+    remote_error,
+)
+from garage_tpu.utils.metrics import MetricsRegistry
+from garage_tpu.utils.overload import LoadGovernor, OverloadTunables
+from garage_tpu.utils.tracing import (
+    arm_deadline,
+    clamp_to_budget,
+    deadline_expired,
+    deadline_scope,
+    disarm_deadline,
+    remaining_budget,
+)
+
+pytestmark = pytest.mark.asyncio
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --- deadline arithmetic (utils/tracing) -------------------------------
+
+
+def test_deadline_clamp_arithmetic():
+    assert remaining_budget() is None
+    assert clamp_to_budget(30.0) == 30.0   # no deadline → untouched
+    assert clamp_to_budget(None) is None
+    tok = arm_deadline(0.5)
+    try:
+        rem = remaining_budget()
+        assert rem is not None and 0.4 < rem <= 0.5
+        assert clamp_to_budget(30.0) <= 0.5          # clamped down
+        assert clamp_to_budget(0.1) == 0.1           # tighter caller wins
+        assert clamp_to_budget(None) <= 0.5          # untimed call capped
+        assert not deadline_expired()
+    finally:
+        disarm_deadline(tok)
+    assert remaining_budget() is None
+
+
+def test_deadline_nested_arming_only_shrinks():
+    t1 = arm_deadline(10.0)
+    try:
+        t2 = arm_deadline(1.0)          # nested hop shrinks
+        try:
+            assert remaining_budget() <= 1.0
+            t3 = arm_deadline(100.0)    # nested hop may NOT extend
+            try:
+                assert remaining_budget() <= 1.0
+            finally:
+                disarm_deadline(t3)
+        finally:
+            disarm_deadline(t2)
+        assert remaining_budget() > 5.0  # outer budget restored
+    finally:
+        disarm_deadline(t1)
+
+
+def test_deadline_scope_and_expiry():
+    with deadline_scope(-1.0):
+        assert deadline_expired()
+        assert remaining_budget() < 0
+    assert remaining_budget() is None
+    with deadline_scope(None):          # disabled → nothing armed
+        assert remaining_budget() is None
+
+
+def test_deadline_exceeded_wire_roundtrip():
+    err = remote_error("DeadlineExceeded", "budget gone")
+    assert isinstance(err, DeadlineExceeded)
+    assert error_code(err) == "DeadlineExceeded"
+    # never a transport error: no breaker feed, no retry
+    assert not is_transport_error(DeadlineExceeded("x"))
+    assert not is_transport_error(err)
+    # API rendering: the defined 503 answer, not an anonymous 500
+    assert DeadlineExceeded.status == 503
+
+
+# --- the RPC layer clamps and fast-fails -------------------------------
+
+
+def make_helper(metrics=None, tunables=None):
+    app = NetApp(gen_node_key(), "s")
+    peering = FullMeshPeering(app, metrics=metrics, tunables=tunables)
+    helper = RpcHelper(app, peering, metrics=metrics, tunables=tunables)
+    return app, peering, helper
+
+
+async def test_call_clamps_timeout_to_remaining_budget():
+    reg = MetricsRegistry()
+    _app, _peering, helper = make_helper(metrics=reg)
+    nid = node_id_of(gen_node_key())
+    seen = []
+
+    async def record(timeout):
+        seen.append(timeout)
+        return "ok"
+
+    strategy = RequestStrategy(rs_timeout=30.0, rs_adaptive_timeout=False)
+    with deadline_scope(0.5):
+        assert await helper._call_policied("ep", nid, record, strategy) == "ok"
+    assert seen and seen[0] is not None and seen[0] <= 0.5
+
+
+async def test_call_fast_fails_on_expired_budget():
+    reg = MetricsRegistry()
+    _app, peering, helper = make_helper(metrics=reg)
+    nid = node_id_of(gen_node_key())
+    dispatched = []
+
+    async def record(timeout):
+        dispatched.append(timeout)
+        return "ok"
+
+    strategy = RequestStrategy(rs_timeout=30.0)
+    with deadline_scope(-0.1):
+        with pytest.raises(DeadlineExceeded):
+            await helper._call_policied("ep", nid, record, strategy)
+    assert dispatched == []              # shed BEFORE any dispatch
+    assert helper.m_deadline.get(endpoint="ep") == 1.0
+    # the peer took no blame: breaker untouched
+    assert peering.breaker_state(nid) == "closed"
+
+
+async def test_budget_timeout_reclassified_not_breaker_fed():
+    """A timeout caused by the budget clamp (the peer was given less
+    than its normal allowance) surfaces as DeadlineExceeded and never
+    feeds the breaker or retries."""
+    tun = ResilienceTunables(retry_max=2, deadline_floor=0.001)
+    reg = MetricsRegistry()
+    _app, peering, helper = make_helper(metrics=reg, tunables=tun)
+    nid = node_id_of(gen_node_key())
+    calls = []
+
+    async def slow(timeout):
+        calls.append(timeout)
+        # what netapp's wait_for does: the timeout fires AT the clamped
+        # budget, i.e. the deadline has passed by the time it raises
+        await asyncio.sleep(max(timeout or 0, 0) + 0.01)
+        raise TimeoutError_(f"rpc timeout after {timeout}s")
+
+    strategy = RequestStrategy(rs_timeout=30.0, rs_idempotent=True,
+                               rs_adaptive_timeout=False)
+    with deadline_scope(0.2):
+        with pytest.raises(DeadlineExceeded):
+            await helper._call_policied("ep", nid, slow, strategy)
+    assert len(calls) == 1               # no retry burned on a dead budget
+    assert peering.breaker_state(nid) == "closed"
+
+
+async def test_quorum_failure_from_expired_budget_is_typed():
+    """When every per-node dispatch of a quorum call is shed by the
+    budget, the surfaced error is DeadlineExceeded (→ 503 +
+    Retry-After at the API), never an anonymous QuorumError 500."""
+    from garage_tpu.net.netapp import node_id_of as _nid
+    from garage_tpu.utils.error import QuorumError
+
+    app, _peering, helper = make_helper(metrics=MetricsRegistry())
+    ep = app.endpoint("q")
+    nodes = [node_id_of(gen_node_key()) for _ in range(3)]
+    strategy = RequestStrategy(rs_quorum=2)
+    with deadline_scope(-0.1):
+        with pytest.raises(DeadlineExceeded):
+            await helper.try_call_many(ep, nodes, {}, strategy)
+    # reads too (interrupt_after_quorum path)
+    strategy = RequestStrategy(rs_quorum=2, rs_interrupt_after_quorum=True,
+                               rs_hedge=False)
+    with deadline_scope(-0.1):
+        with pytest.raises(DeadlineExceeded):
+            await helper.try_call_many(ep, nodes, {}, strategy)
+    # genuine quorum failures (no deadline in play) stay QuorumError
+    with pytest.raises(QuorumError):
+        await helper.try_call_many(ep, nodes, {}, strategy)
+
+
+async def test_budget_survives_multihop_forwarding():
+    """A deadline armed at the front door shrinks monotonically across
+    RPC hops: A → B (hop 1) where B's handler calls back to A (hop 2);
+    each handler reports the budget it observed."""
+    apps = [NetApp(gen_node_key(), "mh") for _ in range(2)]
+    for a in apps:
+        await a.listen("127.0.0.1:0")
+    ports = [a._server.sockets[0].getsockname()[1] for a in apps]
+    await apps[0].connect(f"127.0.0.1:{ports[1]}", expected_id=apps[1].id)
+    a, b = apps
+    budgets = {}
+
+    async def h2(remote, msg, body):
+        budgets["hop2"] = remaining_budget()
+        return {"ok": True}, None
+
+    async def h1(remote, msg, body):
+        budgets["hop1"] = remaining_budget()
+        await asyncio.sleep(0.05)        # burn some budget between hops
+        await b.endpoint("h2").call(a.id, {})
+        return {"ok": True}, None
+
+    a.endpoint("h2").set_handler(h2)
+    b.endpoint("h1").set_handler(h1)
+    try:
+        with deadline_scope(5.0):
+            await a.endpoint("h1").call(b.id, {})
+        assert budgets["hop1"] is not None and budgets["hop1"] <= 5.0
+        assert budgets["hop2"] is not None
+        assert budgets["hop2"] < budgets["hop1"]     # shrank, not reset
+        assert budgets["hop2"] > 0
+        # no deadline armed → no budget forwarded
+        budgets.clear()
+        await a.endpoint("h1").call(b.id, {})
+        assert budgets["hop1"] is None and budgets["hop2"] is None
+    finally:
+        for app in apps:
+            await app.shutdown()
+
+
+async def test_expired_handler_answers_typed_without_running():
+    """A request arriving with zero budget is answered DeadlineExceeded
+    by the transport without invoking the handler."""
+    apps = [NetApp(gen_node_key(), "xh") for _ in range(2)]
+    for a in apps:
+        await a.listen("127.0.0.1:0")
+    ports = [a._server.sockets[0].getsockname()[1] for a in apps]
+    await apps[0].connect(f"127.0.0.1:{ports[1]}", expected_id=apps[1].id)
+    ran = []
+
+    async def h(remote, msg, body):
+        ran.append(1)
+        return {"ok": True}, None
+
+    apps[1].endpoint("h").set_handler(h)
+    try:
+        with deadline_scope(-0.5):       # already expired at send time
+            with pytest.raises(DeadlineExceeded):
+                await apps[0].endpoint("h").call(apps[1].id, {},
+                                                 timeout=5.0)
+        assert ran == []
+    finally:
+        for app in apps:
+            await app.shutdown()
+
+
+async def test_outmux_drops_expired_request_frames():
+    mux = _OutMux()
+    dropped = []
+    # an already-expired K_REQ queued behind nothing: the writer must
+    # discard it (on_drop fires) and hand out the live frame instead
+    await mux.put(Frame(K_REQ, PRIO_NORMAL, 1, b"dead"),
+                  deadline=time.monotonic() - 1.0,
+                  on_drop=lambda: dropped.append(1))
+    await mux.put(Frame(K_DATA, PRIO_NORMAL, 3, b"live"))
+    frame, _t = await mux.pop()
+    assert frame.payload == b"live"
+    assert dropped == [1]
+    assert mux.expired_drops == 1
+    # frames with a FUTURE deadline flow normally
+    await mux.put(Frame(K_REQ, PRIO_NORMAL, 5, b"soon"),
+                  deadline=time.monotonic() + 30.0,
+                  on_drop=lambda: dropped.append(2))
+    frame, _t = await mux.pop()
+    assert frame.payload == b"soon" and dropped == [1]
+
+
+# --- admission gate ----------------------------------------------------
+
+
+def test_admission_gate_sheds_at_watermark_admits_after_drain():
+    reg = MetricsRegistry()
+    gate = AdmissionGate(OverloadTunables(max_inflight=2), metrics=reg)
+    t1 = gate.try_admit()
+    t2 = gate.try_admit()
+    assert t1 is not None and t2 is not None
+    assert gate.try_admit() is None                  # sheds at watermark
+    assert gate.m_admission.get(verdict="admit") == 2.0
+    assert gate.m_admission.get(verdict="shed") == 1.0
+    t1.release()
+    assert gate.try_admit() is not None              # admits after drain
+    assert gate.inflight == 2
+    t1.release()                                     # double-release: no-op
+    assert gate.inflight == 2
+
+
+def test_admission_gate_bytes_watermark():
+    gate = AdmissionGate(OverloadTunables(max_inflight=0,
+                                          max_inflight_bytes=100))
+    big = gate.try_admit(1000)
+    assert big is not None        # an empty gate always admits one —
+    #                               oversized ≠ unservable
+    assert gate.try_admit(10) is None                # bytes watermark
+    big.release()
+    assert gate.inflight_bytes == 0
+    assert gate.try_admit(50) is not None
+
+
+def test_admission_gate_never_sheds_admitted_midstream():
+    """Admission is decided once at intake: a token held through a long
+    streaming transfer stays valid no matter how hot the gate gets."""
+    gate = AdmissionGate(OverloadTunables(max_inflight=1))
+    streaming = gate.try_admit(1 << 20)
+    assert streaming is not None
+    for _ in range(50):                              # storm hits mid-stream
+        assert gate.try_admit() is None
+    # the in-flight transfer was never revoked; its release re-opens
+    assert gate.inflight == 1
+    streaming.release()
+    assert gate.try_admit() is not None
+
+
+def test_occupancy_signal():
+    gate = AdmissionGate(OverloadTunables(max_inflight=4,
+                                          max_inflight_bytes=1000))
+    assert gate.occupancy() == 0.0
+    toks = [gate.try_admit(100) for _ in range(2)]
+    assert gate.occupancy() == pytest.approx(0.5)
+    for t in toks:
+        t.release()
+    assert gate.occupancy() == 0.0
+
+
+# --- load governor -----------------------------------------------------
+
+
+def test_governor_ratio_drops_and_recovers():
+    clk = FakeClock()
+    tun = OverloadTunables(governor_low=0.4, governor_high=0.8,
+                           governor_min_ratio=0.05, governor_tau=1.0)
+    gov = LoadGovernor(tun, clock=clk)
+    pressure = [0.0]
+    gov.add_signal("test", lambda: pressure[0])
+    assert gov.ratio() == 1.0
+    # saturation: ratio decays toward min_ratio
+    pressure[0] = 1.0
+    clk.advance(10.0)
+    assert gov.ratio() == pytest.approx(0.05, abs=0.01)
+    # between the watermarks: partial throttle
+    pressure[0] = 0.6
+    clk.advance(10.0)
+    assert 0.3 < gov.ratio() < 0.7
+    # pressure clears: full background rate restored
+    pressure[0] = 0.0
+    clk.advance(10.0)
+    assert gov.ratio() == 1.0
+
+
+def test_governor_smoothing_not_instant():
+    clk = FakeClock()
+    gov = LoadGovernor(OverloadTunables(governor_tau=2.0), clock=clk)
+    pressure = [1.0]
+    gov.add_signal("test", lambda: pressure[0])
+    clk.advance(0.5)                     # much less than tau
+    r = gov.ratio()
+    assert 0.5 < r < 1.0                 # moving, but not slammed shut
+
+
+def test_governor_bg_pause_duty_cycle():
+    clk = FakeClock()
+    gov = LoadGovernor(OverloadTunables(governor_tau=0.1,
+                                        governor_min_ratio=0.1), clock=clk)
+    assert gov.bg_pause(0.1) == 0.0      # no pressure: no pause
+    pressure = [1.0]
+    gov.add_signal("test", lambda: pressure[0])
+    clk.advance(10.0)
+    pause = gov.bg_pause(0.1)
+    assert pause > 0.5                   # ~0.1 * (1-0.1)/0.1 = 0.9
+    assert gov.bg_pause(100.0) <= 2.0    # capped
+    # a dead signal reads as zero pressure, not a crash
+    gov.add_signal("broken", lambda: 1 / 0)
+    assert gov.pressure() >= 1.0
+
+
+def test_governor_queue_wait_signal_decays():
+    clk = FakeClock()
+    tun = OverloadTunables(governor_queue_wait_full=0.05, governor_tau=1.0)
+    gov = LoadGovernor(tun, clock=clk)
+    for _ in range(50):
+        clk.advance(0.05)
+        gov.note_queue_wait(0.2)         # 4× the full-pressure wait
+    assert gov.pressure() > 1.0
+    clk.advance(30.0)                    # silence: pressure ages out
+    assert gov.pressure() < 0.1
+
+
+# --- feeder sheds expired submissions ----------------------------------
+
+
+async def test_feeder_sheds_expired_submission():
+    from garage_tpu.ops import make_codec
+    from garage_tpu.ops.feeder import CodecFeeder
+
+    feeder = CodecFeeder(make_codec("cpu", rs_data=2, rs_parity=1),
+                         slo_ms=1.0, max_batch_blocks=64)
+    try:
+        with deadline_scope(-0.5):       # submitter's budget already gone
+            dead = feeder.submit_hash([b"x" * 100])
+        live = feeder.submit_hash([b"x" * 100])
+        with pytest.raises(DeadlineExceeded):
+            dead.result(timeout=5.0)
+        assert len(live.result(timeout=5.0)) == 1    # batchmate unharmed
+        assert feeder.stats()["expired"] == 1
+    finally:
+        feeder.shutdown()
+
+
+# --- API rendering (Retry-After / RequestId satellite) -----------------
+
+
+def test_error_response_503_carries_retry_after_and_request_id():
+    resp = error_response(SlowDownError(retry_after=3), "/b/k")
+    assert resp.status == 503
+    assert resp.headers["Retry-After"] == "3"
+    rid = resp.headers["x-amz-request-id"]
+    assert rid and len(rid) == 32
+    body = resp.body
+    assert b"<Code>SlowDown</Code>" in body
+    assert f"<RequestId>{rid}</RequestId>".encode() in body
+    # DeadlineExceeded renders the same defined-overload way
+    resp = error_response(DeadlineExceeded("budget gone"), "/b/k", "a" * 32)
+    assert resp.status == 503
+    assert resp.headers["Retry-After"] == "1"
+    assert resp.headers["x-amz-request-id"] == "a" * 32
+    assert b"<Code>DeadlineExceeded</Code>" in resp.body
+    # non-503 errors carry the RequestId but no Retry-After
+    resp = error_response(ValueError("boom"), "/b", "b" * 32)
+    assert resp.status == 500
+    assert "Retry-After" not in resp.headers
+    assert resp.headers["x-amz-request-id"] == "b" * 32
+
+
+# --- config section ----------------------------------------------------
+
+
+def test_api_config_section_parses_and_validates():
+    cfg = config_from_dict({
+        "metadata_dir": "/tmp/x", "rpc_secret": "s",
+        "api": {"max_inflight": 8, "max_inflight_bytes": "256M",
+                "governor_min_ratio": 0.2},
+        "rpc": {"deadline_default": 10.0, "deadline_floor": 0.05},
+    })
+    assert cfg.api.max_inflight == 8
+    assert cfg.api.max_inflight_bytes == 256 * 10**6
+    assert cfg.rpc.deadline_default == 10.0
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/x", "rpc_secret": "s",
+                          "api": {"bogus_knob": 1}})
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/x", "rpc_secret": "s",
+                          "api": {"max_inflight": -1}})
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/x", "rpc_secret": "s",
+                          "api": {"governor_min_ratio": 0.0}})
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/x", "rpc_secret": "s",
+                          "rpc": {"deadline_floor": -1}})
+
+
+# --- promlint over the new families ------------------------------------
+
+
+def test_overload_metric_families_pass_promlint():
+    from garage_tpu.utils.promlint import lint_exposition
+
+    reg = MetricsRegistry()
+    gate = AdmissionGate(OverloadTunables(max_inflight=2), metrics=reg)
+    gov = LoadGovernor(OverloadTunables(), metrics=reg)
+    gov.add_signal("admission", gate.occupancy)
+    app = NetApp(gen_node_key(), "s")
+    peering = FullMeshPeering(app, metrics=reg)
+    helper = RpcHelper(app, peering, metrics=reg)
+    tok = gate.try_admit(100)
+    gate.try_admit()
+    gate.try_admit()                     # one shed
+    helper.m_deadline.inc(endpoint="block/put")
+    gov.note_queue_wait(0.01)
+    body = reg.render()
+    for fam in ("api_inflight_requests", "api_admission_total",
+                "rpc_deadline_exceeded_total", "background_throttle_ratio",
+                "governor_pressure"):
+        assert fam in body, fam
+    assert lint_exposition(body) == []
+    tok.release()
+
+
+# --- end-to-end: the S3 front door sheds typed -------------------------
+
+
+async def test_s3_front_door_sheds_typed_503(tmp_path):
+    """With the gateway's gate held full, a real S3 request is shed with
+    the full contract: 503, Code SlowDown, Retry-After, RequestId — and
+    admitted again the moment the gate drains."""
+    import xml.etree.ElementTree as ET
+
+    from test_s3_api import make_api_cluster, stop_all
+
+    garages, server, client, _key = await make_api_cluster(tmp_path)
+    try:
+        st, _h, _b = await client.req("PUT", "/shedbkt")
+        assert st == 200
+        gate = garages[0].admission
+        # hold the gate at its watermark from the outside
+        hold = [gate.try_admit()
+                for _ in range(gate.tun.max_inflight - gate.inflight)]
+        st, hdrs, body = await client.req(
+            "PUT", "/shedbkt/obj", body=b"x" * 1024)
+        assert st == 503
+        assert hdrs.get("Retry-After") == "1"
+        root = ET.fromstring(body)
+        assert root.findtext("Code") == "SlowDown"
+        assert root.findtext("RequestId")
+        assert hdrs.get("x-amz-request-id") == root.findtext("RequestId")
+        for t in hold:
+            t.release()
+        st, _h, _b = await client.req(
+            "PUT", "/shedbkt/obj", body=b"x" * 1024)
+        assert st == 200                 # admitted after drain
+        assert gate.shed_total >= 1
+    finally:
+        await stop_all(garages, server)

@@ -341,13 +341,25 @@ async def test_cluster_ppr_drill_bit_identical_with_mixed_version(tmp_path):
                  "peers": g.system._peer_book()},
                 timeout=5.0)
 
-        # coordinate from a node that does NOT hold the first block
+        # coordinate from a node that holds NO piece of the first
+        # block's codeword (placement is ring-random per run: a node
+        # holding a surviving member/parity would serve that piece
+        # LOCALLY, and an unlucky single-member codeword could then
+        # reconstruct with zero wire bytes — the `ppr bytes moved`
+        # assert below needs every fetched piece to be remote)
         def holder_of(bh):
             return bytes(garages[0].block_manager.replication.write_nodes(
                 Hash(bh))[0])
 
+        ent0 = entries[bytes(hs[0])]
+        piece_holders = {
+            holder_of(bytes(ph))
+            for ph in list(ent0.members) + list(ent0.parity_hashes)
+            if bytes(ph) != bytes(32)
+        }
         coord = next(g for g in garages
-                     if bytes(g.system.id) != holder_of(hs[0]))
+                     if bytes(g.system.id) not in piece_holders
+                     and bytes(g.system.id) != holder_of(hs[0]))
         planner = coord.block_manager.repair_planner
         assert planner is not None and planner.use_ppr
 
@@ -359,9 +371,16 @@ async def test_cluster_ppr_drill_bit_identical_with_mixed_version(tmp_path):
             "no partial products moved"
 
         # mixed-version: one OTHER node gossips a pre-PPR version; the
-        # planner must stop sending it `ppr` and whole-shard its pieces
+        # planner must stop sending it `ppr` and whole-shard its pieces.
+        # Pick the old node among nodes that actually HOLD a test block
+        # (placement is ring-random per run: an arbitrary node holds one
+        # of the 8 blocks only ~83% of the time, and the capability-gate
+        # check below needs a block whose sole holder is the old node —
+        # the holder of hs[0] always qualifies and is never coord)
         old = next(g for g in garages
-                   if bytes(g.system.id) != bytes(coord.system.id))
+                   if bytes(g.system.id) != bytes(coord.system.id)
+                   and any(holder_of(bytes(h)) == bytes(g.system.id)
+                           for h in hs))
         old.system.version = "0.1.0"
         await old.system.rpc.broadcast(
             old.system.endpoint,
