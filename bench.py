@@ -1597,6 +1597,99 @@ async def _overload_phase_async() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+async def _transport_phase_async() -> dict:
+    """Paired A/B for the zero-copy device transport (ISSUE 11): the
+    SAME workload — scrub batches (bg) + foreground hash windows riding
+    one CodecFeeder — against the synthetic in-process device backend,
+    once over the legacy serialize+copy routing (transport=False: the
+    feeder's device batches repack through the bytes-level codec API)
+    and once over the DeviceTransport staging path.  Windows alternate
+    old/new to cancel host drift (the put_batched discipline).  Reports
+    measured link GiB/s for both paths, host copies per staged block
+    (old: pack + transfer-serialize = 2; new: ≤ 1 by counter), the
+    per-side byte attribution, and `sustained_tpu_frac` — the gate
+    provably OPEN through the new path."""
+    from garage_tpu.ops.codec import CodecParams
+    from garage_tpu.ops.feeder import CodecFeeder
+    from garage_tpu.ops.hybrid_codec import HybridCodec
+    from garage_tpu.testing.synthetic_device import SyntheticLinkCodec
+    from garage_tpu.utils.data import Hash
+
+    blk = 1 << 20
+    n_scrub, scrub_blocks = 4, 2 * K
+    n_hash, hash_blocks = 8, 4
+    rng = np.random.default_rng(7)
+    base = rng.integers(0, 256, (scrub_blocks, blk), dtype=np.uint8)
+
+    def mk_rig(transport: bool):
+        params = CodecParams(rs_data=K, rs_parity=M, block_size=blk,
+                             transport=transport)
+        dev = SyntheticLinkCodec(params, link_gibs=0.3, compute_real=True)
+        hy = HybridCodec(params, device_codec=dev)
+        hy._probe_link()            # cache the open-gate verdict
+        feeder = CodecFeeder(hy, slo_ms=1.0, max_batch_blocks=512)
+        return params, dev, hy, feeder
+
+    def window(dev, hy, feeder) -> float:
+        blocks = [base[i % scrub_blocks].tobytes()
+                  for i in range(scrub_blocks)]
+        hashes = [Hash(hashlib.blake2s(b, digest_size=32).digest())
+                  for b in blocks]
+        t0 = time.perf_counter()
+        futs = [feeder.submit_scrub(blocks, hashes, want_parity=True)
+                for _ in range(n_scrub)]
+        futs += [feeder.submit_hash(blocks[:hash_blocks], peers=1)
+                 for _ in range(n_hash)]
+        for f in futs:
+            r = f.result(timeout=300)
+            if isinstance(r, tuple):
+                assert r[0].all(), "corruption reported in clean batch"
+        return time.perf_counter() - t0
+
+    rigs = {"old": mk_rig(False), "new": mk_rig(True)}
+    assert rigs["old"][2].transport is None
+    assert rigs["new"][2].transport is not None, "transport not armed"
+    times = {"old": 0.0, "new": 0.0}
+    for tag in ("old", "new"):        # warm (compile pools, caches)
+        window(*rigs[tag][1:])
+    rounds = 3
+    for _ in range(rounds):           # paired windows cancel host drift
+        for tag in ("old", "new"):
+            times[tag] += window(*rigs[tag][1:])
+    total_bytes = rounds * (n_scrub * scrub_blocks
+                            + n_hash * hash_blocks) * blk
+    _p_old, dev_old, hy_old, feeder_old = rigs["old"]
+    _p_new, dev_new, hy_new, feeder_new = rigs["new"]
+    tr = hy_new.transport
+    link_new = tr.probe_link(16 << 20)
+    frac = hy_new.obs.tpu_frac()
+    by_side = dict(hy_new.obs.bytes_total)
+    old_blocks = max(dev_old.blocks_submitted, 1)
+    out = {
+        "transport_old_gibs": round(total_bytes / times["old"] / 2**30, 4),
+        "transport_new_gibs": round(total_bytes / times["new"] / 2**30, 4),
+        "transport_speedup": round(times["old"] / times["new"], 3),
+        "transport_old_copies_per_block": round(
+            dev_old.host_copies / old_blocks, 2),
+        "transport_new_copies_per_block": round(tr.copies_per_block(), 4),
+        "transport_link_gibs": round(link_new, 4),
+        "transport_old_link_gibs": 0.3,
+        "sustained_tpu_frac": round(frac, 4),
+        "transport_bytes_by_side": by_side,
+        "transport_stats": tr.stats(),
+        "transport_old_bytes_level_submissions": dev_old.submissions,
+        "transport_new_bytes_level_submissions": dev_new.submissions,
+    }
+    assert frac > 0.0, "gate failed to open through the transport"
+    assert tr.copies_per_block() <= 1.0, tr.stats()
+    assert dev_new.submissions == 0, \
+        "new path leaked a bytes-level device submission"
+    for feeder in (feeder_old, feeder_new):
+        feeder.shutdown()
+    hy_new.close()
+    return out
+
+
 _PHASES = {
     "--put-phase": _put_phase_async,
     "--put-solo-phase": _put_solo_phase_async,
@@ -1607,6 +1700,7 @@ _PHASES = {
     "--repair-storm-phase": _repair_storm_phase_async,
     "--wan-phase": _wan_phase_async,
     "--overload-phase": _overload_phase_async,
+    "--transport-phase": _transport_phase_async,
 }
 
 
@@ -1863,8 +1957,12 @@ def _headline_guard(out: dict) -> int:
             f"prior round ({best:.3f} GiB/s in {src}) — failing the run. "
             f"Attribution: gate={out.get('hybrid_gate')} "
             f"link={out.get('hybrid_link_gibs')} GiB/s "
-            f"cpu={out.get('cpu_gibs')} GiB/s; see the `attribution` "
-            f"block in the emitted JSON for per-stage timings.",
+            f"cpu={out.get('cpu_gibs')} GiB/s "
+            f"transport_frac={out.get('sustained_tpu_frac')} "
+            f"copies/block={out.get('transport_new_copies_per_block')}; "
+            f"see the `attribution` block in the emitted JSON for "
+            f"per-stage timings and the transport_* keys for the "
+            f"zero-copy A/B.",
             file=sys.stderr, flush=True)
         return 1
     return 0
@@ -1958,6 +2056,8 @@ def main() -> None:
     out.update(run_phase_subprocess("--repair-storm-phase", timeout=900))
     emit()
     out.update(run_phase_subprocess("--overload-phase"))
+    emit()
+    out.update(run_phase_subprocess("--transport-phase"))
     emit()
     out.update(run_phase_subprocess("--wan-phase"))
     emit()

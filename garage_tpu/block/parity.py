@@ -239,8 +239,11 @@ class ParityStore:
             # swapping codecs) — a mismatched (k, m) must decode direct.
             feeder = getattr(self.manager, "feeder", None)
             if feeder is not None and feeder.codec is self.codec:
+                # cls="bg": sidecar rebuilds run from the scrub/resync
+                # heal paths — in the device transport's single queue
+                # they yield to live foreground verifies/decodes
                 data = feeder.decode_or_direct(
-                    shards, present, rows=[target_i])[0]
+                    shards, present, rows=[target_i], cls="bg")[0]
             else:
                 data = self.codec.rs_reconstruct(
                     shards, present, rows=[target_i])[0]  # (1, maxlen)

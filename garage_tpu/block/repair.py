@@ -392,9 +392,21 @@ class ScrubWorker(Worker):
                 "Scrub batch", blocks=len(all_b),
                 bytes=sum(len(b) for b in all_b),
             ):
-                ok, parity = await asyncio.to_thread(
-                    mgr.codec.scrub_encode_batch, all_b, all_h, want_parity,
-                )
+                # through the codec feeder when armed: scrub batches are
+                # background-class submissions in the SAME queue as the
+                # foreground verifies, so on a device-armed node they
+                # enter the zero-copy transport deadline-ordered behind
+                # live traffic instead of talking to the device behind
+                # the feeder's back (ops/transport.py); a closed/absent
+                # feeder keeps the pre-transport direct call
+                if mgr.feeder is not None:
+                    ok, parity = await mgr.feeder.scrub_async(
+                        all_b, all_h, want_parity)
+                else:
+                    ok, parity = await asyncio.to_thread(
+                        mgr.codec.scrub_encode_batch, all_b, all_h,
+                        want_parity,
+                    )
             for j, good in enumerate(ok[nc:]):
                 if not good:
                     h, path, _ = batch[plain_idx[j]]

@@ -296,6 +296,11 @@ class Garage:
         self.system.netapp.queue_wait_hook = self.governor.note_queue_wait
         # repair-storm fetch concurrency clamps against the same ratio
         self.block_manager.governor = self.governor
+        # the device transport demotes background batches against the
+        # same ratio (survives a late async device attach)
+        codec = self.block_manager.codec
+        if hasattr(codec, "set_governor"):
+            codec.set_governor(self.governor.ratio)
 
         self.bg = BackgroundRunner()
         # background workers duty-cycle against foreground pressure
@@ -474,6 +479,13 @@ class Garage:
             import asyncio
 
             await asyncio.to_thread(self.block_manager.feeder.shutdown)
+        # device transport: drain staged/queued device batches (its
+        # worker falls back to CPU inline if the device died mid-drain)
+        codec = self.block_manager.codec
+        if hasattr(codec, "close"):
+            import asyncio
+
+            await asyncio.to_thread(codec.close)
         # quorum-write stragglers and cancelled-read losers still talk
         # through the transport: give them a bounded drain BEFORE workers
         # and the netapp go away (System.shutdown drains again, cheaply,

@@ -85,6 +85,22 @@ class CodecParams:
     # UNBOUNDED — one fused call per contiguous segment, byte-identical
     # in cost to the plain CPU codec path (VERDICT r4 #3).
     hybrid_cpu_span_blocks: int = 128
+    # --- DeviceTransport (ops/transport.py): the zero-copy colocated
+    # submission queue between the CodecFeeder and the device codec.
+    # transport=False restores the legacy per-call serialize+copy
+    # routing (hybrid ragged batches through the device codec's
+    # bytes-level API).
+    transport: bool = True
+    # Staging slots (double buffering): batch N+1 stages and submits
+    # while batch N computes.  The per-chunk staging bound is
+    # max_device_staging_mib / transport_staging_slots, so all slots
+    # together never exceed the configured budget.
+    transport_staging_slots: int = 2
+    # Background demotion slack (ms): a background (scrub/resync) batch
+    # sorts behind foreground batches arriving within this window; the
+    # slack stretches by 1/background_throttle_ratio when the load
+    # governor reports foreground pressure.
+    transport_bg_slack_ms: float = 50.0
     # Minimum measured host→device round-trip rate for the hybrid feeder
     # to claim any work.  Staging a submission costs ~3-5% of a CPU
     # verify for the same bytes, and a claimed-but-undelivered group is
@@ -272,6 +288,15 @@ class BlockCodec:
                     dec[off:off + sh.shape[0], :, : sh.shape[-1]])
                 off += sh.shape[0]
         return out  # type: ignore[return-value]
+
+    def scrub_ragged(self, items: Sequence[tuple]) -> List[tuple]:
+        """Many scrub_encode_batch submissions (the CodecFeeder's `scrub`
+        kind): items are (blocks, hashes, fetch_parity) tuples, result
+        is per-item (ok, parity|None).  The base implementation runs
+        them serially; HybridCodec routes the batch to one side, and a
+        device-armed feeder bypasses this entirely through the
+        DeviceTransport."""
+        return [self.scrub_encode_batch(b, h, fp) for b, h, fp in items]
 
     def scrub_encode_batch(self, blocks: Sequence[bytes],
                            hashes: Sequence[Hash],

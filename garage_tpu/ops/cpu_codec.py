@@ -12,6 +12,7 @@ as the TPU backend.
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import os
 import threading
@@ -63,6 +64,17 @@ class CpuCodec(BlockCodec):
         self._native_ptrs = get_native_gf_matmul_ptrs()
         if params.rs_data > 0:
             self._parity_mat = gf256.rs_parity_matrix(params.rs_data, params.rs_parity)
+        # encode-schedule cache, keyed by (k, m, geometry): a partial
+        # codeword of j < k members only needs the generator's first j
+        # columns — the remaining k-j operands are implicit zeros, and
+        # multiplying them is pure waste ("Accelerating XOR-based
+        # Erasure Coding": drop zero operands from the schedule, cache
+        # the schedule, re-run the apply).  Decode has carried the
+        # equivalent cache since round 6; encode paid full-width work
+        # for every lone-put partial codeword.  Bounded LRU (tiny:
+        # geometries are 1..k-1, but the bound holds if k grows).
+        self._enc_cache: "collections.OrderedDict" = collections.OrderedDict()
+        self._enc_cache_lock = threading.Lock()
         # decode-schedule cache, keyed by survivor pattern: building the
         # recovery matrix (generator submatrix + GF inversion) costs more
         # than applying it to a single small decode, and degraded reads /
@@ -90,19 +102,114 @@ class CpuCodec(BlockCodec):
         assert data.shape[-2] == self.params.rs_data, data.shape
         return self._apply(self._parity_mat, np.ascontiguousarray(data, dtype=np.uint8))
 
+    _ENC_CACHE_MAX = 64
+
+    def encode_matrix(self, ncols: int) -> np.ndarray:
+        """Cached encode schedule for a partial codeword of `ncols`
+        members: the parity generator's first ncols columns (the other
+        k-ncols operands are implicit zero shards — zero contributes
+        zero over GF(2^8), so dropping the columns is exact).  Keyed by
+        (k, m, geometry), bounded LRU — the encode-side twin of
+        decode_matrix."""
+        k, m = self.params.rs_data, self.params.rs_parity
+        ncols = min(ncols, k)
+        if ncols == k:
+            return self._parity_mat
+        key = (k, m, ncols)
+        with self._enc_cache_lock:
+            mat = self._enc_cache.get(key)
+            if mat is not None:
+                self._enc_cache.move_to_end(key)
+                return mat
+        mat = np.ascontiguousarray(self._parity_mat[:, :ncols])
+        with self._enc_cache_lock:
+            self._enc_cache[key] = mat
+            while len(self._enc_cache) > self._ENC_CACHE_MAX:
+                self._enc_cache.popitem(last=False)
+        return mat
+
+    def _encode_codewords(self, bufs: Sequence[bytes], mat: np.ndarray,
+                          s: int) -> np.ndarray:
+        """One schedule application: len(bufs)/ncols codewords of ncols
+        members each, zero-extended to width s → (B, m, s) parity.
+        Pointer-gather kernel when built (no packing pass), else pack +
+        the blocks kernel."""
+        r, ncols = mat.shape
+        assert len(bufs) % ncols == 0, (len(bufs), ncols)
+        if self._native_ptrs is not None:
+            return self._native_ptrs(mat, list(bufs), s)
+        arr = np.zeros((len(bufs), s), dtype=np.uint8)
+        for i, b in enumerate(bufs):
+            arr[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        return self._apply(mat, arr.reshape(-1, ncols, s))
+
     def rs_encode_blocks(self, blocks: Sequence[bytes]) -> np.ndarray:
-        """Pointer-gather override: when the GFNI kernel is present, parity
-        is computed straight from the original block buffers — the base
-        class's (B, k, S) packing memcpy alone costs more than the encode
-        it feeds."""
-        if self._native_ptrs is None:
-            return super().rs_encode_blocks(blocks)
+        """Schedule-aware override: full codewords run the full
+        generator; a trailing partial codeword runs the cached
+        column-sliced schedule instead of multiplying zero pads —
+        bit-identical output (zero shards encode to zero parity), and a
+        lone single-block put pays 1/k of the GF work."""
         k = self.params.rs_data
         assert k > 0 and blocks
+        blocks = list(blocks)
         maxlen = max(len(b) for b in blocks)
-        pad = (-len(blocks)) % k
-        return self._native_ptrs(
-            self._parity_mat, list(blocks) + [b""] * pad, maxlen)
+        nfull = (len(blocks) // k) * k
+        parts = []
+        if nfull:
+            parts.append(self._encode_codewords(
+                blocks[:nfull], self._parity_mat, maxlen))
+        tail = blocks[nfull:]
+        if tail:
+            parts.append(self._encode_codewords(
+                tail, self.encode_matrix(len(tail)), maxlen))
+        return parts[0] if len(parts) == 1 else np.concatenate(parts,
+                                                               axis=0)
+
+    def rs_encode_ragged(self, groups: Sequence[Sequence[bytes]]
+                         ) -> List[np.ndarray]:
+        """Fused ragged encode with schedule sharing: every group's full
+        codewords join ONE generator application, and partial tails are
+        batched per geometry through the cached sliced schedules (the
+        XOR-schedule fusion of "Accelerating XOR-based Erasure Coding"
+        — no zero pad blocks are materialized or multiplied at all).
+        Per-group results are bit-identical to rs_encode_blocks(group)."""
+        k = self.params.rs_data
+        assert k > 0 and groups
+        maxlen = max(len(b) for g in groups for b in g)
+        full_bufs: List[bytes] = []
+        tails: dict = {}
+        for gi, g in enumerate(groups):
+            assert g, "empty encode submission"
+            nfull = (len(g) // k) * k
+            full_bufs.extend(g[:nfull])
+            if len(g) > nfull:
+                tails.setdefault(len(g) - nfull, []).append(gi)
+        full_par = (self._encode_codewords(full_bufs, self._parity_mat,
+                                           maxlen)
+                    if full_bufs else None)
+        tail_par: dict = {}
+        for ncols, gis in tails.items():
+            bufs = [b for gi in gis
+                    for b in groups[gi][(len(groups[gi]) // k) * k:]]
+            par = self._encode_codewords(
+                bufs, self.encode_matrix(ncols), maxlen)
+            for j, gi in enumerate(gis):
+                tail_par[gi] = par[j:j + 1]
+        out: List[np.ndarray] = []
+        r = 0
+        for gi, g in enumerate(groups):
+            nrows = len(g) // k
+            ml = max(len(b) for b in g)
+            rows = []
+            if nrows:
+                rows.append(full_par[r:r + nrows])
+                r += nrows
+            if gi in tail_par:
+                rows.append(tail_par[gi])
+            par = rows[0] if len(rows) == 1 else np.concatenate(rows,
+                                                                axis=0)
+            out.append(np.ascontiguousarray(par[:, :, :ml]))
+        return out
 
     def gf_scale(self, coeff: int, buf: bytes,
                  limit: Optional[int] = None) -> bytes:

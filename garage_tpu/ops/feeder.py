@@ -51,7 +51,7 @@ import numpy as np
 
 logger = logging.getLogger("garage_tpu.ops.feeder")
 
-KINDS = ("hash", "encode", "decode")
+KINDS = ("hash", "encode", "decode", "scrub")
 
 # histogram edges tuned to the objects being measured: waits are bounded
 # by slo_ms (default 2 ms), batch sizes by max_batch_blocks
@@ -68,13 +68,20 @@ class FeederClosed(RuntimeError):
 
 class _Item:
     __slots__ = ("kind", "payload", "blocks", "nbytes", "future", "ts",
-                 "peers", "deadline")
+                 "peers", "deadline", "cls", "want_parity")
 
-    def __init__(self, kind, payload, blocks, nbytes, peers=None):
+    def __init__(self, kind, payload, blocks, nbytes, peers=None,
+                 cls="fg", want_parity=True):
         self.kind = kind
         self.payload = payload
         self.blocks = blocks
         self.nbytes = nbytes
+        # scheduling class for the device transport's single queue:
+        # "fg" = client-facing (PUT/GET verify, write-time encode,
+        # degraded-read decode), "bg" = scrub/resync producers, demoted
+        # behind foreground under governor pressure (ops/transport.py)
+        self.cls = cls if cls in ("fg", "bg") else "fg"
+        self.want_parity = want_parity
         # end-to-end request deadline (absolute time.monotonic), captured
         # from the submitter's task-local budget (utils/tracing): an
         # expired submission is failed typed at dispatch instead of
@@ -110,6 +117,18 @@ class CodecFeeder:
         self._closed = False
         self._inflight = 0
         self._thread: Optional[threading.Thread] = None
+        # lazy daemon worker for INLINE (CPU-side) scrub batches: a
+        # multi-MiB fused verify+encode must not run on the lone
+        # dispatcher thread, where it would head-of-line block every
+        # foreground hash/encode/decode dispatch (pre-feeder, scrub
+        # compute ran on its own asyncio.to_thread worker).  A daemon
+        # thread + queue rather than a ThreadPoolExecutor: executor
+        # threads are non-daemon and have no bounded join, so one
+        # wedged codec call would hang both shutdown() and interpreter
+        # exit.
+        self._scrub_q: Optional[collections.deque] = None
+        self._scrub_cond = threading.Condition()
+        self._scrub_thread: Optional[threading.Thread] = None
         self._last_side: Optional[str] = None
         # always-on counters (admin `codec info` + bench self-attribution)
         self.submits = 0
@@ -187,34 +206,50 @@ class CodecFeeder:
         return item.future
 
     def submit_hash(self, blocks: Sequence[bytes],
-                    peers: Optional[int] = None):
+                    peers: Optional[int] = None, cls: str = "fg"):
         """BLAKE2s block-id hashing for one request's window of blocks.
         Future resolves to List[Hash] in submission order.  `peers` =
         concurrent submitters the caller can see (see _Item.peers)."""
         blocks = list(blocks)
         return self._submit(_Item(
             "hash", blocks, len(blocks), sum(len(b) for b in blocks),
-            peers=peers))
+            peers=peers, cls=cls))
 
     def submit_encode(self, blocks: Sequence[bytes],
-                      peers: Optional[int] = None):
+                      peers: Optional[int] = None, cls: str = "fg"):
         """RS parity for one request's blocks (own codeword group,
         zero-padded to whole codewords — rs_encode_blocks semantics).
         Future resolves to (ceil(B/k), m, maxlen) uint8 parity."""
         blocks = list(blocks)
         return self._submit(_Item(
             "encode", blocks, len(blocks), sum(len(b) for b in blocks),
-            peers=peers))
+            peers=peers, cls=cls))
 
     def submit_decode(self, shards: np.ndarray, present: Sequence[int],
                       rows: Optional[Sequence[int]] = None,
-                      peers: Optional[int] = None):
+                      peers: Optional[int] = None, cls: str = "fg"):
         """One degraded-read RS decode (rs_reconstruct semantics).
         Future resolves to the decoded (B, len(rows) or k, S) array."""
         return self._submit(_Item(
             "decode", (shards, list(present),
                        list(rows) if rows is not None else None),
-            max(1, int(shards.shape[0])), int(shards.nbytes), peers=peers))
+            max(1, int(shards.shape[0])), int(shards.nbytes), peers=peers,
+            cls=cls))
+
+    def submit_scrub(self, blocks: Sequence[bytes], hashes: Sequence,
+                     want_parity: bool = True, cls: str = "bg"):
+        """One scrub/resync batch (scrub_encode_batch semantics: fused
+        verify + per-codeword RS parity).  Future resolves to
+        (ok (B,), parity | None).  This is how the background producers
+        ride the SAME feeder queue as foreground verifies — the scrub
+        worker no longer talks to the device behind the feeder's back —
+        entering the device transport as class "bg" (demoted behind
+        foreground under governor pressure)."""
+        blocks = list(blocks)
+        return self._submit(_Item(
+            "scrub", (blocks, list(hashes)), len(blocks),
+            sum(len(b) for b in blocks), cls=cls,
+            want_parity=want_parity))
 
     # sync conveniences with a closed-feeder fallback: shutdown races
     # degrade to the inline (pre-feeder) codec call, never to an error
@@ -231,11 +266,25 @@ class CodecFeeder:
             return self.codec.rs_encode_blocks(list(blocks))
 
     def decode_or_direct(self, shards: np.ndarray, present: Sequence[int],
-                         rows: Optional[Sequence[int]] = None) -> np.ndarray:
+                         rows: Optional[Sequence[int]] = None,
+                         cls: str = "fg") -> np.ndarray:
         try:
-            return self.submit_decode(shards, present, rows).result()
+            return self.submit_decode(shards, present, rows,
+                                      cls=cls).result()
         except FeederClosed:
             return self.codec.rs_reconstruct(shards, present, rows)
+
+    async def scrub_async(self, blocks: Sequence[bytes], hashes: Sequence,
+                          want_parity: bool = True):
+        import asyncio
+
+        try:
+            fut = self.submit_scrub(blocks, hashes, want_parity)
+        except FeederClosed:
+            return await asyncio.to_thread(
+                self.codec.scrub_encode_batch, list(blocks), list(hashes),
+                want_parity)
+        return await asyncio.wrap_future(fut)
 
     async def hash_async(self, blocks: Sequence[bytes],
                          peers: Optional[int] = None):
@@ -302,13 +351,20 @@ class CodecFeeder:
                     if self._pending_blocks >= self.max_batch_blocks:
                         reason = "full"
                         break
-                    hints = [it.peers for it in self._pending]
-                    if None not in hints:
+                    # the peers short-circuit considers FOREGROUND
+                    # submissions only: a co-pending background scrub
+                    # (peers=None by design — it coalesces over the full
+                    # SLO) must not force the foreground window to wait
+                    # the deadline out when all its expected peers have
+                    # already arrived
+                    fg = [it for it in self._pending if it.cls == "fg"]
+                    hints = [it.peers for it in fg]
+                    if hints and None not in hints:
                         want = max(hints)
                         if want <= 1:
                             reason = "lone"
                             break
-                        if len(self._pending) >= want:
+                        if len(fg) >= want:
                             reason = "peers"
                             break
                     left = deadline - time.perf_counter()
@@ -358,6 +414,20 @@ class CodecFeeder:
             if self.m_wait is not None:
                 self.m_wait.observe(now - it.ts, kind=it.kind)
         side = getattr(self.codec, "ragged_side", lambda: "cpu")()
+        all_items = [it for its in by_kind.values() for it in its]
+        if (side == "cpu" and all_items
+                and all(it.cls == "bg" for it in all_items)):
+            # a PURELY background batch against a closed/unprobed gate
+            # pays the (TTL-cached) link probe — the old stealing feeder
+            # probed every scrub pass; with scrub riding this queue the
+            # probe rides along, and a healthy link re-opens the device
+            # route for THIS batch.  A batch carrying any foreground
+            # item never pays it: the probe can cost a full link
+            # round-trip and this is the lone dispatcher thread.
+            refresh = getattr(self.codec, "refresh_gate", None)
+            if refresh is not None:
+                refresh()
+                side = self.codec.ragged_side()
         if side != self._last_side:
             # route changes are gate decisions: they land in the same
             # event ring as the scrub feeder's probe/gate events
@@ -374,6 +444,34 @@ class CodecFeeder:
                 self.m_size.observe(float(nblocks), kind=kind)
             if self.m_dispatch is not None:
                 self.m_dispatch.inc(kind=kind, reason=reason)
+            # Device side: hand the whole ragged batch to the zero-copy
+            # transport (ops/transport.py) — the feeder is the single
+            # producer of its deadline-aware queue, and the transport
+            # resolves the items' futures (and counts their bytes) at
+            # collect.  A closed/absent transport, or one the device
+            # codec cannot serve for this kind, dispatches inline below.
+            if side == "tpu":
+                tr = getattr(self.codec, "transport", None)
+                if tr is not None and tr.alive and tr.supports(kind):
+                    try:
+                        tr.submit_items(kind, items)
+                        continue
+                    except Exception:  # noqa: BLE001 — degrade inline
+                        logger.warning(
+                            "transport submit failed; dispatching "
+                            "ragged %s batch inline", kind, exc_info=True)
+            if kind == "scrub":
+                # inline scrub compute runs off the dispatcher thread
+                with self._scrub_cond:
+                    if self._scrub_q is None:
+                        self._scrub_q = collections.deque()
+                        self._scrub_thread = threading.Thread(
+                            target=self._scrub_worker,
+                            name="codec-feeder-scrub", daemon=True)
+                        self._scrub_thread.start()
+                    self._scrub_q.append((items, side))
+                    self._scrub_cond.notify_all()
+                continue
             try:
                 with self.obs.stage("feeder_dispatch", side):
                     if kind == "hash":
@@ -404,6 +502,43 @@ class CodecFeeder:
                     if not it.future.done():
                         it.future.set_exception(err)
 
+    def _scrub_worker(self) -> None:
+        while True:
+            with self._scrub_cond:
+                while not self._scrub_q:
+                    self._scrub_cond.wait()
+                job = self._scrub_q.popleft()
+            if job is None:
+                return
+            self._dispatch_scrub_inline(*job)
+
+    def _dispatch_scrub_inline(self, batch: List[_Item],
+                               side: str) -> None:
+        """Run one inline (non-transport) scrub batch and resolve its
+        futures — on the dedicated scrub thread, so the dispatcher stays
+        free for foreground batches."""
+        try:
+            with self.obs.stage("feeder_dispatch", side):
+                results = self.codec.scrub_ragged(
+                    [(it.payload[0], it.payload[1], it.want_parity)
+                     for it in batch])
+            self.obs.add_bytes(side, sum(it.nbytes for it in batch))
+        except BaseException as e:  # noqa: BLE001 — fan the error out
+            for it in batch:
+                if not it.future.done():
+                    it.future.set_exception(e)
+            return
+        for it, res in zip(batch, results):
+            if not it.future.done():
+                it.future.set_result(res)
+        if len(results) < len(batch):
+            err = RuntimeError(
+                f"ragged scrub returned {len(results)} results "
+                f"for {len(batch)} submissions")
+            for it in batch[len(results):]:
+                if not it.future.done():
+                    it.future.set_exception(err)
+
     # --- lifecycle / introspection -----------------------------------------
 
     def shutdown(self, timeout: float = 15.0) -> None:
@@ -423,6 +558,20 @@ class CodecFeeder:
             if t.is_alive():
                 logger.warning(
                     "codec feeder drain did not finish within %.1fs", timeout)
+        st = self._scrub_thread
+        if st is not None:
+            # the dispatcher has drained: any inline scrub jobs are
+            # already queued — drain them BOUNDED (a wedged codec call
+            # must not hang node shutdown; unresolved futures then fall
+            # to the callers' *_or_direct/async fallbacks)
+            with self._scrub_cond:
+                self._scrub_q.append(None)  # sentinel: exit after drain
+                self._scrub_cond.notify_all()
+            st.join(timeout)
+            if st.is_alive():
+                logger.warning(
+                    "codec feeder scrub drain did not finish within "
+                    "%.1fs", timeout)
 
     def stats(self) -> dict:
         with self._cond:

@@ -147,9 +147,18 @@ class HybridCodec(BlockCodec):
         self._link_ts = 0.0
         self._link_failed = False
         self._link_ttl = self._LINK_PROBE_TTL_S
+        self._fail_ttl = self._LINK_PROBE_FAIL_TTL_S
         self._probe_buf: Optional[np.ndarray] = None
         self._probe_warmed = False
         self._probe_lock = threading.Lock()
+        # the zero-copy device transport (ops/transport.py): armed when
+        # the device codec speaks the array-level transport API; the
+        # CodecFeeder routes device-side ragged batches through it, and
+        # the gate probe measures IT instead of the retired
+        # serialize+copy path
+        self.transport = None
+        self._metrics = metrics
+        self._governor_ratio = None
         # accounting (read by bench.py and the admin worker registry)
         self.bytes_cpu = 0
         self.bytes_tpu = 0
@@ -172,6 +181,34 @@ class HybridCodec(BlockCodec):
                 ).start()
             else:
                 self._build_device()
+        elif self.tpu is not None:
+            self._arm_transport()
+
+    def _arm_transport(self) -> None:
+        """Build the DeviceTransport over the attached device codec when
+        enabled and the device speaks the array-level transport API
+        (scripted test fakes without it keep the legacy ragged
+        routing)."""
+        if not getattr(self.params, "transport", True) or self.tpu is None:
+            return
+        from .transport import DeviceTransport
+
+        if not DeviceTransport.supports_device(self.tpu):
+            return
+        tr = DeviceTransport(self.tpu, self.params, fallback=self.cpu,
+                             observer=self.obs, metrics=self._metrics)
+        tr.governor_ratio = self._governor_ratio
+        self.transport = tr  # atomic attach (feeder reads it racily)
+        self.obs.event("transport_up", reason=type(self.tpu).__name__,
+                       slots=tr.slots)
+
+    def set_governor(self, ratio_fn) -> None:
+        """Wire the load governor's background_throttle_ratio into the
+        transport's background demotion (model/garage.py); survives a
+        late async device attach."""
+        self._governor_ratio = ratio_fn
+        if self.transport is not None:
+            self.transport.governor_ratio = ratio_fn
 
     def _build_device(self) -> None:
         try:
@@ -181,6 +218,7 @@ class HybridCodec(BlockCodec):
             # demotions land in the same event ring as gate decisions
             self.tpu = TpuCodec(self.params, observer=self.obs)  # atomic attach
             self.obs.event("device_attach", reason="ok")
+            self._arm_transport()
         except Exception as e:
             logger.warning(
                 "device codec unavailable; hybrid runs CPU-only",
@@ -202,7 +240,14 @@ class HybridCodec(BlockCodec):
                 "device_batch_blocks": self.device_batch_blocks,
                 "window": self.window,
             })
+        if self.transport is not None:
+            d["transport"] = self.transport.stats()
         return d
+
+    def close(self) -> None:
+        """Drain the device transport (shutdown path; idempotent)."""
+        if self.transport is not None:
+            self.transport.shutdown()
 
     def pop_stats(self) -> Tuple[int, int]:
         with self._stats_lock:
@@ -292,23 +337,40 @@ class HybridCodec(BlockCodec):
     def _probe_link(self) -> float:
         """Measured host→device round-trip rate (GiB/s), cached.
 
-        Cache policy (advisor r4): a FAILED probe is retried once
-        immediately and, if still failing, cached only for
-        _LINK_PROBE_FAIL_TTL_S — one transient exception must not
-        disable the device side for a full healthy-TTL.  Consecutive
-        below-threshold measurements back the TTL off (doubling up to
-        _LINK_PROBE_TTL_MAX_S) so a durably-dead link isn't re-probed
-        every pass.  Device codecs may supply `probe_link(nbytes) ->
-        GiB/s` (the synthetic-link test backend does); real codecs are
-        marked by warm_scrub; anything else (scripted test fakes) is
-        treated as healthy."""
+        With a transport armed, the probe measures the NEW path — one
+        ragged submission through stage→submit→collect
+        (DeviceTransport.probe_link) — not the retired serialize+copy
+        round-trip, so the gate decides on the rate the feeder's
+        batches will actually see.  A device codec's own `probe_link`
+        hook still wins (the synthetic-link backend keeps gate
+        decisions deterministic); real codecs are marked by warm_scrub;
+        anything else (scripted test fakes) is treated as healthy.
+
+        Cache policy: a FAILED probe is retried once immediately and,
+        if still failing, re-probed on a doubling ladder
+        (_LINK_PROBE_FAIL_TTL_S → _LINK_PROBE_TTL_MAX_S) — a
+        durably-dead backend isn't hammered every pass.  A probe that
+        SUCCEEDS — even below the gate threshold — caches for exactly
+        _LINK_PROBE_TTL_S and resets the failure ladder, so a
+        once-failed or once-slow link that recovers is re-probed (and
+        the gate re-opened) within one healthy TTL.  The old policy
+        doubled the TTL on below-threshold measurements too, which left
+        a recovered link gated for up to _LINK_PROBE_TTL_MAX_S.  The
+        flat healthy cadence costs nothing when probing is cheap (a
+        transport probe or a device hook); only the LEGACY
+        _probe_once path — a full 16 MiB round-trip over a possibly
+        metered link — keeps the below-threshold backoff ladder."""
         hook = getattr(self.tpu, "probe_link", None)
-        if hook is None and not hasattr(self.tpu, "warm_scrub"):
+        tr = self.transport
+        if hook is None and tr is not None and tr.alive:
+            hook = tr.probe_link
+        legacy = hook is None
+        if legacy and not hasattr(self.tpu, "warm_scrub"):
             return float("inf")
         with self._probe_lock:
             now = time.monotonic()
             if self._link_rate is not None:
-                ttl = (self._LINK_PROBE_FAIL_TTL_S if self._link_failed
+                ttl = (self._fail_ttl if self._link_failed
                        else self._link_ttl)
                 if now - self._link_ts < ttl:
                     return self._link_rate
@@ -322,10 +384,17 @@ class HybridCodec(BlockCodec):
                 rate, failed = self._probe_once()
                 if failed:
                     rate, failed = self._probe_once()
-            if not failed and rate < self.params.hybrid_min_link_gibs:
+            if failed:
+                self._fail_ttl = min(self._fail_ttl * 2,
+                                     self._LINK_PROBE_TTL_MAX_S)
+            elif legacy and rate < self.params.hybrid_min_link_gibs:
+                # the probe itself spends metered link quota here:
+                # back a below-threshold verdict off as before
+                self._fail_ttl = self._LINK_PROBE_FAIL_TTL_S
                 self._link_ttl = min(self._link_ttl * 2,
                                      self._LINK_PROBE_TTL_MAX_S)
-            elif not failed:
+            else:
+                self._fail_ttl = self._LINK_PROBE_FAIL_TTL_S
                 self._link_ttl = self._LINK_PROBE_TTL_S
             self._link_failed = failed
             self._link_rate, self._link_ts = rate, now
@@ -776,6 +845,11 @@ class HybridCodec(BlockCodec):
         it is re-derived here rather than read from _link_rate."""
         if self.tpu is None:
             return "cpu"
+        if self.transport is not None and not self.transport.alive:
+            # the transport latched down (repeated device failures or
+            # drain): the device path is gone for ragged batches even
+            # if the cached link verdict was healthy
+            return "cpu"
         if (getattr(self.tpu, "probe_link", None) is None
                 and not hasattr(self.tpu, "warm_scrub")):
             return "tpu"
@@ -793,6 +867,32 @@ class HybridCodec(BlockCodec):
 
     def _ragged_target(self) -> BlockCodec:
         return self.tpu if self.ragged_side() == "tpu" else self.cpu
+
+    def refresh_gate(self) -> None:
+        """Run the (TTL-cached) link probe so the cached gate verdict
+        exists/stays fresh.  Called by the feeder before dispatching a
+        BACKGROUND batch to a still-closed gate: scrub is where the
+        gate historically got its measurements (the stealing feeder
+        probed every pass), and with scrub riding the feeder queue the
+        probe must ride with it — background work can afford it,
+        foreground never pays it cold."""
+        if self.tpu is not None:
+            try:
+                self._probe_link()
+            except Exception:  # noqa: BLE001 — a dead probe = gate stays shut
+                logger.warning("gate refresh probe failed", exc_info=True)
+
+    def scrub_ragged(self, items):
+        """Feeder `scrub` kind when no transport took the batch: the CPU
+        floor runs the fused serial path; a device route without the
+        array API (scripted fakes) degrades to one hybrid-engine pass
+        per item."""
+        if self.ragged_side() == "tpu":
+            t = self.tpu
+            if hasattr(t, "scrub_ragged"):
+                return t.scrub_ragged(items)
+            return [self.scrub_encode_batch(b, h, fp) for b, h, fp in items]
+        return self.cpu.scrub_ragged(items)
 
     def hash_ragged(self, groups):
         return self._ragged_target().hash_ragged(groups)

@@ -46,6 +46,9 @@ STAGES = (
     "tail_wait",       # grace wait on the device before hedging the tail
     "feeder_dispatch", # one ragged foreground batch (CodecFeeder) through
                        # hash_ragged / rs_encode_ragged / rs_reconstruct_ragged
+    "transport_wait",  # queue wait in the DeviceTransport's EDF heap
+                       # (ops/transport.py; its staging/submit/collect
+                       # reuse host_staging / device_submit / sync_collect)
 )
 
 EVENT_RING_SIZE = 256

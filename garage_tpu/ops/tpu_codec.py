@@ -265,6 +265,150 @@ class TpuCodec(BlockCodec):
         on the device (routing belongs to HybridCodec)."""
         return "tpu"
 
+    # --- the transport device API (ops/transport.py) ---
+    #
+    # DeviceTransport stages ragged batches ONCE into reusable host
+    # buffers and hands them over through these array-level entry
+    # points — no bytes-list repacking, no second pad pass.  The
+    # staging buffer is adopted zero-copy via dlpack where host and
+    # device share memory (CPU backend, unified hosts); elsewhere
+    # device_put is the H2D DMA, which is not a host copy.  The
+    # transport's slot discipline guarantees a staging buffer is never
+    # rewritten while a dispatch that adopted it is still in flight.
+
+    def staging_geometry(self, nlanes: int, maxlen: int,
+                         kind: str) -> Tuple[int, int]:
+        """(lanes, row_bytes) the transport must stage for a batch of
+        `nlanes` blocks of up to `maxlen` bytes: the compiled-executable
+        shape (power-of-two bucketing for XLA retrace avoidance, lane
+        alignment to whole codewords — and codewords-per-device when
+        sharded — for the fused scrub kernel's parity output)."""
+        cols = self._bucket(max(maxlen, 1))
+        if kind in ("scrub", "encode"):
+            lanes = self._batch_size(max(nlanes, 1))
+            lanes += (-lanes) % self._lane_align()
+        else:
+            lanes = self._batch_size(max(nlanes, 1))
+        return lanes, cols
+
+    def _to_device(self, arr: np.ndarray) -> jax.Array:
+        """Adopt a staged host buffer: dlpack zero-copy when the backend
+        can alias host memory, device_put (pure DMA) otherwise."""
+        try:
+            return jnp.from_dlpack(arr)
+        except Exception:  # noqa: BLE001 — any dlpack refusal → plain put
+            return jnp.asarray(arr)
+
+    def probe_submit(self, arr: np.ndarray):
+        """The transport's link probe op: upload a staged buffer and
+        return a device scalar that DEPENDS on it (the only sync some
+        remote backends honor — see HybridCodec._probe_once).  Compute
+        is a trivial reduction, so the measured round-trip is
+        transfer-bound like the retired probe, but through the NEW
+        staging/adoption path."""
+        if not hasattr(self, "_probe_sum_jit"):
+            self._probe_sum_jit = jax.jit(
+                lambda x: jnp.sum(x, dtype=jnp.uint32))
+        return self._probe_sum_jit(self._to_device(arr))
+
+    def probe_collect(self, handle) -> int:
+        return int(np.asarray(handle))
+
+    def hash_submit(self, arr: np.ndarray, lengths: np.ndarray):
+        """Enqueue a staged hash batch WITHOUT synchronizing; returns
+        the device digest array handle for hash_collect."""
+        with self.obs.stage("h2d_transfer", "tpu"):
+            da = self._to_device(arr)
+            dl = jnp.asarray(lengths)
+        with self.obs.stage("kernel_dispatch", "tpu"):
+            return self._hash_jit(da, dl)
+
+    def hash_collect(self, handle, n: int) -> List[Hash]:
+        h = np.asarray(handle)[:n]
+        return [Hash(d) for d in digests_to_bytes(h)]
+
+    def scrub_collect(self, out, fetch_parity: bool):
+        """Materialize one scrub_encode_submit result: (ok full-lane
+        bool array, parity full array | None) — per-entry trimming is
+        the transport's job (it knows the lane spans)."""
+        _h, ok, _bad, parity = out
+        ok = np.asarray(ok)
+        parity_np = np.asarray(parity) if fetch_parity else None
+        return ok, parity_np
+
+    def _gf_submit(self, u32, K, mat: np.ndarray):
+        """Dispatch one GF apply WITHOUT synchronizing, preferring the
+        Pallas kernel with the same demotion policy as _gf_apply_np
+        (a backend without Mosaic support must fall back to the XLA
+        kernel, not fail the transport's batch).  Pallas failures that
+        would only surface at sync time are the transport's
+        note_sync_failure path."""
+        pg = self._pallas_for(mat)
+        if pg is not None:
+            try:
+                return pg(u32)
+            except Exception as e:
+                import logging
+
+                if _pallas_error_is_permanent(e):
+                    logging.getLogger("garage_tpu.ops").warning(
+                        "pallas GF kernel unsupported on this backend "
+                        "(permanent); using the XLA kernel", exc_info=True)
+                    self._pallas_ok = False
+                    self.obs.event("gf_demote", reason="permanent",
+                                   error=f"{type(e).__name__}: {e}"[:200])
+                else:
+                    self._pallas_transient_fails += 1
+                    if (self._pallas_transient_fails
+                            >= PALLAS_MAX_TRANSIENT_FAILS):
+                        self._pallas_ok = False
+                        self.obs.event("gf_demote",
+                                       reason="transient_limit",
+                                       fails=self._pallas_transient_fails)
+        return self._gf_jit(u32, K)
+
+    def encode_submit(self, groups: np.ndarray):
+        """Enqueue RS parity for staged (B, k, S) codeword groups
+        without synchronizing (S must be a multiple of 4 — guaranteed
+        by staging_geometry's bucketing).  Returns a lazily-viewed
+        device array; np.asarray (encode_collect) is the sync."""
+        assert groups.shape[-1] % 4 == 0, groups.shape
+        with self.obs.stage("h2d_transfer", "tpu"):
+            u32 = bytes_view_u32(self._to_device(
+                groups.reshape(-1, groups.shape[-2], groups.shape[-1])))
+        with self.obs.stage("kernel_dispatch", "tpu"):
+            return u32_view_bytes(self._gf_submit(u32, self._K_enc,
+                                                  self._enc_mat))
+
+    def encode_collect(self, handle) -> np.ndarray:
+        return np.asarray(handle)
+
+    def decode_submit(self, shards: np.ndarray, present: Sequence[int],
+                      rows: Optional[Sequence[int]] = None):
+        """Enqueue one survivor-pattern decode over staged (B, p, S)
+        shards without synchronizing; shares rs_reconstruct's
+        mask-constant schedule cache."""
+        k, m = self.params.rs_data, self.params.rs_parity
+        key = (tuple(present[:k]), tuple(rows) if rows is not None else None)
+        cached = self._decode_w_cache.get(key)
+        if cached is None:
+            dec = gf256.rs_decode_matrix(k, m, present)
+            if rows is not None:
+                dec = np.ascontiguousarray(dec[list(rows)])
+            cached = (jnp.asarray(gf_mask_consts(dec)), dec)
+            self._decode_w_cache[key] = cached
+        K, dec_mat = cached
+        sub = shards[..., :k, :]
+        s = sub.shape[-1]
+        pad = (-s) % 4
+        if pad:
+            sub = np.pad(sub, [(0, 0)] * (sub.ndim - 1) + [(0, pad)])
+        with self.obs.stage("h2d_transfer", "tpu"):
+            u32 = bytes_view_u32(self._to_device(
+                np.ascontiguousarray(sub)))
+        with self.obs.stage("kernel_dispatch", "tpu"):
+            return u32_view_bytes(self._gf_submit(u32, K, dec_mat))[..., :s]
+
     # --- hashing ---
     @staticmethod
     def _bucket(n: int, quantum: int = 64) -> int:

@@ -37,6 +37,29 @@ from ..ops.cpu_codec import CpuCodec
 from ..utils.data import Hash
 
 
+def _wait_until(ready: float) -> None:
+    dt = ready - time.monotonic()
+    if dt > 0:
+        time.sleep(dt)
+
+
+class _Lazy:
+    """Async-device result handle: np.asarray() blocks until the modeled
+    link has delivered the submission (TpuCodec's device arrays behave
+    the same way — sync happens at materialization)."""
+
+    __slots__ = ("value", "ready")
+
+    def __init__(self, value, ready: float):
+        self.value = value
+        self.ready = ready
+
+    def __array__(self, dtype=None, copy=None):
+        _wait_until(self.ready)
+        out = np.asarray(self.value)
+        return out.astype(dtype) if dtype is not None else out
+
+
 class SyntheticLinkCodec:
     """TpuCodec stand-in with a modeled host→device link."""
 
@@ -53,6 +76,31 @@ class SyntheticLinkCodec:
             CpuCodec(params) if compute_real else None)
         self.submissions = 0
         self.bytes_submitted = 0
+        # transport A/B attribution: the bytes-level path (scrub_submit)
+        # models the retired serialize+copy link — each block pays a
+        # pack copy plus a transfer-serialize copy, exactly what the
+        # real bytes-level TpuCodec path did; array-level submissions
+        # arrive pre-staged (the transport's single copy is counted on
+        # the transport's own meter, not here)
+        self.host_copies = 0
+        self.blocks_submitted = 0
+        self.array_submissions = 0
+
+    def _codec(self) -> CpuCodec:
+        """identity-mode math on demand: the array-level transport API
+        always computes real results (the transport's bit-identity is
+        the thing under test), even when the bytes-level path runs in
+        timing mode."""
+        if self.cpu is None:
+            self.cpu = CpuCodec(self.params)
+        return self.cpu
+
+    def _link_sleep(self, nbytes: int) -> None:
+        # the link is ONE serial resource: concurrent callers reserve
+        # windows on it and wait their own out, so two threads pushing
+        # bytes cost the sum of their transfers, not the max — without
+        # this, any caller-side threading would fake link bandwidth
+        _wait_until(self._link_ready_at(nbytes))
 
     # --- hooks the hybrid engine looks for ---
 
@@ -75,10 +123,9 @@ class SyntheticLinkCodec:
         nbytes = sum(len(b) for b in blocks)
         self.submissions += 1
         self.bytes_submitted += nbytes
-        dt = self.fixed_latency_s + nbytes / (self.link_gibs * 2**30)
-        if self.device_gibs != float("inf"):
-            dt += nbytes / (self.device_gibs * 2**30)
-        time.sleep(dt)
+        self.blocks_submitted += len(blocks)
+        self.host_copies += 2 * len(blocks)  # pack + transfer-serialize
+        self._link_sleep(nbytes)
         if self.compute_real:
             ok = self.cpu.batch_verify(blocks, hashes)
             parity = self.cpu.rs_encode_blocks(blocks)
@@ -86,3 +133,131 @@ class SyntheticLinkCodec:
         # timing mode: the caller's hashes are trusted correct-by-
         # construction; parity is None (fetch_parity=False flows only)
         return np.ones((len(blocks),), dtype=bool), None, len(blocks)
+
+    # --- bytes-level ragged API (the LEGACY serialize+copy path) ---
+    #
+    # What HybridCodec routed feeder batches through before the
+    # DeviceTransport: every block repacked (pack copy) and pushed over
+    # the modeled link (transfer-serialize copy).  Kept as the "old"
+    # side of the transport A/B (bench --transport-phase).
+
+    def _bytes_level(self, nblocks: int, nbytes: int) -> None:
+        self.submissions += 1
+        self.bytes_submitted += nbytes
+        self.blocks_submitted += nblocks
+        self.host_copies += 2 * nblocks   # pack + transfer-serialize
+        self._link_sleep(nbytes)
+
+    def hash_ragged(self, groups):
+        flat = [b for g in groups for b in g]
+        self._bytes_level(len(flat), sum(len(b) for b in flat))
+        return self._codec().hash_ragged(groups)
+
+    def rs_encode_ragged(self, groups):
+        flat = [b for g in groups for b in g]
+        self._bytes_level(len(flat), sum(len(b) for b in flat))
+        return self._codec().rs_encode_ragged(groups)
+
+    def rs_reconstruct_ragged(self, items):
+        rows = sum(int(sh.shape[0]) for sh, _p, _r in items)
+        self._bytes_level(rows, sum(int(sh.nbytes)
+                                    for sh, _p, _r in items))
+        return self._codec().rs_reconstruct_ragged(items)
+
+    def scrub_ragged(self, items):
+        out = []
+        for blocks, hashes, fetch_parity in items:
+            ok, parity, _n = self.scrub_submit(blocks, hashes)
+            out.append((ok, parity if fetch_parity else None))
+        return out
+
+    # --- the transport device API (ops/transport.py) ---
+    #
+    # Array-level entry points consuming the transport's staged buffers
+    # directly.  Unlike the bytes-level path, these model an ASYNC
+    # device: submit computes the result (real CpuCodec math, so the
+    # transport's merge/split machinery is bit-identity-testable) and
+    # returns a LAZY handle whose materialization blocks until the
+    # modeled link — a serial resource, like a real DMA engine — has
+    # "delivered" the bytes.  That is what lets the transport's double
+    # buffering show its overlap: batch N+1 stages and submits while
+    # batch N's transfer window elapses.
+
+    def _link_ready_at(self, nbytes: int) -> float:
+        dt = self.fixed_latency_s + nbytes / (self.link_gibs * 2**30)
+        if self.device_gibs != float("inf"):
+            dt += nbytes / (self.device_gibs * 2**30)
+        now = time.monotonic()
+        start = max(now, getattr(self, "_link_busy_until", 0.0))
+        self._link_busy_until = start + dt
+        return self._link_busy_until
+
+    def staging_geometry(self, nlanes: int, maxlen: int, kind: str):
+        k = max(1, self.params.rs_data)
+        if kind in ("scrub", "encode"):
+            nlanes += (-nlanes) % k
+        return max(nlanes, 1), max(maxlen, 1)
+
+    def _rows_bytes(self, arr: np.ndarray, lengths: np.ndarray):
+        return [arr[i, :n].tobytes() for i, n in enumerate(lengths)]
+
+    def probe_submit(self, arr: np.ndarray):
+        time.sleep(min(arr.nbytes / (self.link_gibs * 2**30), 0.05))
+        return int(arr.sum(dtype=np.uint32))
+
+    def probe_collect(self, handle) -> int:
+        return int(handle)
+
+    def hash_submit(self, arr: np.ndarray, lengths: np.ndarray):
+        self.array_submissions += 1
+        self.bytes_submitted += int(lengths.sum())
+        ready = self._link_ready_at(int(lengths.sum()))
+        return ready, self._codec().batch_hash(
+            self._rows_bytes(arr, lengths))
+
+    def hash_collect(self, handle, n: int):
+        ready, digs = handle
+        _wait_until(ready)
+        return digs[:n]
+
+    def scrub_encode_submit(self, arr: np.ndarray, lengths: np.ndarray,
+                            expected: np.ndarray):
+        self.array_submissions += 1
+        self.bytes_submitted += int(lengths.sum())
+        ready = self._link_ready_at(int(lengths.sum()))
+        codec = self._codec()
+        digs = codec.batch_hash(self._rows_bytes(arr, lengths))
+        ok = np.array(
+            [bytes(d) == np.asarray(e, dtype="<u4").tobytes()
+             for d, e in zip(digs, np.asarray(expected))], dtype=bool)
+        k = self.params.rs_data
+        parity = None
+        if k > 0:
+            groups = np.ascontiguousarray(arr).reshape(
+                arr.shape[0] // k, k, arr.shape[1])
+            parity = codec.rs_encode(groups)
+        return None, _Lazy(ok, ready), int((~ok).sum()), \
+            (_Lazy(parity, ready) if parity is not None else None)
+
+    def scrub_collect(self, out, fetch_parity: bool):
+        _h, ok, _bad, parity = out
+        return np.asarray(ok), (np.asarray(parity) if fetch_parity
+                                and parity is not None else None)
+
+    def encode_submit(self, groups: np.ndarray):
+        self.array_submissions += 1
+        self.bytes_submitted += int(groups.nbytes)
+        ready = self._link_ready_at(int(groups.nbytes))
+        return _Lazy(self._codec().rs_encode(
+            np.ascontiguousarray(groups)), ready)
+
+    def encode_collect(self, handle) -> np.ndarray:
+        return np.asarray(handle)
+
+    def decode_submit(self, shards: np.ndarray, present,
+                      rows=None):
+        self.array_submissions += 1
+        self.bytes_submitted += int(shards.nbytes)
+        ready = self._link_ready_at(int(shards.nbytes))
+        return _Lazy(self._codec().rs_reconstruct(shards, present, rows),
+                     ready)

@@ -132,6 +132,15 @@ class CodecConfig:
     feeder: bool = True
     feeder_slo_ms: float = 2.0
     feeder_max_batch_blocks: int = 256
+    # --- zero-copy device transport (ops/transport.py): one
+    # deadline-aware submission queue from the feeder to the device —
+    # staged once into reusable buffers (≤1 host copy per block),
+    # double-buffered within max_device_staging_mib, foreground ahead
+    # of governor-demoted background.  transport=false restores the
+    # legacy per-call serialize+copy routing.
+    transport: bool = _CODEC_DEFAULTS.transport
+    transport_staging_slots: int = _CODEC_DEFAULTS.transport_staging_slots
+    transport_bg_slack_ms: float = _CODEC_DEFAULTS.transport_bg_slack_ms
     # --- repair-bandwidth-optimal degraded reads (block/repair_plan.py):
     # exact-k survivor selection ranked by RTT EWMA / breaker state /
     # zone locality, hedged ranked replacements, and partial-parallel
@@ -172,6 +181,9 @@ class CodecConfig:
             hybrid_window=self.hybrid_window,
             device_batch_blocks=self.device_batch_blocks,
             max_device_staging_mib=self.max_device_staging_mib,
+            transport=self.transport,
+            transport_staging_slots=self.transport_staging_slots,
+            transport_bg_slack_ms=self.transport_bg_slack_ms,
         )
 
 
@@ -418,6 +430,10 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         raise ConfigError("codec.feeder_max_batch_blocks must be >= 1")
     if cfg.codec.repair_hedge_ms < 0:
         raise ConfigError("codec.repair_hedge_ms must be >= 0")
+    if cfg.codec.transport_staging_slots < 1:
+        raise ConfigError("codec.transport_staging_slots must be >= 1")
+    if cfg.codec.transport_bg_slack_ms < 0:
+        raise ConfigError("codec.transport_bg_slack_ms must be >= 0")
 
     # secrets: env overrides > `<key>_file` in TOML > inline value
     for key, env in _SECRET_ENV.items():

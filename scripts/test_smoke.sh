@@ -10,6 +10,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/smoke.py "$@"
+# zero-copy device transport smoke (ISSUE 11): the hybrid gate must
+# OPEN through the transport on the synthetic in-process backend
+# (sustained_tpu_frac > 0), staging must pay ≤ 1 host copy per block,
+# scrub and foreground verifies must share one feeder queue, and the
+# live transport_* metric families must pass the strict lint
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/transport_smoke.py
 # degraded-mode smoke: one hard partition between the two replicas of an
 # in-process 3-node cluster must stay client-invisible (quorum 2/3), and
 # one flaky-disk + ENOSPC node must go read-only (typed StorageFull) and

@@ -45,14 +45,21 @@ def test_stage_histograms_and_bytes_by_side_scrapeable():
     """An open-gate hybrid pass must leave per-stage histograms and
     bytes-by-side counters in the registry from which tpu_frac > 0 is
     computable — the acceptance bar of the observability tentpole."""
-    reg = MetricsRegistry()
     params = _params()
-    dev = SyntheticLinkCodec(params, link_gibs=100.0, compute_real=True)
-    hy = HybridCodec(params, device_codec=dev, metrics=reg)
     blocks, hashes = _mk_batch()
-    out = hy.scrub_many([(blocks, hashes)], fetch_parity=False)
-    assert all(ok.all() for ok, _p in out)
-    _cpu_b, tpu_b = hy.pop_stats()
+    # work stealing is timing-dependent: on a loaded host the CPU side
+    # can occasionally drain the whole deque before the feeder's first
+    # claim — retry a fresh pass (bounded) rather than flake
+    for _attempt in range(3):
+        reg = MetricsRegistry()
+        dev = SyntheticLinkCodec(params, link_gibs=100.0,
+                                 compute_real=True)
+        hy = HybridCodec(params, device_codec=dev, metrics=reg)
+        out = hy.scrub_many([(blocks, hashes)], fetch_parity=False)
+        assert all(ok.all() for ok, _p in out)
+        _cpu_b, tpu_b = hy.pop_stats()
+        if tpu_b > 0:
+            break
     assert tpu_b > 0, "synthetic device took no work through an open gate"
 
     # scrapeable ratio: the counters, not pop_stats, carry the split
@@ -219,11 +226,17 @@ def test_hybrid_collect_reports_sync_failure_to_device():
         def note_sync_success(self, variant=None):
             noted.append(("ok", variant))
 
-    dev = _SyncFailDevice(params, link_gibs=100.0)
-    hy = HybridCodec(params, device_codec=dev)
     blocks, hashes = _mk_batch()
-    out = hy.scrub_many([(blocks, hashes)], fetch_parity=False)
-    assert all(ok.all() for ok, _p in out), "CPU did not absorb the failure"
+    # bounded retry: the CPU side can drain the deque before the feeder
+    # claims anything on a loaded host (no submission → nothing to fail)
+    for _attempt in range(3):
+        dev = _SyncFailDevice(params, link_gibs=100.0)
+        hy = HybridCodec(params, device_codec=dev)
+        out = hy.scrub_many([(blocks, hashes)], fetch_parity=False)
+        assert all(ok.all() for ok, _p in out), \
+            "CPU did not absorb the failure"
+        if ("RuntimeError", "pallas") in noted:
+            break
     assert ("RuntimeError", "pallas") in noted, noted
     kinds = {e["kind"] for e in hy.obs.events_list()}
     assert "sync_failure" in kinds
@@ -301,6 +314,11 @@ async def test_admin_codec_info_events_and_slow_ops(tmp_path):
         blocks, hashes = _mk_batch()
         await asyncio.to_thread(
             hy.scrub_many, [(blocks, hashes)], False)
+        for _attempt in range(2):
+            if hy.obs.bytes_total["tpu"] > 0:
+                break  # stealing is timing-dependent; retry a pass
+            await asyncio.to_thread(
+                hy.scrub_many, [(blocks, hashes)], False)
 
         admin = AdminRpcHandler(g, register_endpoint=False)
         info = await admin._cmd_codec_info({})
