@@ -116,26 +116,61 @@ def error_xml(err: Exception, resource: str = "", request_id: str = "") -> bytes
     return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
 
 
-def admit_request(gate, request):
+def body_claim(tun, request):
+    """→ (bytes to admit against, estimated?).  Declared Content-Length
+    when present; a chunked/streaming body with NO declared length is
+    admitted against the conservative ``streaming_body_estimate`` (and
+    reconciled to actual bytes as it streams — AdmissionToken
+    note_body_bytes/body_done) instead of bypassing the bytes watermark
+    entirely.  Body-less requests claim nothing."""
+    cl = request.headers.get("Content-Length")
+    if cl is not None:
+        try:
+            return max(int(cl), 0), False
+        except ValueError:
+            return 0, False
+    te = request.headers.get("Transfer-Encoding", "")
+    if "chunked" in te.lower():
+        return max(getattr(tun, "streaming_body_estimate", 0), 0), True
+    return 0, False
+
+
+_SHED_MESSAGES = {
+    "over_share": "tenant is past its fair share of the admission gate; "
+                  "retry with backoff",
+    "queue_full": "tenant admission queue is full; retry with backoff",
+    "queue_timeout": "no admission slot freed within the queueing bound; "
+                     "retry with backoff",
+    "remote_pressure": "a storage node this request must touch is "
+                       "saturated; shed at the gateway on its behalf",
+}
+
+
+async def admit_request(gate, request, tenant: Optional[str] = None,
+                        remote_pressure: float = 0.0,
+                        bucket: Optional[str] = None):
     """Admission-gate intake shared by the S3 and K2V servers →
     ``(token, None)`` when admitted (release the token when the request
     FULLY finishes, streaming included) or ``(None, response)`` when
-    shed — the ready-to-return 503 SlowDown with Retry-After and a
-    minted RequestId.  Gate None (overload protection unwired, e.g.
-    bare test servers) admits everything."""
+    shed — the ready-to-return 503 SlowDown with a load-derived
+    Retry-After and a minted RequestId.  Requests are classified into
+    per-tenant WDRR queues by access key (fallback: bucket); sheds are
+    per-tenant, never gate-wide.  Gate None (overload protection
+    unwired, e.g. bare test servers) admits everything."""
     if gate is None:
         return None, None
-    try:
-        nbytes = int(request.headers.get("Content-Length") or 0)
-    except ValueError:
-        nbytes = 0
-    token = gate.try_admit(max(nbytes, 0))
+    from .admission import classify_tenant
+
+    nbytes, estimated = body_claim(gate.tun, request)
+    token, verdict = await gate.admit(
+        nbytes, tenant=tenant or classify_tenant(request, bucket),
+        remote_pressure=remote_pressure, estimated=estimated)
     if token is not None:
         return token, None
+    msg = _SHED_MESSAGES.get(
+        verdict, "node is past its admission watermarks; retry with backoff")
     return None, error_response(
-        SlowDownError(
-            "node is past its admission watermarks; retry with backoff",
-            retry_after=gate.tun.retry_after),
+        SlowDownError(msg, retry_after=gate.retry_after_hint()),
         request.path)
 
 
@@ -146,6 +181,26 @@ def request_deadline_budget(config) -> Optional[float]:
     if rpc_tun is not None and rpc_tun.deadline_default > 0:
         return rpc_tun.deadline_default
     return None
+
+
+def client_deadline_budget(default_s: Optional[float],
+                           request) -> Optional[float]:
+    """Fold a client-supplied ``X-Request-Timeout`` (seconds) into the
+    request's deadline budget: it may TIGHTEN the default, never extend
+    it — and when deadlines are disabled a client may still arm its own.
+    Malformed / non-finite / non-positive values are ignored (header
+    values are client-controlled fuzz targets; a bad one must not
+    disable or poison the budget)."""
+    raw = request.headers.get("X-Request-Timeout")
+    if raw is None:
+        return default_s
+    try:
+        t = float(raw)
+    except (TypeError, ValueError):
+        return default_s
+    if not (t == t) or t == float("inf") or t <= 0:
+        return default_s
+    return t if default_s is None else min(default_s, t)
 
 
 def gen_request_id() -> str:

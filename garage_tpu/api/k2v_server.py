@@ -33,6 +33,7 @@ from .common import (
     NoSuchBucketError,
     NoSuchKeyError,
     admit_request,
+    client_deadline_budget,
     error_response,
     int_param,
     request_deadline_budget,
@@ -44,6 +45,21 @@ from .signature import check_signature, raw_query_pairs
 logger = logging.getLogger("garage_tpu.api.k2v")
 
 CAUSALITY_HEADER = "X-Garage-Causality-Token"
+
+
+def parse_poll_timeout(raw) -> float:
+    """Client long-poll window → seconds in (0, 600].  The value is
+    client-controlled: non-numeric raises a typed 400 (not a 500 out of
+    float()), and NaN/non-positive are rejected too — nan would poison
+    every downstream deadline comparison and the event loop's timer
+    heap (same invariant as the budget-extension parse above)."""
+    try:
+        t = float(raw)
+    except (TypeError, ValueError):
+        raise BadRequestError(f"invalid poll timeout: {raw!r}")
+    if not (t == t) or t <= 0:
+        raise BadRequestError(f"invalid poll timeout: {raw!r}")
+    return min(t, 600.0)
 
 
 class K2VApiServer:
@@ -75,36 +91,58 @@ class K2VApiServer:
 
     async def handle_request(self, request: web.Request) -> web.StreamResponse:
         # admission first, before signature/trace/body — shed typed
-        # (503 SlowDown + Retry-After + RequestId) instead of queueing
-        token, shed = admit_request(self.gate, request)
-        if shed is not None:
-            return shed
-        try:
-            trace, rid = request_trace(
-                self.garage.system.tracer, "K2V", "k2v", request)
-            # long polls legitimately outlive the default request budget:
-            # give them their requested window on top of it.  The value is
-            # client-controlled: only FINITE values in [0, 600] extend —
-            # nan would poison every downstream deadline comparison and
-            # the event loop's timer heap, and a negative value must not
-            # silently shrink the budget
-            budget = self.deadline_s
-            if budget is not None and "timeout" in request.query:
-                try:
-                    t = float(request.query["timeout"])
-                except ValueError:
-                    t = 0.0
-                if t == t and t > 0:
-                    budget += min(t, 600.0)
-            with trace, deadline_scope(budget):
-                resp = await self._handle_with_errors(request, rid)
-                trace.set_attr("status", resp.status)
-                if not resp.prepared:
-                    resp.headers["x-amz-request-id"] = rid
-                return resp
-        finally:
+        # (503 SlowDown + Retry-After + RequestId) instead of queueing.
+        # Tenant-classified (access key, fallback bucket) with the
+        # gossiped pressure of the bucket's placement nodes folded in,
+        # exactly like the S3 front door.
+        remote_p = 0.0
+        probe = getattr(self.garage, "admission_probe", None)
+        seg = request.rel_url.raw_path.lstrip("/").split("/", 1)[0]
+        import urllib.parse as _up
+
+        bname = _up.unquote(seg) if seg else None
+        if probe is not None:
+            remote_p, _hot = probe.pressure(bname)
+        # long polls legitimately outlive the default request budget:
+        # give them their requested window on top of it.  The value is
+        # client-controlled: only FINITE values in [0, 600] extend —
+        # nan would poison every downstream deadline comparison and
+        # the event loop's timer heap, and a negative value must not
+        # silently shrink the budget
+        budget = self.deadline_s
+        if budget is not None and "timeout" in request.query:
+            try:
+                t = float(request.query["timeout"])
+            except ValueError:
+                t = 0.0
+            if t == t and t > 0:
+                budget += min(t, 600.0)
+        # a client-supplied X-Request-Timeout tightens the final budget
+        # (never extends — even a long poll honors an explicit tighter
+        # client bound); armed BEFORE admission so WDRR queue time
+        # spends the budget instead of stacking on top of it
+        budget = client_deadline_budget(budget, request)
+        with deadline_scope(budget):
+            token, shed = await admit_request(
+                self.gate, request, remote_pressure=remote_p, bucket=bname)
+            if shed is not None:
+                return shed
             if token is not None:
-                token.release()
+                # the long-poll handlers park this token while waiting so
+                # pollers don't starve the in-flight watermark
+                request["admission_token"] = token
+            try:
+                trace, rid = request_trace(
+                    self.garage.system.tracer, "K2V", "k2v", request)
+                with trace:
+                    resp = await self._handle_with_errors(request, rid)
+                    trace.set_attr("status", resp.status)
+                    if not resp.prepared:
+                        resp.headers["x-amz-request-id"] = rid
+                    return resp
+            finally:
+                if token is not None:
+                    token.release()
 
     async def _handle_with_errors(self, request, rid: str) -> web.StreamResponse:
         try:
@@ -153,6 +191,9 @@ class K2VApiServer:
         sk = parts[2] if len(parts) > 2 else None
 
         bucket_id = await self.helper.resolve_bucket(bucket_name, api_key)
+        probe = getattr(self.garage, "admission_probe", None)
+        if probe is not None:
+            probe.note_bucket(bucket_name, bytes(bucket_id))
         m = request.method
         # Classify the endpoint BEFORE the permission check (ref
         # src/api/k2v/router.rs authorization_type): ReadBatch (POST
@@ -197,7 +238,8 @@ class K2VApiServer:
             raise BadRequestError("missing sort key")
         if m == "GET":
             if "causality_token" in q and "timeout" in q:
-                return await self.poll_item(bucket_id, pk, sk, q, headers)
+                return await self.poll_item(bucket_id, pk, sk, q, headers,
+                                            request)
             return await self.read_item(bucket_id, pk, sk, headers)
         if m == "PUT":
             return await self.insert_item(bucket_id, pk, sk, request, headers)
@@ -259,12 +301,23 @@ class K2VApiServer:
         await self.garage.k2v_rpc.insert(bucket_id, pk, sk, context, None)
         return web.Response(status=204)
 
-    async def poll_item(self, bucket_id, pk, sk, q, headers) -> web.Response:
+    async def poll_item(self, bucket_id, pk, sk, q, headers,
+                        request=None) -> web.Response:
         context = CausalContext.parse(q["causality_token"])
-        timeout = min(float(q.get("timeout", "300")), 600.0)
-        item = await self.garage.k2v_rpc.poll_item(
-            bucket_id, pk, sk, context, timeout
-        )
+        timeout = parse_poll_timeout(q.get("timeout", "300"))
+        # park the admission slot for the poll window: a long poll holds
+        # no node resources while waiting, and N pollers must not brown
+        # out PUT/GET admission for up to 600 s each
+        token = request.get("admission_token") if request is not None else None
+        if token is not None:
+            token.park()
+        try:
+            item = await self.garage.k2v_rpc.poll_item(
+                bucket_id, pk, sk, context, timeout
+            )
+        finally:
+            if token is not None:
+                token.unpark()
         if item is None:
             return web.Response(status=304)  # not modified within timeout
         return self._item_response(item, headers)
@@ -485,7 +538,7 @@ class K2VApiServer:
             body = json.loads(await request.read() or b"{}")
         except ValueError as e:
             raise BadRequestError(f"malformed PollRange body: {e}")
-        timeout = min(float(body.get("timeout", 300)), 600.0)
+        timeout = parse_poll_timeout(body.get("timeout", 300))
         prefix = body.get("prefix")
         start = body.get("start")
         end = body.get("end")
@@ -526,19 +579,29 @@ class K2VApiServer:
             if not fresh:
                 import time as _time
 
-                deadline = _time.monotonic() + timeout
-                while not fresh:
-                    remain = deadline - _time.monotonic()
-                    if remain <= 0:
-                        return web.Response(status=304)
-                    try:
-                        import asyncio as _asyncio
+                # park the admission slot for the wait (same rationale as
+                # poll_item: a parked poller must not starve the gate)
+                token = request.get("admission_token")
+                if token is not None:
+                    token.park()
+                try:
+                    deadline = _time.monotonic() + timeout
+                    while not fresh:
+                        remain = deadline - _time.monotonic()
+                        if remain <= 0:
+                            return web.Response(status=304)
+                        try:
+                            import asyncio as _asyncio
 
-                        cand = await _asyncio.wait_for(q.get(), timeout=remain)
-                    except Exception:
-                        return web.Response(status=304)
-                    if matches(cand) and is_new(cand):
-                        fresh = [cand]
+                            cand = await _asyncio.wait_for(
+                                q.get(), timeout=remain)
+                        except Exception:
+                            return web.Response(status=304)
+                        if matches(cand) and is_new(cand):
+                            fresh = [cand]
+                finally:
+                    if token is not None:
+                        token.unpark()
             for i in fresh:
                 seen_map[i.sort_key_str] = i.causal_context()
             marker = base64.urlsafe_b64encode(json.dumps({

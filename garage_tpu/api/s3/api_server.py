@@ -32,6 +32,7 @@ from ..common import (
     BucketNotEmptyError,
     NoSuchBucketError,
     admit_request,
+    client_deadline_budget,
     error_response,
     host_to_bucket,
     parse_bucket_key,
@@ -59,8 +60,11 @@ class S3ApiServer:
         self.root_domain = garage.config.root_domain
         # overload protection (docs/ROBUSTNESS.md "Overload & brownout"):
         # the node-wide admission gate (shared with the K2V server — one
-        # node, one capacity) and the per-request deadline budget
+        # node, one capacity), the cluster-aware pressure probe (shed at
+        # the front door on behalf of a gossiped-hot storage node) and
+        # the per-request deadline budget
         self.gate = getattr(garage, "admission", None)
+        self.probe = getattr(garage, "admission_probe", None)
         self.deadline_s = request_deadline_budget(garage.config)
         self._runner: Optional[web.AppRunner] = None
         # metrics (ref generic_server.rs:63-95)
@@ -108,35 +112,59 @@ class S3ApiServer:
         # admission control BEFORE any per-request work (signature, trace,
         # body): past the watermarks the request is shed with a typed
         # 503 SlowDown + Retry-After instead of queueing toward its
-        # client's timeout.  Admission is decided once — an admitted
-        # request (streaming bodies included) is never shed mid-transfer.
-        token, shed = admit_request(self.gate, request)
-        if shed is not None:
-            self.error_counter += 1
-            if self._m is not None:
-                self._m["errors"].inc(api="s3", status="503")
-            return shed
-        try:
-            # fresh trace per request (ref generic_server.rs:187-200);
-            # child spans (table ops, quorum RPCs, block IO — on EVERY
-            # node the request touches, via the propagated context)
-            # parent under it.  The request id returned to the client IS
-            # the trace id, so a quoted x-amz-request-id is the trace
-            # lookup key.  The deadline scope arms the request's
-            # end-to-end budget: every nested RPC hop carries what is
-            # left and sheds typed once it runs out.
-            trace, rid = request_trace(
-                self.garage.system.tracer, "S3", "s3", request)
-            with trace, deadline_scope(self.deadline_s), \
-                    maybe_time(self._m and self._m["duration"], api="s3"):
-                resp = await self._handle_with_errors(request, rid)
-                trace.set_attr("status", resp.status)
-                if not resp.prepared:
-                    resp.headers["x-amz-request-id"] = rid
-                return resp
-        finally:
+        # client's timeout.  Requests classify into per-tenant WDRR
+        # queues (by access key, fallback bucket), and the gossiped
+        # pressure of the bucket's placement nodes is folded in so a
+        # saturated storage node sheds HERE, not three hops later.
+        # Admission is decided once — an admitted request (streaming
+        # bodies included) is never shed mid-transfer.
+        remote_p = 0.0
+        vb = host_to_bucket(
+            request.headers.get("Host", ""), self.root_domain)
+        bname, key = parse_bucket_key(request.rel_url.raw_path, vb)
+        # routing (_handle) reuses THIS parse: classification and
+        # dispatch must never disagree about which bucket a request is
+        request["s3_bucket_key"] = (bname, key)
+        if self.probe is not None:
+            remote_p, _hot = self.probe.pressure(bname)
+        # the deadline scope arms the request's end-to-end budget —
+        # tightened (never extended) by a client-supplied
+        # X-Request-Timeout — BEFORE admission, so time queued in the
+        # WDRR gate spends the budget instead of stacking on top of it;
+        # every nested RPC hop carries what is left and sheds typed
+        # once it runs out.
+        budget = client_deadline_budget(self.deadline_s, request)
+        with deadline_scope(budget):
+            token, shed = await admit_request(
+                self.gate, request, remote_pressure=remote_p, bucket=bname)
+            if shed is not None:
+                self.error_counter += 1
+                if self._m is not None:
+                    self._m["errors"].inc(api="s3", status="503")
+                return shed
             if token is not None:
-                token.release()
+                # streaming handlers reconcile Content-Length-less bodies
+                # against the token (RequestContext.body_stream)
+                request["admission_token"] = token
+            try:
+                # fresh trace per request (ref generic_server.rs:187-200);
+                # child spans (table ops, quorum RPCs, block IO — on
+                # EVERY node the request touches, via the propagated
+                # context) parent under it.  The request id returned to
+                # the client IS the trace id, so a quoted
+                # x-amz-request-id is the trace lookup key.
+                trace, rid = request_trace(
+                    self.garage.system.tracer, "S3", "s3", request)
+                with trace, maybe_time(
+                        self._m and self._m["duration"], api="s3"):
+                    resp = await self._handle_with_errors(request, rid)
+                    trace.set_attr("status", resp.status)
+                    if not resp.prepared:
+                        resp.headers["x-amz-request-id"] = rid
+                    return resp
+            finally:
+                if token is not None:
+                    token.release()
 
     async def _handle_with_errors(self, request, rid: str) -> web.StreamResponse:
         try:
@@ -170,13 +198,20 @@ class S3ApiServer:
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         headers = {k.lower(): v for k, v in request.headers.items()}
-        vhost_bucket = host_to_bucket(headers.get("host", ""), self.root_domain)
-        # bucket/key come from the RAW (still-encoded) path, decoded exactly
-        # once in parse_bucket_key; request.path is already decoded and
-        # would double-decode keys containing %XX sequences
-        bucket_name, key_name = parse_bucket_key(
-            request.rel_url.raw_path, vhost_bucket
-        )
+        # bucket/key come from the RAW (still-encoded) path, decoded
+        # exactly once in parse_bucket_key (request.path is already
+        # decoded and would double-decode keys containing %XX); normally
+        # handle_request already parsed for admission — reuse it so
+        # classification and routing can never disagree
+        parsed = request.get("s3_bucket_key")
+        if parsed is not None:
+            bucket_name, key_name = parsed
+        else:
+            vhost_bucket = host_to_bucket(
+                headers.get("host", ""), self.root_domain)
+            bucket_name, key_name = parse_bucket_key(
+                request.rel_url.raw_path, vhost_bucket
+            )
         query = [(k, v) for k, v in request.query.items()]
         endpoint = parse_endpoint(
             request.method, bucket_name, key_name, query, headers
@@ -233,6 +268,11 @@ class S3ApiServer:
         bucket_id = await self.helper.resolve_bucket(bucket_name, api_key)
         bucket = await self.helper.get_existing_bucket(bucket_id)
         ctx.bucket_id, ctx.bucket = bucket_id, bucket
+        if self.probe is not None and bucket_name:
+            # teach the admission probe this bucket's placement so the
+            # NEXT request can fold the gossiped pressure of its layout
+            # nodes into the admit decision
+            self.probe.note_bucket(bucket_name, bytes(bucket_id))
 
         allowed = {
             READ: api_key.allow_read(bucket_id),
@@ -376,12 +416,20 @@ class RequestContext:
 
     def body_stream(self):
         """The (possibly chunk-signed) request body as an async byte
-        iterator (ref signature/streaming.rs wrapping)."""
+        iterator (ref signature/streaming.rs wrapping).  Bodies admitted
+        against the Content-Length-less ESTIMATE reconcile the admission
+        gate's byte accounting to the actual bytes as they stream."""
         from ..signature import decode_streaming_body
+
+        token = self.request.get("admission_token")
 
         async def raw():
             async for chunk in self.request.content.iter_any():
+                if token is not None:
+                    token.note_body_bytes(len(chunk))
                 yield chunk
+            if token is not None:
+                token.body_done()
 
         if self.verified.content_sha256 == "STREAMING":
             return decode_streaming_body(

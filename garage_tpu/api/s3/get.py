@@ -269,6 +269,11 @@ async def _stream_blocks_range(
     garage = ctx.garage
     hdrs["Content-Length"] = str(end - begin)
     hdrs.update(ctx.cors_headers)  # immutable after prepare()
+    # a streamed download's duration is the CLIENT's drain pace — keep
+    # it out of the CoDel admitted-latency law (api/admission.py)
+    token = ctx.request.get("admission_token")
+    if token is not None:
+        token.exclude_sojourn()
     resp = web.StreamResponse(status=status, headers=hdrs)
     await resp.prepare(ctx.request)
 

@@ -266,12 +266,20 @@ class Garage:
         # brownout"): the front-door admission gate and the background
         # load governor, wired to the live pressure signals this node
         # already produces ---
-        from ..api.admission import AdmissionGate
+        from ..api.admission import AdmissionGate, RemotePressureProbe
         from ..utils.overload import LoadGovernor
 
         self.admission = AdmissionGate(config.api, metrics=self.system.metrics)
         self.governor = LoadGovernor(config.api, metrics=self.system.metrics)
         self.governor.add_signal("admission", self.admission.occupancy)
+        # the Retry-After hint on sheds tracks live pressure, not a
+        # constant; gossip carries the same signal to remote gateways
+        # (cluster-aware admission) and the probe folds the gossiped
+        # pressure of a request's placement nodes back into this node's
+        # own front door
+        self.admission.pressure_fn = self.governor.pressure
+        self.system.governor_pressure_fn = self.governor.pressure
+        self.admission_probe = RemotePressureProbe(self.system)
         feeder = self.block_manager.feeder
         if feeder is not None:
             depth_full = max(config.api.governor_feeder_depth_full, 1)

@@ -74,6 +74,13 @@ class NodeStatus:
     # stats` shows which zones still run the old build.  None = peer
     # predates the field
     version: Optional[str] = None
+    # current load-governor pressure (utils/overload.py; 0 idle, >= 1
+    # saturated, clamped to 2).  Gateways fold the max pressure of the
+    # layout nodes a request must touch into ADMISSION (shed at the
+    # front door on behalf of the hot node — docs/ROBUSTNESS.md
+    # "Multi-tenant fairness & noisy neighbors").  None = peer predates
+    # the field / governor unwired
+    governor_pressure: Optional[float] = None
 
     def pack(self):
         return dataclasses.asdict(self)
@@ -84,6 +91,7 @@ class NodeStatus:
             "hostname", "replication_factor", "layout_version",
             "layout_staging_hash", "data_avail", "data_total",
             "meta_avail", "meta_total", "disk_state", "version",
+            "governor_pressure",
         )})
 
 
@@ -228,8 +236,30 @@ class System:
         # data-root health, gossiped in NodeStatus so peers' `cluster
         # stats` show a remote node going read-only)
         self.disk_state_fn: Optional[Callable[[], str]] = None
+        # set by Garage: () -> load-governor pressure in [0, 2], gossiped
+        # in NodeStatus so gateways can shed at the front door on behalf
+        # of a saturated storage node (cluster-aware admission)
+        self.governor_pressure_fn: Optional[Callable[[], float]] = None
 
         self.node_status: Dict[FixedBytes32, NodeStatus] = {}
+        # when each peer's status last arrived (monotonic): gossiped
+        # pressure EXPIRES — a node that advertised hot and then died
+        # must not keep gateways shedding its buckets forever
+        self._status_at: Dict[FixedBytes32, float] = {}
+        # the gossiped pressure map, scrapeable at any node: which peers
+        # currently advertise foreground saturation (feeds the Grafana
+        # "gossiped pressure map" panel and the noisy-neighbor drill)
+        self.metrics.gauge(
+            "cluster_peer_pressure",
+            "Last load-governor pressure each peer gossiped in its "
+            "NodeStatus (0 idle, >= 1 saturated; stale gossip reads 0)",
+            labeled_fn=lambda: [
+                ({"peer": bytes(nid).hex()[:16]},
+                 self.peer_pressure(nid))
+                for nid, st in self.node_status.items()
+                if st.governor_pressure is not None
+            ],
+        )
         self._discovery = None  # external (consul/k8s) backends, built lazily
         self._tasks: List[asyncio.Task] = []
         self._stopped = asyncio.Event()
@@ -264,6 +294,7 @@ class System:
             self.peering.forget_peer(fb)
             self.netapp.forget_peer_series(fb)
             self.node_status.pop(fb, None)
+            self._status_at.pop(fb, None)
         for cb in self._ring_callbacks:
             try:
                 cb(self.ring)
@@ -339,6 +370,12 @@ class System:
                 st.disk_state = self.disk_state_fn()
             except Exception:  # noqa: BLE001 — gossip must never break
                 logger.exception("disk_state_fn failed")
+        if self.governor_pressure_fn is not None:
+            try:
+                st.governor_pressure = round(
+                    float(self.governor_pressure_fn()), 4)
+            except Exception:  # noqa: BLE001 — gossip must never break
+                logger.exception("governor_pressure_fn failed")
         return st
 
     def _disk_stats(self) -> dict:
@@ -393,23 +430,31 @@ class System:
                 out.append([bytes(nid), addr])
         return out
 
+    async def advertise_status(self):
+        """One status-gossip round: broadcast our NodeStatus (disk
+        health, governor pressure, layout version) plus the peer book.
+        The exchange loop calls this on its interval; drills/tests call
+        it directly to push fresh pressure without waiting 10 s."""
+        # Peer-list gossip rides the status broadcast: an operator
+        # who runs `connect` against ONE node (the star bootstrap
+        # every real deployment starts as) must converge to a full
+        # mesh — without address exchange, nodes only ever know
+        # the peers someone explicitly dialed for them, and a
+        # partition heals only by operator action (observed: star
+        # survivors couldn't reach table quorums after node loss).
+        # ref: netapp's FullMeshPeeringStrategy PeerList exchange.
+        msg = {
+            "t": "advertise_status",
+            "status": self._local_status().pack(),
+            "peers": self._peer_book(),
+        }
+        await self.rpc.broadcast(self.endpoint, msg, prio=PRIO_HIGH,
+                                 timeout=10.0)
+
     async def _status_exchange_loop(self):
         while not self._stopped.is_set():
             try:
-                # Peer-list gossip rides the status broadcast: an operator
-                # who runs `connect` against ONE node (the star bootstrap
-                # every real deployment starts as) must converge to a full
-                # mesh — without address exchange, nodes only ever know
-                # the peers someone explicitly dialed for them, and a
-                # partition heals only by operator action (observed: star
-                # survivors couldn't reach table quorums after node loss).
-                # ref: netapp's FullMeshPeeringStrategy PeerList exchange.
-                msg = {
-                    "t": "advertise_status",
-                    "status": self._local_status().pack(),
-                    "peers": self._peer_book(),
-                }
-                await self.rpc.broadcast(self.endpoint, msg, prio=PRIO_HIGH, timeout=10.0)
+                await self.advertise_status()
             except Exception as e:
                 logger.debug("status exchange failed: %s", e)
             await asyncio.sleep(STATUS_EXCHANGE_INTERVAL)
@@ -500,6 +545,7 @@ class System:
         if t == "advertise_status":
             st = NodeStatus.unpack(msg["status"])
             self.node_status[FixedBytes32(remote)] = st
+            self._status_at[FixedBytes32(remote)] = time.monotonic()
             # a peer with a newer layout triggers a pull
             if st.layout_version > self.layout.version:
                 asyncio.get_running_loop().create_task(self._pull_layout(remote))
@@ -573,6 +619,34 @@ class System:
             return self.version
         st = self.node_status.get(FixedBytes32(bytes(nid)))
         return st.version if st is not None else None
+
+    # gossiped pressure older than this reads as 0: a hot node that
+    # crashed (or partitioned away) must stop shedding its buckets at
+    # every gateway within a few missed exchange rounds
+    PRESSURE_TTL = 3 * STATUS_EXCHANGE_INTERVAL
+
+    def peer_pressure(self, nid) -> float:
+        """Load-governor pressure `nid` last gossiped (0.0 when unknown
+        or STALE — a silent peer is not presumed hot, and a dead one
+        must not stay hot forever).  Cluster-aware admission folds the
+        max over a request's placement nodes into the admit decision
+        (api/admission.py RemotePressureProbe); the degraded-read
+        planner can rank survivors by the same signal."""
+        if bytes(nid) == bytes(self.id):
+            if self.governor_pressure_fn is not None:
+                try:
+                    return float(self.governor_pressure_fn())
+                except Exception:  # noqa: BLE001
+                    return 0.0
+            return 0.0
+        fb = FixedBytes32(bytes(nid))
+        st = self.node_status.get(fb)
+        if st is None or st.governor_pressure is None:
+            return 0.0
+        at = self._status_at.get(fb)
+        if at is None or time.monotonic() - at > self.PRESSURE_TTL:
+            return 0.0
+        return float(st.governor_pressure)
 
     def get_known_nodes(self) -> List[dict]:
         """Peer list for status displays (ids as hex, JSON-safe)."""

@@ -400,8 +400,11 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
     bad = set(api) - known
     if bad:
         raise ConfigError(f"unknown [api] keys: {sorted(bad)}")
-    if "max_inflight_bytes" in api:
-        api["max_inflight_bytes"] = parse_capacity(api["max_inflight_bytes"])
+    # human-friendly capacities for the byte-sized QoS knobs
+    for key in ("max_inflight_bytes", "wdrr_quantum_bytes",
+                "wdrr_request_cost", "streaming_body_estimate"):
+        if key in api:
+            api[key] = parse_capacity(api[key])
     cfg.api = OverloadTunables(**api)
     if cfg.api.max_inflight < 0:
         raise ConfigError("api.max_inflight must be >= 0 (0 = unlimited)")
@@ -411,6 +414,37 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         raise ConfigError("api.governor_min_ratio must be in (0, 1]")
     if not 0.0 <= cfg.api.governor_low < cfg.api.governor_high:
         raise ConfigError("api.governor_low must be in [0, governor_high)")
+    if cfg.api.tenant_queue_len < 1:
+        raise ConfigError("api.tenant_queue_len must be >= 1")
+    if cfg.api.tenant_queue_wait < 0:
+        raise ConfigError("api.tenant_queue_wait must be >= 0")
+    if cfg.api.wdrr_quantum_bytes < 1:
+        raise ConfigError("api.wdrr_quantum_bytes must be >= 1")
+    if cfg.api.wdrr_request_cost < 0:
+        raise ConfigError("api.wdrr_request_cost must be >= 0")
+    if cfg.api.max_tracked_tenants < 1:
+        raise ConfigError("api.max_tracked_tenants must be >= 1")
+    if cfg.api.remote_pressure_shed < 0:
+        raise ConfigError(
+            "api.remote_pressure_shed must be >= 0 (0 = disabled)")
+    if cfg.api.codel_target < 0:
+        raise ConfigError("api.codel_target must be >= 0 (0 = static)")
+    if cfg.api.codel_interval <= 0:
+        raise ConfigError("api.codel_interval must be > 0")
+    if cfg.api.streaming_body_estimate < 0:
+        raise ConfigError("api.streaming_body_estimate must be >= 0")
+    if cfg.api.longpoll_max_parked < 0:
+        raise ConfigError(
+            "api.longpoll_max_parked must be >= 0 (0 = 4x max_inflight)")
+    if "retry_after_max" not in api:
+        # pre-existing configs may carry retry_after > the new cap's
+        # default: an upgrade must not refuse to boot — widen the
+        # derived ceiling instead of raising
+        cfg.api.retry_after_max = max(cfg.api.retry_after_max,
+                                      int(cfg.api.retry_after), 1)
+    if cfg.api.retry_after_max < max(int(cfg.api.retry_after), 1):
+        raise ConfigError(
+            "api.retry_after_max must be >= api.retry_after (and >= 1)")
 
     codec = raw.get("codec", {})
     known = {f.name for f in dataclasses.fields(CodecConfig)}

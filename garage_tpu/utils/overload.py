@@ -51,8 +51,47 @@ class OverloadTunables:
     # admission gate watermarks
     max_inflight: int = 256
     max_inflight_bytes: int = 1 << 30          # 1 GiB of declared bodies
-    # suggested client back-off seconds on a shed (Retry-After header)
+    # base client back-off seconds on a shed; the Retry-After actually
+    # sent is DERIVED from live load (governor pressure / queue depth,
+    # AdmissionGate.retry_after_hint) between retry_after and
+    # retry_after_max
     retry_after: int = 1
+    retry_after_max: int = 30
+    # --- multi-tenant fair queueing (WDRR; docs/ROBUSTNESS.md
+    # "Multi-tenant fairness & noisy neighbors") ---
+    # bound on each tenant's admission queue (requests waiting for a
+    # slot); past it that tenant sheds — never the whole gate
+    tenant_queue_len: int = 32
+    # max seconds a request may wait queued before shedding typed
+    tenant_queue_wait: float = 1.0
+    # WDRR byte deficit added per scheduling visit, and the per-request
+    # base cost (so byte-free GETs still consume deficit)
+    wdrr_quantum_bytes: int = 256 * 1024
+    wdrr_request_cost: int = 64 * 1024
+    # cap on distinct tenants tracked (metric-cardinality bound; the
+    # overflow shares one "~overflow" bucket)
+    max_tracked_tenants: int = 1024
+    # --- cluster-aware admission ---
+    # gossiped governor_pressure of a layout node the request must touch
+    # at/above this sheds at the front door (verdict remote_pressure);
+    # 0 disables.  Pressure is clamped to [0, 2]; 1 means saturated.
+    remote_pressure_shed: float = 1.5
+    # --- CoDel-style adaptive watermark ---
+    # admitted-request sojourn target (seconds): latency persistently
+    # above it for codel_interval tightens the effective in-flight
+    # limit; persistently below relaxes back toward max_inflight.
+    # 0 disables (static watermark).
+    codel_target: float = 0.5
+    codel_interval: float = 2.0
+    # --- byte accounting for Content-Length-less (chunked) bodies ---
+    # conservative bytes charged at admission for a streaming body with
+    # no declared length, reconciled to actual bytes as it streams
+    streaming_body_estimate: int = 16 * 1024 * 1024
+    # bound on the separate long-poll pool (parked K2V polls).  Past it
+    # a poll keeps holding its admission slot instead of parking, so
+    # total poll concurrency stays bounded by the gate as before this
+    # pool existed.  0 = derive 4 x max_inflight.
+    longpoll_max_parked: int = 0
     # --- load governor ---
     # pressure <= governor_low → background at full rate (ratio 1.0);
     # pressure >= governor_high → background at governor_min_ratio;
@@ -107,6 +146,11 @@ class LoadGovernor:
 
     def add_signal(self, name: str, fn: Callable[[], float]) -> None:
         self._signals.append((name, fn))
+
+    def remove_signal(self, name: str) -> None:
+        """Drop a signal by name (chaos drills inject synthetic pressure
+        and must be able to heal it)."""
+        self._signals = [(n, f) for n, f in self._signals if n != name]
 
     def note_queue_wait(self, seconds: float) -> None:
         """Fed by netapp's write loop with each frame's queue wait; a

@@ -90,6 +90,11 @@ STORM_PHASES = ("repair_storm",)
 # the at-capacity baseline, background_throttle_ratio cedes + recovers,
 # zero acked-data loss
 OVERLOAD_PHASES = ("overload",)
+# ISSUE 12 multi-tenant QoS drill: one abusive tenant saturates the
+# gateway — well-behaved tenants see ZERO errors and their p99 holds,
+# the abuser's excess sheds typed per-tenant, and a gossiped-hot storage
+# node triggers a remote_pressure shed at a locally-idle gateway
+QOS_PHASES = ("noisy_neighbor",)
 
 
 def _apply(inj, phase):
@@ -437,6 +442,47 @@ async def run_overload(secs, n_storage=3, n_zones=3):
     return summary
 
 
+async def run_noisy(secs, n_storage=3, n_zones=3):
+    """ISSUE-12 acceptance: a SimCluster whose gateway admits at most 6
+    concurrent requests hosts one abusive tenant at 2× that concurrency
+    against 4 gently-paced well-behaved tenants.  The noisy_neighbor
+    drill asserts per-tenant shed isolation (zero well-behaved sheds or
+    errors, abuser shed typed), a bounded well-behaved p99, at least one
+    remote_pressure shed at a locally-under-watermark gateway, and the
+    new metric families passing the strict lint."""
+    import aiohttp
+
+    from garage_tpu.testing.sim_cluster import (
+        SimCluster,
+        noisy_neighbor_drill,
+    )
+
+    summary = {"phases": {}, "ok": True}
+    with tempfile.TemporaryDirectory(prefix="garage_noisy_") as tmp:
+        cluster = SimCluster(
+            tmp, n_storage=n_storage, n_zones=n_zones,
+            extra_cfg={"api": {"max_inflight": 6,
+                               "governor_tau": 0.5,
+                               "tenant_queue_wait": 2.0}})
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                st = await noisy_neighbor_drill(cluster, session, secs)
+                summary["phases"]["noisy_neighbor"] = st
+                for key in ("abuser_shed_typed",
+                            "remote_shed_observed", "admitted_after_heal"):
+                    summary["ok"] &= bool(st.get(key))
+                summary["ok"] &= st.get("well_sheds") == 0
+                summary["ok"] &= st.get("errors") == 0
+                summary["ok"] &= st.get("verify_mismatches") == 0
+                summary["ok"] &= st.get("metric_families_missing") == []
+                summary["ok"] &= st.get("promlint_errors") == []
+                print(f"phase noisy_neighbor: {st}", file=sys.stderr)
+        finally:
+            await cluster.stop()
+    return summary
+
+
 async def run_zone(phases, secs, n_storage, n_zones):
     """The zone-scale drills on one SimCluster (built once, phases run
     in order — blackhole heals before drain, drain precedes rolling)."""
@@ -501,7 +547,8 @@ async def run_zone(phases, secs, n_storage, n_zones):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    all_phases = PHASES + ZONE_PHASES + STORM_PHASES + OVERLOAD_PHASES
+    all_phases = (PHASES + ZONE_PHASES + STORM_PHASES + OVERLOAD_PHASES
+                  + QOS_PHASES)
     ap.add_argument("--phases", default=",".join(PHASES),
                     help="comma-separated subset of " + ",".join(all_phases))
     ap.add_argument("--secs", type=float, default=8.0,
@@ -523,6 +570,7 @@ def main():
     zone_phases = [p for p in phases if p in ZONE_PHASES]
     storm_phases = [p for p in phases if p in STORM_PHASES]
     overload_phases = [p for p in phases if p in OVERLOAD_PHASES]
+    qos_phases = [p for p in phases if p in QOS_PHASES]
     if zone_phases:
         # the drills name zones z2/z{n} and a rolling restart only stays
         # client-invisible when every partition keeps ≥2 live zones
@@ -548,6 +596,10 @@ def main():
         summary["ok"] &= s["ok"]
     if overload_phases:
         s = asyncio.run(run_overload(secs))
+        summary["phases"].update(s["phases"])
+        summary["ok"] &= s["ok"]
+    if qos_phases:
+        s = asyncio.run(run_noisy(secs))
         summary["phases"].update(s["phases"])
         summary["ok"] &= s["ok"]
     print("CHAOS " + json.dumps(summary))

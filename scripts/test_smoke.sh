@@ -45,4 +45,12 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
 # background_throttle_ratio cedes and recovers, zero acked-data loss
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
     --phases overload
+# multi-tenant QoS smoke (ISSUE-12 acceptance): one abusive tenant at 2x
+# the gateway's admission capacity vs gently-paced well-behaved tenants —
+# zero well-behaved sheds/errors, abuser shed typed per-tenant, at least
+# one remote_pressure shed at a locally-under-watermark gateway (gossiped
+# governor_pressure), and the new api_tenant_* / admission / pressure
+# metric families render and pass the strict exposition lint
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases noisy_neighbor
 echo "SMOKE+CHAOS OK"

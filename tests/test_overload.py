@@ -525,13 +525,18 @@ async def test_s3_front_door_sheds_typed_503(tmp_path):
         st, _h, _b = await client.req("PUT", "/shedbkt")
         assert st == 200
         gate = garages[0].admission
+        # an under-share tenant queues briefly before shedding; keep the
+        # bounded wait tiny so the test observes the typed shed fast
+        gate.tun.tenant_queue_wait = 0.05
         # hold the gate at its watermark from the outside
         hold = [gate.try_admit()
                 for _ in range(gate.tun.max_inflight - gate.inflight)]
         st, hdrs, body = await client.req(
             "PUT", "/shedbkt/obj", body=b"x" * 1024)
         assert st == 503
-        assert hdrs.get("Retry-After") == "1"
+        # Retry-After is DERIVED from live load now (occupancy 1.0 at a
+        # held-full gate), so it must be a positive integer >= the base
+        assert int(hdrs.get("Retry-After")) >= 1
         root = ET.fromstring(body)
         assert root.findtext("Code") == "SlowDown"
         assert root.findtext("RequestId")
