@@ -752,6 +752,43 @@ async def _mk_cluster(tmp, n=1, repl="none", codec_cfg=None, quotas=None,
     return garages, server, server.port, key.key_id, key.params().secret_key
 
 
+def _phase_critical_path(garages, prefix: str) -> dict:
+    """{f"{prefix}_critical_path": per-endpoint sampled breakdown} from
+    the cluster nodes' waterfall recorders (utils/waterfall.py): for
+    each endpoint the phase exercised, the sampled request count, mean
+    duration, dominant critical-path segment and the per-segment time
+    split — so every BENCH phase carries its own "where did the time
+    go", not just a latency number."""
+    merged: dict = {}
+    for g in garages:
+        wf = getattr(g.system.tracer, "waterfall", None)
+        if wf is None:
+            continue
+        for ep, tot in wf.totals().items():
+            m = merged.setdefault(
+                ep, {"count": 0, "seconds": 0.0, "segments": {}})
+            m["count"] += tot["count"]
+            m["seconds"] += tot["seconds"]
+            for seg, s in tot["segments"].items():
+                m["segments"][seg] = m["segments"].get(seg, 0.0) + s
+    out = {}
+    for ep, m in merged.items():
+        if not m["count"]:
+            continue
+        dom = max(m["segments"], key=lambda s: m["segments"][s]) \
+            if m["segments"] else "other"
+        out[ep] = {
+            "sampled": m["count"],
+            "mean_ms": round(m["seconds"] / m["count"] * 1000.0, 2),
+            "dominant": dom,
+            "segments_ms": {
+                k: round(v / m["count"] * 1000.0, 3)
+                for k, v in sorted(m["segments"].items(),
+                                   key=lambda kv: -kv[1])},
+        }
+    return {f"{prefix}_critical_path": out} if out else {}
+
+
 class _S3:
     """Minimal SigV4 client against the in-process server."""
 
@@ -870,6 +907,7 @@ async def _put_phase_async(n=3, repl="3", prefix="put") -> dict:
                              int(len(conc_lat) * 0.99))], 2),
             f"{prefix}_conc8_puts_per_s": round(n_conc / conc_dt, 1),
         }
+        out.update(_phase_critical_path(garages, prefix))
         await server.stop()
         for g in garages:
             await g.shutdown()
@@ -930,6 +968,7 @@ async def _rs_put_phase_async() -> dict:
             "rs42_covered_blocks": covered,
             "rs42_total_blocks": total_blocks,
         }
+        out.update(_phase_critical_path(garages, "rs42"))
         await server.stop()
         await g.shutdown()
         return out
@@ -1012,6 +1051,7 @@ async def _mp_phase_async() -> dict:
             "mp_part_mibs_p50": round(part_rates[len(part_rates) // 2], 1),
             "mp_gib_moved": round(moved / 2**30, 2),
         }
+        out.update(_phase_critical_path([g], "mp"))
         await server.stop()
         await g.shutdown()
         return out
@@ -1078,6 +1118,7 @@ async def _wan_phase_async() -> dict:
             "wan_put_p50_rtt": round(p50p / WAN_RTT_MS, 2),
             "wan_get_p50_rtt": round(p50g / WAN_RTT_MS, 2),
         }
+        out.update(_phase_critical_path(garages, "wan"))
         await server.stop()
         for g in garages:
             await g.shutdown()
@@ -1222,6 +1263,9 @@ async def _degraded_phase_async() -> dict:
                 g.block_manager.blocks_reconstructed
                 for i, g in enumerate(garages) if i not in victims),
         }
+        out.update(_phase_critical_path(
+            [g for i, g in enumerate(inj.garages) if i not in inj.dead],
+            "degraded"))
         await server.stop()
         for i, g in enumerate(inj.garages):
             if i not in inj.dead:
@@ -1385,6 +1429,7 @@ async def _repair_storm_phase_async() -> dict:
             "repair_storm_ppr_fallbacks": sum(
                 g.block_manager.repair_ppr_fallbacks for g in survivors),
         }
+        out.update(_phase_critical_path(survivors, "repair_storm"))
         await server.stop()
         for i, g in enumerate(inj.garages):
             if i not in inj.dead:
@@ -1491,6 +1536,8 @@ async def _put_batched_phase_async() -> dict:
         assert st_["dispatches"] > 0, "feeder never dispatched"
         assert clusters["put_inline"][0][0].block_manager.feeder is None, \
             "feeder=false must disable it"
+        out.update(_phase_critical_path(
+            clusters["put_batched"][0], "put_batched"))
         for garages, server, _p, _k, _s in clusters.values():
             await server.stop()
             for g in garages:
@@ -1586,7 +1633,7 @@ async def _overload_phase_async() -> dict:
             "levels": levels,
             "admitted_total": gate["admitted_total"],
             "shed_total": gate["shed_total"],
-        }}
+        }, **_phase_critical_path(garages, "overload")}
     finally:
         try:
             await server.stop()
@@ -1798,10 +1845,11 @@ async def _tenants_phase_async() -> dict:
         assert out["well_p99_held"], \
             f"well-behaved p99 broke its bound: {out}"
         assert out["errors"] == 0, out
+        cp = _phase_critical_path(garages, "tenants")
         await server.stop()
         for g in garages:
             await g.shutdown()
-        return {"tenants": out}
+        return {"tenants": out, **cp}
     finally:
         for p in proxies:
             try:
@@ -2156,28 +2204,47 @@ def _best_prior_headline() -> tuple:
     return best, src
 
 
+def _dominant_stage(out: dict) -> str:
+    """Name the stage/segment that owns the headline's wall clock: the
+    largest-seconds entry of the codec attribution block (e.g.
+    "cpu_span/cpu").  The regression guard prints it so a failed run
+    opens with WHERE the time went, not just that it regressed."""
+    stages = ((out.get("attribution") or {}).get("stages") or {})
+    if not stages:
+        return "unknown"
+    return max(stages, key=lambda k: stages[k].get("seconds", 0.0))
+
+
 def _headline_guard(out: dict) -> int:
     """ROADMAP's explicit ask: regression-guard the headline in bench.py.
     Returns a nonzero exit code (after the JSON is emitted) when `value`
     drops more than (1 - HEADLINE_REGRESSION_FRAC) below the best prior
-    round, with a message naming both numbers."""
+    round, with a message naming both numbers AND the dominant
+    critical-path stage of the attribution block."""
     best, src = _best_prior_headline()
     out["headline_best_prior_gibs"] = round(best, 4)
     out["headline_best_prior_src"] = src
+    dominant = _dominant_stage(out)
+    out["headline_dominant_segment"] = dominant
     value = float(out.get("value") or 0.0)
     if best > 0.0 and value < HEADLINE_REGRESSION_FRAC * best:
+        put_cp = out.get("put_critical_path") or {}
+        put_dom = ", ".join(
+            f"{ep}→{d.get('dominant')}" for ep, d in put_cp.items())
         print(
             f"# HEADLINE REGRESSION: value {value:.3f} GiB/s is more than "
             f"{round((1 - HEADLINE_REGRESSION_FRAC) * 100)}% below the best "
             f"prior round ({best:.3f} GiB/s in {src}) — failing the run. "
+            f"Dominant critical-path segment: {dominant}"
+            + (f" (API phases: {put_dom})" if put_dom else "") + ". "
             f"Attribution: gate={out.get('hybrid_gate')} "
             f"link={out.get('hybrid_link_gibs')} GiB/s "
             f"cpu={out.get('cpu_gibs')} GiB/s "
             f"transport_frac={out.get('sustained_tpu_frac')} "
             f"copies/block={out.get('transport_new_copies_per_block')}; "
             f"see the `attribution` block in the emitted JSON for "
-            f"per-stage timings and the transport_* keys for the "
-            f"zero-copy A/B.",
+            f"per-stage timings and the *_critical_path keys for the "
+            f"per-endpoint segment splits.",
             file=sys.stderr, flush=True)
         return 1
     return 0

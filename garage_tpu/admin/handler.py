@@ -724,6 +724,121 @@ class AdminRpcHandler:
             int(limit) if limit else None
         )
 
+    # --- critical-path attribution (docs/OBSERVABILITY.md "Critical
+    #     path & saturation"; utils/waterfall.py) ----------------------
+
+    async def _cmd_trace_spans(self, msg) -> List[Dict]:
+        """Every span record THIS node holds for one trace id — the
+        per-peer fetch the waterfall merge fans out (a request's remote
+        handler/table/disk spans live on the nodes that ran them)."""
+        wf = getattr(self.garage.system.tracer, "waterfall", None)
+        if wf is None:
+            return []
+        return wf.spans_for_trace(str(msg["trace"]))
+
+    async def _cmd_request_waterfall(self, msg) -> Dict:
+        """The request-waterfall surface.  Without a selector: the
+        per-endpoint summary + retained slowest exemplars.  With
+        `trace` (an x-amz-request-id) or `endpoint`: ONE request's full
+        span tree, merged across every layout node that contributed
+        spans, with the critical-path segment breakdown recomputed over
+        the merged tree."""
+        wf = getattr(self.garage.system.tracer, "waterfall", None)
+        if wf is None:
+            raise GarageError("no waterfall recorder on this node")
+        trace = msg.get("trace")
+        endpoint = msg.get("endpoint")
+        if trace is None and endpoint is None and not msg.get("pick"):
+            return {
+                "endpoints": wf.endpoints(),
+                "retained": wf.entries(),
+                "sampled": wf.sampled,
+            }
+        entry = wf.entry_for(trace_id=trace, endpoint=endpoint)
+        if entry is None:
+            raise GarageError(
+                f"no retained waterfall for "
+                f"{'trace ' + trace if trace else 'endpoint ' + str(endpoint)}"
+            )
+        return await self._merged_waterfall(entry)
+
+    async def _merged_waterfall(self, entry: Dict) -> Dict:
+        from ..utils.waterfall import (
+            build_tree,
+            dominant_segment,
+            segment_breakdown,
+        )
+
+        tid = entry["trace_id"]
+        spans = {r["span"]: dict(r) for r in entry["local_spans"]}
+        nodes_contributing = 1
+        endpoint_rpc = getattr(self, "endpoint", None)
+        if endpoint_rpc is not None:
+            import asyncio
+
+            from ..net.frame import PRIO_NORMAL
+
+            sys = self.garage.system
+            peers = [nid for nid in sys.layout.node_roles().keys()
+                     if bytes(nid) != bytes(sys.id)]
+
+            async def fetch(nid):
+                try:
+                    resp = await endpoint_rpc.call(
+                        nid, {"cmd": "trace_spans", "trace": tid},
+                        prio=PRIO_NORMAL, timeout=5.0)
+                    return resp.get("ok") or []
+                except Exception:  # noqa: BLE001 — best-effort merge
+                    return []
+
+            for remote in await asyncio.gather(*[fetch(n) for n in peers]):
+                if remote:
+                    nodes_contributing += 1
+                for r in remote:
+                    spans.setdefault(r["span"], dict(r))
+        records = list(spans.values())
+        root = next((r for r in records if r.get("parent") is None
+                     and (r.get("attrs") or {}).get("api")), None)
+        if root is None:
+            root = max(records,
+                       key=lambda r: r["end_ns"] - r["start_ns"])
+        segments = segment_breakdown(records, root)
+        dom, _s = dominant_segment(segments)
+        return {
+            "trace_id": tid,
+            "endpoint": entry["endpoint"],
+            "seconds": entry["seconds"],
+            "ts": entry["ts"],
+            "segments": {k: round(v, 6) for k, v in sorted(
+                segments.items(), key=lambda kv: -kv[1])},
+            "dominant": dom,
+            "span_count": len(records),
+            "nodes_contributing": nodes_contributing,
+            "tree": build_tree(records, root),
+        }
+
+    async def _cmd_device_timeline(self, msg) -> Dict:
+        """The device/transport pipeline timeline as Chrome-trace
+        (catapult) JSON — load into chrome://tracing or Perfetto; the
+        per-slot tracks show staging overlapping compute (or not)."""
+        limit = msg.get("limit")
+        tl = self.garage.block_manager.codec.obs.timeline
+        return tl.chrome_trace(int(limit) if limit else None)
+
+    async def _cmd_exemplars(self, msg) -> List[Dict]:
+        """Current-window histogram exemplars: for each exemplar-enabled
+        family and label set, the max observation and the trace id that
+        produced it — the bridge from a p99 bucket to `request
+        waterfall --trace`."""
+        from ..utils.metrics import Histogram
+
+        out = []
+        for m in self.garage.system.metrics._metrics:
+            if isinstance(m, Histogram) and m.exemplars:
+                for ex in m.exemplar_snapshot():
+                    out.append({"family": m.name, **ex})
+        return out
+
     async def _cmd_launch_repair(self, msg) -> str:
         what = msg.get("what", "tables")
         g = self.garage

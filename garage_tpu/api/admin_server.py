@@ -20,6 +20,32 @@ from .common import start_site
 logger = logging.getLogger("garage_tpu.api.admin")
 
 
+def metrics_body(garage, openmetrics: bool = False) -> str:
+    """The full Prometheus exposition for one node: the ad-hoc cluster
+    gauges + the refreshed registry.  Module-level so the metrics-docs
+    lint (tests + smoke) checks exactly what /metrics serves."""
+    lines = []
+
+    def gauge(name, value, help_=""):
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+
+    h = garage.system.health()
+    gauge("cluster_healthy", 1 if h.status == "healthy" else 0)
+    gauge("cluster_available", 1 if h.status != "unavailable" else 0)
+    gauge("cluster_connected_nodes", h.connected_nodes)
+    gauge("cluster_known_nodes", h.known_nodes)
+    # refresh scrape-time observed gauges (per-table backlogs, the
+    # per-worker status registry, per-peer health), then render the
+    # registry that the rpc/table/block/api layers record into
+    for t in garage.tables:
+        t.observe_gauges()
+    garage.bg.observe_gauges(garage.system.metrics)
+    garage.system.peering.observe_gauges()
+    return ("\n".join(lines) + "\n"
+            + garage.system.metrics.render(openmetrics=openmetrics))
+
+
 class AdminApiServer:
     def __init__(self, garage):
         self.garage = garage
@@ -79,6 +105,7 @@ class AdminApiServer:
         app.router.add_delete(
             "/v1/bucket/alias/local", self.handle_unalias_local)
         app.router.add_get("/check", self.handle_check_domain)
+        app.router.add_get("/v1/timeline", self.handle_timeline)
         # v0 compat surface (ref api/admin/router_v0.rs:88-122): thin
         # aliases onto the v1 handlers — upstream v0 and v1 share their
         # request/response shapes for these routes (key.rs serves both);
@@ -162,31 +189,27 @@ class AdminApiServer:
     async def handle_metrics(self, request) -> web.Response:
         """Prometheus exposition of every layer's metrics (ref
         api/admin/api_server.rs:271-335 + rpc/table/block/api metric
-        structs)."""
+        structs).  `?exemplars=1` appends histogram exemplars — trace
+        ids on max buckets — in the OpenMetrics suffix syntax.  This is
+        an EXPLICIT opt-in only, never Accept-header sniffing: a stock
+        Prometheus server advertises openmetrics-text on every scrape
+        but selects its parser by the response Content-Type, and an
+        exemplar suffix under text/plain would fail the whole scrape."""
         tok = self.garage.config.admin_metrics_token
         if tok is not None:
             self._check_token(request, tok)
-        g = self.garage
-        lines = []
-
-        def gauge(name, value, help_=""):
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {value}")
-
-        h = g.system.health()
-        gauge("cluster_healthy", 1 if h.status == "healthy" else 0)
-        gauge("cluster_available", 1 if h.status != "unavailable" else 0)
-        gauge("cluster_connected_nodes", h.connected_nodes)
-        gauge("cluster_known_nodes", h.known_nodes)
-        # refresh scrape-time observed gauges (per-table backlogs, the
-        # per-worker status registry, per-peer health), then render the
-        # registry that the rpc/table/block/api layers record into
-        for t in g.tables:
-            t.observe_gauges()
-        g.bg.observe_gauges(g.system.metrics)
-        g.system.peering.observe_gauges()
-        body = "\n".join(lines) + "\n" + g.system.metrics.render()
+        om = request.query.get("exemplars") == "1"
+        body = metrics_body(self.garage, openmetrics=om)
         return web.Response(text=body, content_type="text/plain")
+
+    async def handle_timeline(self, request) -> web.Response:
+        """Chrome-trace (catapult) JSON of the device/transport
+        pipeline timeline — load into chrome://tracing / Perfetto."""
+        self._admin(request)
+        limit = request.query.get("limit")
+        tl = self.garage.block_manager.codec.obs.timeline
+        return web.json_response(
+            tl.chrome_trace(int(limit) if limit else None))
 
     async def handle_status(self, request) -> web.Response:
         self._admin(request)

@@ -334,7 +334,8 @@ def client_addr(request) -> str:
     return request.remote or ""
 
 
-def request_trace(tracer, title: str, api: str, request):
+def request_trace(tracer, title: str, api: str, request,
+                  start_ns: Optional[int] = None):
     """Per-request trace root shared by the S3/K2V/Web servers (ref
     api/generic_server.rs:187-200 creates one span per request with a
     fresh trace id).  Records method/path, the TCP peer, and the
@@ -343,7 +344,12 @@ def request_trace(tracer, title: str, api: str, request):
     → (span, request_id).  The request id IS the trace id (it seeds the
     root span), so the `x-amz-request-id` a client quotes in a support
     ticket is the exact key to look the distributed trace up by.  The
-    id exists even with tracing off — clients always get one."""
+    id exists even with tracing off — clients always get one.
+
+    `start_ns` backdates the root to request INTAKE: admission runs
+    before the trace can be minted (sheds must stay cheap), but its
+    time belongs to the request — the waterfall's segments then sum to
+    the duration the client actually saw."""
     rid = os.urandom(16).hex()
     attrs = {
         "api": api,
@@ -355,7 +361,8 @@ def request_trace(tracer, title: str, api: str, request):
     if fwd != attrs["peer"]:
         attrs["forwarded_for"] = fwd
     return tracer.new_trace(
-        f"{title} {request.method}", trace_id=rid, **attrs
+        f"{title} {request.method}", trace_id=rid, start_ns=start_ns,
+        **attrs
     ), rid
 
 

@@ -122,9 +122,13 @@ class K2VApiServer:
         # client bound); armed BEFORE admission so WDRR queue time
         # spends the budget instead of stacking on top of it
         budget = client_deadline_budget(budget, request)
+        import time as _time
+
+        t_intake_ns = _time.time_ns()
         with deadline_scope(budget):
             token, shed = await admit_request(
                 self.gate, request, remote_pressure=remote_p, bucket=bname)
+            t_admitted_ns = _time.time_ns()
             if shed is not None:
                 return shed
             if token is not None:
@@ -132,8 +136,15 @@ class K2VApiServer:
                 # pollers don't starve the in-flight watermark
                 request["admission_token"] = token
             try:
+                tracer = self.garage.system.tracer
                 trace, rid = request_trace(
-                    self.garage.system.tracer, "K2V", "k2v", request)
+                    tracer, "K2V", "k2v", request, start_ns=t_intake_ns)
+                if t_admitted_ns > t_intake_ns:
+                    # the waterfall's `admission` segment (root is
+                    # backdated to intake, so this lands inside it)
+                    tracer.record_span(
+                        "admission", trace.trace_id, trace.span_id,
+                        t_intake_ns, t_admitted_ns)
                 with trace:
                     resp = await self._handle_with_errors(request, rid)
                     trace.set_attr("status", resp.status)

@@ -80,7 +80,8 @@ class S3ApiServer:
                 "errors": m.counter(
                     "api_error_counter", "API requests answered with an error"),
                 "duration": m.histogram(
-                    "api_request_duration_seconds", "API request latency"),
+                    "api_request_duration_seconds", "API request latency",
+                    exemplars=True),
             }
         else:
             self._m = None
@@ -134,9 +135,13 @@ class S3ApiServer:
         # every nested RPC hop carries what is left and sheds typed
         # once it runs out.
         budget = client_deadline_budget(self.deadline_s, request)
+        import time as _time
+
+        t_intake_ns = _time.time_ns()
         with deadline_scope(budget):
             token, shed = await admit_request(
                 self.gate, request, remote_pressure=remote_p, bucket=bname)
+            t_admitted_ns = _time.time_ns()
             if shed is not None:
                 self.error_counter += 1
                 if self._m is not None:
@@ -152,13 +157,26 @@ class S3ApiServer:
                 # EVERY node the request touches, via the propagated
                 # context) parent under it.  The request id returned to
                 # the client IS the trace id, so a quoted
-                # x-amz-request-id is the trace lookup key.
+                # x-amz-request-id is the trace lookup key.  The root is
+                # backdated to intake and the admission wait recorded as
+                # a child, so the waterfall's segments cover the whole
+                # client-observed duration.
+                tracer = self.garage.system.tracer
                 trace, rid = request_trace(
-                    self.garage.system.tracer, "S3", "s3", request)
+                    tracer, "S3", "s3", request, start_ns=t_intake_ns)
+                if t_admitted_ns > t_intake_ns:
+                    tracer.record_span(
+                        "admission", trace.trace_id, trace.span_id,
+                        t_intake_ns, t_admitted_ns)
                 with trace, maybe_time(
                         self._m and self._m["duration"], api="s3"):
                     resp = await self._handle_with_errors(request, rid)
                     trace.set_attr("status", resp.status)
+                    ep = request.get("s3_endpoint")
+                    if ep is not None:
+                        # the waterfall groups by this (PutObject,
+                        # GetObject, …), not by raw method
+                        trace.set_attr("endpoint", ep)
                     if not resp.prepared:
                         resp.headers["x-amz-request-id"] = rid
                     return resp
@@ -216,6 +234,9 @@ class S3ApiServer:
         endpoint = parse_endpoint(
             request.method, bucket_name, key_name, query, headers
         )
+        # the per-endpoint label the request root (and the waterfall
+        # recorder keyed on it) carries
+        request["s3_endpoint"] = endpoint.name
 
         # PostObject authenticates via the signed policy document inside
         # the form, not an Authorization header (ref post_object.rs:1-507)
@@ -237,11 +258,13 @@ class S3ApiServer:
                 return None
             return k
 
-        verified = await check_signature(
-            get_key, self.region, request.method, request.path, query, headers,
-            raw_path=request.rel_url.raw_path,
-            raw_query=raw_query_pairs(request.rel_url.raw_query_string),
-        )
+        with self.garage.system.tracer.span("signature verify"):
+            verified = await check_signature(
+                get_key, self.region, request.method, request.path, query,
+                headers,
+                raw_path=request.rel_url.raw_path,
+                raw_query=raw_query_pairs(request.rel_url.raw_query_string),
+            )
         api_key = verified.key
 
         ctx = RequestContext(

@@ -127,6 +127,10 @@ class BlockManager:
         # from disk goes through self.disk, so storage faults inject at
         # exactly one seam (testing/faults.py FaultyDisk wraps it)
         self.disk = DiskIo()
+        # per-root busy-seconds attribution (USE utilization): DiskIo
+        # accumulates I/O wall time keyed by the root this hook maps
+        # each path to
+        self.disk.root_of = self._root_of
         # per-hash local-read error backoff (a bad sector must not be
         # re-hit by every read of a hot block while peers can serve it);
         # reuses the resync ErrorCounter schedule
@@ -261,6 +265,14 @@ class BlockManager:
                 labeled_fn=lambda: [
                     ({"root": r}, float(self.health.free_bytes(r) or 0))
                     for r in self.health.roots()])
+            m.gauge(
+                "disk_busy_seconds",
+                "Cumulative wall seconds spent in block-store I/O per "
+                "data root (USE utilization; rate() = per-root busy "
+                "fraction).  root=\"\" aggregates unmapped paths",
+                labeled_fn=lambda: [
+                    ({"root": r}, float(s))
+                    for r, s in sorted(self._disk_busy().items())])
             self.m_quarantine = m.counter(
                 "block_quarantine_total",
                 "Block copies moved aside as .corrupted (read-path "
@@ -351,6 +363,18 @@ class BlockManager:
 
     def is_block_present(self, h: Hash) -> bool:
         return self.find_block(h) is not None
+
+    def _disk_busy(self) -> dict:
+        """Per-root cumulative I/O busy seconds — read through a fault
+        wrapper's inner DiskIo when one is installed (FaultyDisk
+        delegates the actual I/O, so the inner instance holds the
+        truth).  Snapshot-copied: worker threads insert concurrently."""
+        disk = self.disk
+        busy = getattr(disk, "busy_seconds", None)
+        if busy is None:
+            inner = getattr(disk, "inner", None)
+            busy = getattr(inner, "busy_seconds", None)
+        return dict(busy) if busy else {}
 
     def _root_of(self, path: str) -> str:
         """Which data root a block file lives under (longest prefix

@@ -205,6 +205,36 @@ def _build_parser() -> argparse.ArgumentParser:
         help="top-N slowest operations retained by the always-on "
              "slow-op log (no trace_sink needed)")
     pso.add_argument("-n", "--limit", type=int, default=20)
+
+    prq = sub.add_parser(
+        "request",
+        help="per-request critical-path attribution (waterfalls)")
+    rqs = prq.add_subparsers(dest="request_cmd", required=True)
+    rw = rqs.add_parser(
+        "waterfall",
+        help="retained slowest request span trees per endpoint; with "
+             "--trace/--endpoint, one request's cross-node waterfall "
+             "with its critical-path segment breakdown")
+    rw.add_argument("--trace", default=None,
+                    help="an x-amz-request-id (== trace id)")
+    rw.add_argument("--endpoint", default=None,
+                    help="e.g. PutObject: show its slowest retained "
+                         "request")
+    rw.add_argument("--json", action="store_true")
+    rqs.add_parser(
+        "exemplars",
+        help="current-window histogram exemplars: the trace id behind "
+             "each family's max observation")
+
+    ptl = sub.add_parser(
+        "timeline",
+        help="device/transport pipeline timeline as Chrome-trace JSON "
+             "(load into chrome://tracing or Perfetto)")
+    ptl.add_argument("-o", "--out", default=None,
+                     help="write the catapult JSON here (default: "
+                          "stdout)")
+    ptl.add_argument("-n", "--limit", type=int, default=None,
+                     help="most recent N events only")
     return p
 
 
@@ -642,13 +672,99 @@ async def _amain(args) -> None:
         return
 
     if args.command == "slow-ops":
-        rows = ["SECONDS\tOP\tATTRS"]
+        rows = ["SECONDS\tOP\tTRACE\tATTRS"]
         for o in await client.call({"cmd": "slow_ops",
                                     "limit": args.limit}):
             attrs = ", ".join(f"{k}={v}" for k, v in
                               (o.get("attrs") or {}).items())
-            rows.append(f"{o['seconds']:.3f}\t{o['name']}\t{attrs or '-'}")
+            # the trace id keys straight into `request waterfall --trace`
+            trace = o.get("trace")
+            rows.append(f"{o['seconds']:.3f}\t{o['name']}"
+                        f"\t{trace[:16] + '…' if trace else '-'}"
+                        f"\t{attrs or '-'}")
         print(format_table(rows))
+        return
+
+    if args.command == "request":
+        if args.request_cmd == "exemplars":
+            rows = ["FAMILY\tLABELS\tSECONDS\tTRACE"]
+            for e in await client.call({"cmd": "exemplars"}):
+                labels = ",".join(f"{k}={v}" for k, v in
+                                  (e.get("labels") or {}).items())
+                rows.append(f"{e['family']}\t{labels or '-'}"
+                            f"\t{e['value']:.4f}\t{e['trace_id']}")
+            print(format_table(rows))
+            return
+        msg = {"cmd": "request_waterfall"}
+        if args.trace:
+            msg["trace"] = args.trace
+        if args.endpoint:
+            msg["endpoint"] = args.endpoint
+        wf = await client.call(msg)
+        if args.json:
+            print(json.dumps(wf, indent=2))
+            return
+        if "tree" not in wf:
+            print("==== Sampled endpoints ====")
+            rows = ["ENDPOINT\tSAMPLED\tMEAN\tDOMINANT\tRETAINED"]
+            for e in wf["endpoints"]:
+                rows.append(f"{e['endpoint']}\t{e['sampled']}"
+                            f"\t{e['mean_ms']:.1f}ms\t{e['dominant']}"
+                            f"\t{e['retained']}")
+            print(format_table(rows))
+            print("\n==== Retained waterfalls (slowest first; "
+                  "`request waterfall --trace <id>` for the tree) ====")
+            rows = ["ENDPOINT\tSECONDS\tDOMINANT\tTRACE\tSEGMENTS"]
+            for e in wf["retained"]:
+                segs = " ".join(
+                    f"{k}={v * 1000:.1f}ms"
+                    for k, v in list(e["segments"].items())[:4])
+                rows.append(f"{e['endpoint']}\t{e['seconds']:.4f}"
+                            f"\t{e['dominant']}\t{e['trace_id']}\t{segs}")
+            print(format_table(rows))
+            return
+        total_ms = wf["seconds"] * 1000.0
+        print(f"==== {wf['endpoint']} — trace {wf['trace_id']} — "
+              f"{total_ms:.1f} ms across {wf['nodes_contributing']} "
+              f"node(s), {wf['span_count']} spans ====")
+        print("critical path: " + ", ".join(
+            f"{k} {v * 1000:.1f}ms ({v / wf['seconds'] * 100:.0f}%)"
+            for k, v in wf["segments"].items())
+            + f"  → dominant: {wf['dominant']}")
+        t0 = wf["tree"]["start_ns"]
+
+        def render(node, depth):
+            off = (node["start_ns"] - t0) / 1e6
+            dur = node["seconds"] * 1000.0
+            bar_w = 32
+            lo = (0 if total_ms <= 0
+                  else int(bar_w * off / total_ms))
+            hi = (bar_w if total_ms <= 0 else
+                  max(lo + 1, int(bar_w * (off + dur) / total_ms)))
+            bar = " " * lo + "█" * min(bar_w - lo, hi - lo)
+            print(f"  {off:8.1f}ms {dur:8.1f}ms |{bar:<{bar_w}}| "
+                  f"{'  ' * depth}{node['name']} [{node['segment']}]")
+            for c in node["children"]:
+                render(c, depth + 1)
+
+        render(wf["tree"], 0)
+        return
+
+    if args.command == "timeline":
+        msg = {"cmd": "device_timeline"}
+        if args.limit:
+            msg["limit"] = args.limit
+        chrome = await client.call(msg)
+        body = json.dumps(chrome)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(body)
+            n = sum(1 for e in chrome["traceEvents"]
+                    if e.get("ph") != "M")
+            print(f"wrote {n} events to {args.out} "
+                  f"(open in chrome://tracing or https://ui.perfetto.dev)")
+        else:
+            print(body)
         return
 
 

@@ -68,7 +68,8 @@ class FeederClosed(RuntimeError):
 
 class _Item:
     __slots__ = ("kind", "payload", "blocks", "nbytes", "future", "ts",
-                 "peers", "deadline", "cls", "want_parity")
+                 "peers", "deadline", "cls", "want_parity", "tctx",
+                 "span_id", "t_ns", "t_dispatch_ns")
 
     def __init__(self, kind, payload, blocks, nbytes, peers=None,
                  cls="fg", want_parity=True):
@@ -86,9 +87,25 @@ class _Item:
         # from the submitter's task-local budget (utils/tracing): an
         # expired submission is failed typed at dispatch instead of
         # spending codec time on a request whose client already gave up
-        from ..utils.tracing import current_deadline
+        from ..utils.tracing import current_deadline, current_trace_context
 
         self.deadline = current_deadline()
+        # the submitter's trace identity: the dispatcher/transport run on
+        # their own threads where the contextvars are gone, so the item
+        # carries what they need to attribute feeder wait and device
+        # compute back to the REQUEST's waterfall (utils/waterfall.py).
+        # span_id is pre-allocated so transport-side child spans can
+        # parent on the feeder span before it is recorded.
+        self.tctx = current_trace_context()
+        if self.tctx is not None:
+            import os
+
+            self.span_id = os.urandom(8).hex()
+            self.t_ns = time.time_ns()
+        else:
+            self.span_id = None
+            self.t_ns = 0
+        self.t_dispatch_ns = 0
         # how many concurrent submitters the CALLER can see (e.g. the
         # S3 layer's in-flight put count).  Three regimes: an explicit
         # peers <= 1 means PROVABLY alone — dispatch immediately, the
@@ -160,6 +177,14 @@ class CodecFeeder:
             self.m_submit = metrics.counter(
                 "codec_batch_submit_total",
                 "Feeder submissions by kind")
+            # USE saturation: pending blocks vs one dispatch's capacity
+            # (> 1 = the dispatcher cannot drain a full batch per window;
+            # docs/OBSERVABILITY.md "Critical path & saturation")
+            metrics.gauge(
+                "feeder_queue_saturation",
+                "Pending feeder blocks / max_batch_blocks (USE "
+                "saturation; > 1 means the dispatcher is the bottleneck)",
+                fn=lambda: self._pending_blocks / self.max_batch_blocks)
         else:
             self.m_depth = self.m_wait = self.m_size = None
             self.m_dispatch = self.m_submit = None
@@ -203,7 +228,32 @@ class CodecFeeder:
             self._cond.notify_all()
         if self.m_submit is not None:
             self.m_submit.inc(kind=item.kind)
+        if item.tctx is not None and self.obs.tracer is not None:
+            # the "Feeder <kind>" span covers submit→result on the
+            # request's trace, with queue_s marking the wait portion
+            # (the queue-wait/service-time split): recorded when the
+            # future resolves, whichever thread does it, so both the
+            # inline-CPU and transport routes attribute identically
+            item.future.add_done_callback(self._emit_item_span(item))
         return item.future
+
+    def _emit_item_span(self, item: _Item):
+        def emit(_fut) -> None:
+            tr = self.obs.tracer
+            if tr is None:
+                return
+            try:
+                attrs = {"kind": item.kind, "blocks": item.blocks}
+                if item.t_dispatch_ns:
+                    attrs["queue_s"] = round(
+                        (item.t_dispatch_ns - item.t_ns) / 1e9, 6)
+                tr.record_span(
+                    f"Feeder {item.kind}", item.tctx.trace_id,
+                    item.tctx.span_id, item.t_ns, time.time_ns(),
+                    span_id=item.span_id, **attrs)
+            except Exception:  # noqa: BLE001 — attribution must not fail work
+                logger.debug("feeder span emit failed", exc_info=True)
+        return emit
 
     def submit_hash(self, blocks: Sequence[bytes],
                     peers: Optional[int] = None, cls: str = "fg"):
@@ -394,12 +444,14 @@ class CodecFeeder:
     def _dispatch(self, batch: List[_Item], reason: str) -> None:
         now = time.perf_counter()
         mono = time.monotonic()
+        now_ns = time.time_ns()
         by_kind: dict = {}
         for it in batch:
             # claim the future first: a caller-cancelled submission is
             # excluded from the computation entirely
             if not it.future.set_running_or_notify_cancel():
                 continue
+            it.t_dispatch_ns = now_ns
             if it.deadline is not None and mono >= it.deadline:
                 # the submitter's request budget ran out while this sat
                 # in the feeder: shed it typed instead of burning codec
@@ -455,6 +507,10 @@ class CodecFeeder:
                 if tr is not None and tr.alive and tr.supports(kind):
                     try:
                         tr.submit_items(kind, items)
+                        self.obs.timeline.event(
+                            f"handoff {kind}", "feeder",
+                            time.monotonic_ns(), cat="feeder",
+                            blocks=nblocks, reason=reason)
                         continue
                     except Exception:  # noqa: BLE001 — degrade inline
                         logger.warning(
@@ -472,6 +528,8 @@ class CodecFeeder:
                     self._scrub_q.append((items, side))
                     self._scrub_cond.notify_all()
                 continue
+            t_disp_mono = time.monotonic_ns()
+            t_disp_ns = time.time_ns()
             try:
                 with self.obs.stage("feeder_dispatch", side):
                     if kind == "hash":
@@ -489,6 +547,22 @@ class CodecFeeder:
                     if not it.future.done():
                         it.future.set_exception(e)
                 continue
+            end_ns = time.time_ns()
+            self.obs.timeline.event(
+                f"dispatch {kind}", "feeder", t_disp_mono,
+                time.monotonic_ns(), cat="feeder", blocks=nblocks,
+                reason=reason, side=side)
+            tracer = self.obs.tracer
+            if tracer is not None:
+                # the inline compute is a CHILD of each item's feeder
+                # span: the waterfall then splits the feeder envelope
+                # into queue wait (queue_s) and codec compute
+                for it in items:
+                    if it.tctx is not None:
+                        tracer.record_span(
+                            f"Codec {kind}", it.tctx.trace_id,
+                            it.span_id, t_disp_ns, end_ns, side=side,
+                            blocks=nblocks)
             for it, res in zip(items, results):
                 if not it.future.done():
                     it.future.set_result(res)

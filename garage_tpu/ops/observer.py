@@ -79,7 +79,15 @@ class CodecObserver:
 
     def __init__(self, metrics=None, tracer=None,
                  ring_size: int = EVENT_RING_SIZE):
+        from ..utils.timeline import Timeline
+
         self.tracer = tracer
+        # device/transport timeline: begin/end of every pipeline stage
+        # (feeder dispatch, EDF pop, per-slot staging, submit, collect)
+        # in one bounded ring, exportable as Chrome-trace JSON (admin
+        # `device_timeline`, scripts/device_timeline.py) — the staging
+        # overlap is a picture, not an inference
+        self.timeline = Timeline()
         self.events: deque = deque(maxlen=ring_size)
         self._lock = threading.Lock()
         self._seq = 0

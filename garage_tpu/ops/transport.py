@@ -202,7 +202,8 @@ class _Batch:
     of a single kind, within the staging budget."""
 
     __slots__ = ("kind", "parts", "nbytes", "blocks", "eff_deadline",
-                 "cls", "want_parity", "ts", "staged_est")
+                 "cls", "want_parity", "ts", "staged_est",
+                 "t_enq", "t_pop", "t_stage0", "t_stage1", "t_submit1")
 
     def __init__(self, kind: str, cls: str):
         self.kind = kind
@@ -214,6 +215,13 @@ class _Batch:
         self.want_parity = False
         self.ts = 0.0
         self.staged_est = 0    # bucketed staging-buffer bytes (admission)
+        # monotonic_ns stage boundary stamps feeding the device timeline
+        # (obs.timeline) and the per-request transport/device spans
+        self.t_enq = 0
+        self.t_pop = 0
+        self.t_stage0 = 0
+        self.t_stage1 = 0
+        self.t_submit1 = 0
 
 
 class DeviceTransport:
@@ -289,6 +297,19 @@ class DeviceTransport:
         self.fallbacks = 0
         self.max_staged_bytes_seen = 0
         self._depth = {"fg": 0, "bg": 0}
+        # USE-method utilization accounting: device busy = wall time with
+        # ≥ 1 batch staged-or-computing (the U of the device); link busy
+        # = host-side stage/submit/collect work (the U of the host↔device
+        # path).  Saturation = staged bytes queued + in flight vs the
+        # budget.  Cumulative seconds; the *_ratio gauges window them at
+        # render time.
+        self.device_busy_seconds = 0.0
+        self.link_busy_seconds = 0.0
+        self._busy_since: Optional[float] = None
+        self._queued_est = 0  # staged_est bytes still in the EDF heap
+        # wall↔monotonic offset for converting timeline stamps into the
+        # wall-clock span records the waterfall stores
+        self._mono_off = time.time_ns() - time.monotonic_ns()
 
         if metrics is not None:
             self.m_staged = metrics.counter(
@@ -306,8 +327,62 @@ class DeviceTransport:
                 "Device batches staged or computing (double-buffer "
                 "occupancy)",
                 fn=lambda: float(len(self._inflight)))
+            # USE gauges (docs/OBSERVABILITY.md "Critical path &
+            # saturation"): cumulative busy seconds for Grafana rate()
+            # plus self-windowed busy fractions for at-a-glance reads
+            metrics.gauge(
+                "transport_device_busy_seconds",
+                "Cumulative wall seconds the device had >= 1 batch "
+                "staged or computing (USE utilization; rate() = busy "
+                "fraction)", fn=self.device_busy_now)
+            metrics.gauge(
+                "transport_link_busy_seconds",
+                "Cumulative host-side seconds spent staging, submitting "
+                "and collecting device batches (USE utilization of the "
+                "host<->device path)",
+                fn=lambda: self.link_busy_seconds)
+            dev_win = [time.monotonic(), 0.0]
+            link_win = [time.monotonic(), 0.0]
+            metrics.gauge(
+                "transport_device_busy_ratio",
+                "Device busy fraction over the last scrape window "
+                "(0 idle, 1 saturated)",
+                fn=lambda: self._window_ratio(self.device_busy_now,
+                                              dev_win))
+            metrics.gauge(
+                "transport_link_busy_ratio",
+                "Host<->device link busy fraction over the last scrape "
+                "window",
+                fn=lambda: self._window_ratio(
+                    lambda: self.link_busy_seconds, link_win))
+            metrics.gauge(
+                "transport_queue_saturation",
+                "Staged bytes queued + in flight vs the staging budget "
+                "(USE saturation; > 1 means work is waiting on the "
+                "double buffer)",
+                fn=lambda: ((self._queued_est + self._inflight_bytes)
+                            / self.budget_bytes))
         else:
             self.m_staged = self.m_depth = self.m_inflight = None
+
+    def device_busy_now(self) -> float:
+        """Cumulative device-busy seconds including the open interval."""
+        busy, since = self.device_busy_seconds, self._busy_since
+        if since is not None:
+            busy += max(0.0, time.monotonic() - since)
+        return busy
+
+    @staticmethod
+    def _window_ratio(getter, state: list) -> float:
+        """Busy fraction since the previous render of the same gauge."""
+        now = time.monotonic()
+        busy = getter()
+        t0, b0 = state
+        state[0], state[1] = now, busy
+        dt = now - t0
+        if dt <= 0:
+            return 0.0
+        return min(max((busy - b0) / dt, 0.0), 1.0)
 
     # --- capability probing -------------------------------------------------
 
@@ -339,11 +414,13 @@ class DeviceTransport:
             raise TransportClosed(f"device lacks {self.REQUIRED[kind]}")
         batches = self._plan(kind, items, want_parity)
         now = self.clock()
+        t_ns = time.monotonic_ns()
         with self._cond:
             if self._closed:
                 raise TransportClosed("device transport is shut down")
             for b in batches:
                 b.ts = time.perf_counter()
+                b.t_enq = t_ns
                 b.eff_deadline = self._effective_deadline(b, now)
                 self._seq += 1
                 heapq.heappush(
@@ -351,11 +428,19 @@ class DeviceTransport:
                     (b.eff_deadline, 0 if b.cls == "fg" else 1,
                      self._seq, b))
                 self._depth[b.cls] = self._depth.get(b.cls, 0) + 1
+                self._queued_est += b.staged_est
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._run, name="codec-transport", daemon=True)
                 self._thread.start()
             self._cond.notify_all()
+        tl = self.obs.timeline
+        tl.event(f"enqueue {kind}", "edf", t_ns, cat="transport",
+                 cls=batches[0].cls if batches else "fg",
+                 batches=len(batches),
+                 nbytes=sum(b.nbytes for b in batches))
+        tl.counter("transport_queue", t_ns,
+                   fg=self._depth.get("fg", 0), bg=self._depth.get("bg", 0))
 
     def _effective_deadline(self, batch: _Batch, now: float) -> float:
         """EDF key.  Foreground: arrival time — a request's expiry
@@ -519,7 +604,9 @@ class DeviceTransport:
                     if self._heap and self._admit_locked(self._heap[0][3]):
                         batch = heapq.heappop(self._heap)[3]
                         self._depth[batch.cls] -= 1
+                        self._queued_est -= batch.staged_est
                         slot = self._slot_free.pop()
+                        batch.t_pop = time.monotonic_ns()
                         self.obs.observe_stage(
                             "transport_wait", "tpu",
                             time.perf_counter() - batch.ts)
@@ -530,6 +617,11 @@ class DeviceTransport:
                         return
                     self._cond.wait()
             if batch is not None:
+                self.obs.timeline.event(
+                    f"edf_pop {batch.kind}", "edf", batch.t_pop,
+                    cat="transport", cls=batch.cls,
+                    wait_ms=round((batch.t_pop - batch.t_enq) / 1e6, 3),
+                    deadline=round(batch.eff_deadline, 6))
                 if self._device_down:
                     # the down latch means every device submit is doomed:
                     # queued batches skip straight to the CPU fallback
@@ -550,17 +642,33 @@ class DeviceTransport:
 
     def _stage_and_submit(self, batch: _Batch, slot: int) -> None:
         try:
+            batch.t_stage0 = time.monotonic_ns()
             with self.obs.stage("host_staging", "tpu"):
                 staged = self._stage(batch, slot)
+            batch.t_stage1 = time.monotonic_ns()
             with self.obs.stage("device_submit", "tpu"):
                 handle = self._submit(batch, staged)
+            batch.t_submit1 = time.monotonic_ns()
+            self.link_busy_seconds += (batch.t_submit1
+                                       - batch.t_stage0) / 1e9
+            tl = self.obs.timeline
+            track = f"slot{slot}"
+            tl.event(f"stage {batch.kind}", track, batch.t_stage0,
+                     batch.t_stage1, cat="transport", cls=batch.cls,
+                     blocks=batch.blocks, staged_est=batch.staged_est)
+            tl.event(f"submit {batch.kind}", track, batch.t_stage1,
+                     batch.t_submit1, cat="transport")
             variant = getattr(self.device, "last_submit_variant", None)
             with self._cond:
+                if not self._inflight and self._busy_since is None:
+                    self._busy_since = time.monotonic()
                 self._inflight.append((batch, handle, variant, slot))
                 self._inflight_bytes += batch.staged_est
                 if self._inflight_bytes > self.max_staged_bytes_seen:
                     self.max_staged_bytes_seen = self._inflight_bytes
                 self._cond.notify_all()
+            tl.counter("transport_inflight", batch.t_submit1,
+                       batches=len(self._inflight))
             self.dispatches += 1
             if self.m_staged is not None:
                 self.m_staged.inc(batch.nbytes, copies="1")
@@ -576,6 +684,7 @@ class DeviceTransport:
             if not self._inflight:
                 return
             batch, handle, variant, slot = self._inflight[0]
+        t_c0 = time.monotonic_ns()
         try:
             with self.obs.stage("sync_collect", "tpu"):
                 results = self._collect(batch, handle)
@@ -591,6 +700,8 @@ class DeviceTransport:
             self._device_failed("collect", e)
             self._absorb_on_cpu(batch, e)
             return
+        t_c1 = time.monotonic_ns()
+        self.link_busy_seconds += (t_c1 - t_c0) / 1e9
         self._release(batch, slot)
         self._device_fails = 0
         note = getattr(self.device, "note_sync_success", None)
@@ -601,15 +712,59 @@ class DeviceTransport:
                 logger.warning("note_sync_success hook failed",
                                exc_info=True)
         self.obs.add_bytes("tpu", batch.nbytes)
+        tl = self.obs.timeline
+        track = f"slot{slot}"
+        if batch.t_submit1 and t_c0 > batch.t_submit1:
+            tl.event(f"compute {batch.kind}", track, batch.t_submit1,
+                     t_c0, cat="transport")
+        tl.event(f"collect {batch.kind}", track, t_c0, t_c1,
+                 cat="transport", blocks=batch.blocks)
+        self._emit_request_spans(batch, t_c1)
         for part, res in zip(batch.parts, results):
             part.sink.deliver(part.index, res)
+
+    def _emit_request_spans(self, batch: _Batch, t_end_mono: int) -> None:
+        """Attribute this batch's queue wait and device round to each
+        contributing REQUEST's trace (the feeder item carries its
+        submitter's TraceContext + a pre-allocated parent span id) — the
+        waterfall's `transport` and `device` segments come from here."""
+        tracer = self.obs.tracer
+        if tracer is None:
+            return
+        off = self._mono_off
+        seen = set()
+        for part in batch.parts:
+            it = part.item
+            tctx = getattr(it, "tctx", None)
+            if tctx is None or id(it) in seen:
+                continue
+            seen.add(id(it))
+            parent = getattr(it, "span_id", None) or tctx.span_id
+            try:
+                tracer.record_span(
+                    f"Transport wait {batch.kind}", tctx.trace_id,
+                    parent, batch.t_enq + off, batch.t_pop + off,
+                    cls=batch.cls)
+                tracer.record_span(
+                    f"Device {batch.kind}", tctx.trace_id, parent,
+                    batch.t_stage0 + off, t_end_mono + off,
+                    blocks=batch.blocks)
+            except Exception:  # noqa: BLE001 — attribution must not fail work
+                logger.debug("transport span emit failed", exc_info=True)
 
     def _release(self, batch: _Batch, slot: int) -> None:
         with self._cond:
             self._inflight.pop(0)
             self._inflight_bytes -= batch.staged_est
             self._slot_free.append(slot)
+            if not self._inflight and self._busy_since is not None:
+                self.device_busy_seconds += max(
+                    0.0, time.monotonic() - self._busy_since)
+                self._busy_since = None
             self._cond.notify_all()
+        self.obs.timeline.counter(
+            "transport_inflight", time.monotonic_ns(),
+            batches=len(self._inflight))
 
     def _device_failed(self, where: str, e: BaseException) -> None:
         self._device_fails += 1
@@ -940,6 +1095,11 @@ class DeviceTransport:
                 "staging_slots": self.slots,
                 "chunk_bytes": self.chunk_bytes,
                 "budget_bytes": self.budget_bytes,
+                "device_busy_seconds": round(self.device_busy_now(), 6),
+                "link_busy_seconds": round(self.link_busy_seconds, 6),
+                "queue_saturation": round(
+                    (self._queued_est + self._inflight_bytes)
+                    / self.budget_bytes, 6),
             }
 
     def shutdown(self, timeout: float = 15.0) -> None:

@@ -471,8 +471,9 @@ class RpcHelper:
             )
 
         # quorum-call span with the reference's attributes
-        # (rpc/rpc_helper.rs:238-260: to, quorum, strategy); attrs are only
-        # built when tracing is on
+        # (rpc/rpc_helper.rs:238-260: to, quorum, strategy) — created
+        # whether or not an exporter is configured: the request
+        # waterfall's `rpc` segment comes from exactly this span
         tr = self.tracer
         span = tr.span(
             f"RPC {endpoint.path}",
@@ -480,7 +481,7 @@ class RpcHelper:
             quorum=quorum,
             strategy=("interrupt_after_quorum"
                       if strategy.rs_interrupt_after_quorum else "all_sent"),
-        ) if tr is not None and tr.enabled else nullcontext()
+        ) if tr is not None else nullcontext()
         with span:
             if strategy.rs_interrupt_after_quorum:
                 return await self._quorum_read(

@@ -159,6 +159,14 @@ class System:
         # the transport parents incoming-request handler spans on the
         # caller's propagated context (cross-node traces)
         self.netapp.tracer = self.tracer
+        # request-waterfall recorder (utils/waterfall.py): every
+        # finished span lands in its bounded ring; request roots are
+        # sampled into per-endpoint critical-path breakdowns + retained
+        # slowest trees (admin `request waterfall`), and
+        # request_critical_path_seconds{endpoint,segment} derives here
+        from ..utils.waterfall import WaterfallRecorder
+
+        self.tracer.waterfall = WaterfallRecorder(metrics=self.metrics)
         # tracer self-observability: exporter health + the always-on
         # slow-op log's high-water mark are scrapeable, so "is tracing
         # even working" never needs a collector to answer

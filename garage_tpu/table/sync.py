@@ -64,9 +64,24 @@ class TableSyncer:
                 "recv": m.counter(
                     "table_sync_items_received",
                     "Items received from other nodes during anti-entropy"),
+                # Merkle sync convergence signal for the metadata arc: a
+                # cold-joining node's catch-up is `synced` rounds turning
+                # into `in_sync`; persistent `error` rounds mean a
+                # partition that cannot converge
+                "rounds": m.counter(
+                    "table_merkle_sync_rounds_total",
+                    "Per-peer-partition anti-entropy rounds by outcome "
+                    "(in_sync = roots matched, synced = diffs pushed, "
+                    "offload = partition handed to its new replicas, "
+                    "error = round failed)"),
             }
         else:
             self._m = None
+
+    def _round(self, result: str) -> None:
+        if self._m is not None:
+            self._m["rounds"].inc(
+                result=result, table_name=self.data.schema.TABLE_NAME)
 
     def _count(self, which: str, n: int) -> None:
         if self._m is not None and n:
@@ -97,6 +112,18 @@ class TableSyncer:
     # --- push sync (ref sync.rs:286-415) ---
 
     async def _do_sync_with(self, partition: int, who: FixedBytes32) -> None:
+        try:
+            await self._do_sync_with_inner(partition, who)
+        except Exception:
+            # Exception, NOT BaseException: a CancelledError from
+            # worker shutdown is routine, and counting it as an `error`
+            # round would grow the "partition cannot converge" signal on
+            # every restart across the fleet
+            self._round("error")
+            raise
+
+    async def _do_sync_with_inner(self, partition: int,
+                                  who: FixedBytes32) -> None:
         root_nk = node_key(partition, b"")
         local_root = self.merkle.read_node(None, root_nk)
         local_hash = node_hash(local_root)
@@ -107,6 +134,7 @@ class TableSyncer:
         )
         remote_hash = bytes(resp["ck"])
         if bytes(local_hash) == remote_hash:
+            self._round("in_sync")
             return
         todo: List[bytes] = [root_nk]
         to_send: List[bytes] = []
@@ -138,6 +166,7 @@ class TableSyncer:
                 to_send = []
         if to_send:
             await self._send_items(who, to_send)
+        self._round("synced")
 
     async def _send_items(self, who: FixedBytes32, keys: List[bytes]) -> None:
         values = []
@@ -187,6 +216,7 @@ class TableSyncer:
                 "%s: offloaded %d items of partition %d",
                 self.data.schema.TABLE_NAME, len(batch), partition,
             )
+        self._round("offload")
 
     # --- server side (ref sync.rs SyncRpc) ---
 

@@ -23,6 +23,27 @@ DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
 )
 
+# histogram exemplars: the max observation per label set is remembered
+# for one window, with the trace id that produced it — the link from "a
+# p99 bucket grew" to the exact request waterfall to pull
+EXEMPLAR_WINDOW_S = 60.0
+
+_trace_id_fn = None
+
+
+def _current_trace_id():
+    """Lazy bridge to utils.tracing.current_trace_id (metrics must not
+    import the tracing module at import time — the registry is used by
+    bare-library code that never touches spans)."""
+    global _trace_id_fn
+    if _trace_id_fn is None:
+        try:
+            from .tracing import current_trace_id as fn
+        except Exception:  # pragma: no cover — broken install
+            fn = lambda: None  # noqa: E731
+        _trace_id_fn = fn
+    return _trace_id_fn()
+
 
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
@@ -123,17 +144,31 @@ class Gauge:
 
 
 class Histogram:
-    """Cumulative-bucket histogram (Prometheus semantics)."""
+    """Cumulative-bucket histogram (Prometheus semantics).
+
+    With ``exemplars=True`` each label set remembers the trace id of its
+    max-value observation per EXEMPLAR_WINDOW_S window (the trace id is
+    read from the task-local tracing context unless the caller passes
+    ``trace_exemplar=``).  The plain text render stays untouched
+    (promlint-clean); ``render(openmetrics=True)`` appends the
+    OpenMetrics ``# {trace_id="…"} value ts`` exemplar on the holding
+    bucket line, and ``exemplar_snapshot()`` serves them to the admin
+    CLI."""
 
     def __init__(self, name: str, help: str = "",
-                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 exemplars: bool = False):
         self.name = name
         self.help = help
         self.buckets = tuple(buckets)
+        self.exemplars = bool(exemplars)
         # labels -> [bucket counts..., +inf count, sum, count]
         self._vals: Dict[Tuple[Tuple[str, str], ...], list] = {}
+        # labels -> [window_start, value, bucket_index, trace_id, ts]
+        self._exemplars: Dict[Tuple[Tuple[str, str], ...], list] = {}
 
-    def observe(self, v: float, **labels) -> None:
+    def observe(self, v: float, trace_exemplar: Optional[str] = None,
+                **labels) -> None:
         key = tuple(sorted(labels.items()))
         slot = self._vals.get(key)
         if slot is None:
@@ -143,6 +178,26 @@ class Histogram:
         slot[i] += 1
         slot[-2] += v
         slot[-1] += 1
+        if self.exemplars:
+            tid = (trace_exemplar if trace_exemplar is not None
+                   else _current_trace_id())
+            if tid:
+                now = time.time()
+                ex = self._exemplars.get(key)
+                if (ex is None or now - ex[0] > EXEMPLAR_WINDOW_S
+                        or v >= ex[1]):
+                    start = (now if ex is None
+                             or now - ex[0] > EXEMPLAR_WINDOW_S else ex[0])
+                    self._exemplars[key] = [start, v, i, tid, now]
+
+    def exemplar_snapshot(self) -> list:
+        """[{labels, value, trace_id, ts}] — current-window max-bucket
+        exemplars for every label set."""
+        out = []
+        for key, (_w, v, _i, tid, ts) in sorted(self._exemplars.items()):
+            out.append({"labels": dict(key), "value": round(v, 6),
+                        "trace_id": tid, "ts": round(ts, 3)})
+        return out
 
     def time(self, **labels):
         """Context manager recording elapsed seconds (the reference's
@@ -173,18 +228,27 @@ class Histogram:
             lo = edge
         return self.buckets[-1]
 
-    def render(self) -> List[str]:
+    def render(self, openmetrics: bool = False) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         for key, slot in sorted(self._vals.items()):
+            ex = self._exemplars.get(key) if openmetrics else None
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += slot[i]
                 lab = key + (("le", _num(b)),)
-                out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+                line = f"{self.name}_bucket{_fmt_labels(lab)} {cum}"
+                if ex is not None and ex[2] == i:
+                    line += (f' # {{trace_id="{ex[3]}"}} {_num(ex[1])} '
+                             f"{ex[4]:.3f}")
+                out.append(line)
             cum += slot[len(self.buckets)]
             lab = key + (("le", "+Inf"),)
-            out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+            line = f"{self.name}_bucket{_fmt_labels(lab)} {cum}"
+            if ex is not None and ex[2] == len(self.buckets):
+                line += (f' # {{trace_id="{ex[3]}"}} {_num(ex[1])} '
+                         f"{ex[4]:.3f}")
+            out.append(line)
             out.append(f"{self.name}_sum{_fmt_labels(key)} {_num(slot[-2])}")
             out.append(f"{self.name}_count{_fmt_labels(key)} {slot[-1]}")
         return out
@@ -287,11 +351,20 @@ class MetricsRegistry:
         return self._get_or_create(Gauge, name, help, fn, labeled_fn)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
-        return self._get_or_create(Histogram, name, help, buckets)
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  exemplars: bool = False) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets, exemplars)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition; ``openmetrics=True`` additionally
+        appends histogram exemplars (`# {trace_id=…} v ts` bucket-line
+        suffixes) — serve that flavor only to scrapers that negotiated
+        the OpenMetrics content type (the plain format's parsers reject
+        the suffix)."""
         lines: List[str] = []
         for m in self._metrics:
-            lines.extend(m.render())
+            if openmetrics and isinstance(m, Histogram):
+                lines.extend(m.render(openmetrics=True))
+            else:
+                lines.extend(m.render())
         return "\n".join(lines) + "\n"

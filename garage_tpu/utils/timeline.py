@@ -1,0 +1,139 @@
+"""Timeline — a bounded ring of timestamped events, exportable as
+Chrome-trace (catapult) JSON.
+
+The device/transport pipeline's overlap claims (double-buffered staging,
+EDF foreground-first dispatch) were only ever *inferred* from counters;
+this ring records the actual begin/end of every stage — enqueue, EDF
+pop, per-slot staging, device submit, collect — so `chrome://tracing`
+(or Perfetto) renders the pipeline as it ran and "did slot 1 stage while
+slot 0 computed" is a picture, not an argument.
+
+Always on, bounded (`maxlen` events, each a small dict), one lock.
+Producers are the codec feeder and the device transport via the shared
+CodecObserver (`obs.timeline`); consumers are the admin
+`device_timeline` command, the HTTP `/v1/timeline` endpoint and
+`scripts/device_timeline.py`.
+
+Chrome-trace mapping: duration events are phase "X" (ts + dur, µs),
+instants are "i", counters are "C".  Tracks ("tid") are stable small
+integers assigned per track name, with thread_name metadata events so
+the UI shows "slot0", "edf", "feeder" instead of numbers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+DEFAULT_SIZE = 8192
+
+
+class Timeline:
+    def __init__(self, size: int = DEFAULT_SIZE):
+        self._ring: deque = deque(maxlen=size)
+        self._lock = threading.Lock()
+        self._tracks: Dict[str, int] = {}
+        self.dropped = 0  # events evicted by the ring bound
+
+    def _track(self, name: str) -> int:
+        tid = self._tracks.get(name)
+        if tid is None:
+            tid = self._tracks[name] = len(self._tracks) + 1
+        return tid
+
+    def event(self, name: str, track: str, start_ns: int,
+              end_ns: Optional[int] = None, cat: str = "transport",
+              **args) -> None:
+        """Duration event [start_ns, end_ns] (monotonic_ns), or an
+        instant when end_ns is None."""
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": "X" if end_ns is not None else "i",
+            "ts": start_ns // 1000,  # chrome wants µs
+            "pid": 1,
+        }
+        if end_ns is not None:
+            ev["dur"] = max(0, (end_ns - start_ns) // 1000)
+        else:
+            ev["s"] = "t"  # instant scope: thread
+        if args:
+            ev["args"] = args
+        with self._lock:
+            ev["tid"] = self._track(track)
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(ev)
+
+    def counter(self, name: str, start_ns: int, **values) -> None:
+        """Counter sample (stacked area in the trace viewer) — queue
+        depths, slot occupancy."""
+        ev = {"name": name, "cat": "counter", "ph": "C",
+              "ts": start_ns // 1000, "pid": 1,
+              "args": {k: float(v) for k, v in values.items()}}
+        with self._lock:
+            ev["tid"] = self._track("counters")
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(ev)
+
+    def snapshot(self, limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._ring)
+        if limit is not None and limit > 0:
+            out = out[-limit:]
+        return out
+
+    def chrome_trace(self, limit: Optional[int] = None) -> dict:
+        """The catapult JSON object: sorted traceEvents plus
+        process/thread metadata so tracks render with their names."""
+        events = sorted(self.snapshot(limit), key=lambda e: e["ts"])
+        with self._lock:
+            tracks = dict(self._tracks)
+        meta: List[dict] = [{
+            "name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+            "args": {"name": "garage_tpu device pipeline"},
+        }]
+        for name, tid in sorted(tracks.items(), key=lambda kv: kv[1]):
+            meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                         "tid": tid, "args": {"name": name}})
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "source": "garage_tpu",
+                "captured_at": round(time.time(), 3),
+                "dropped_events": self.dropped,
+            },
+        }
+
+
+def overlapping_slot_windows(chrome: dict) -> int:
+    """Count pairs of phase-X events on DISTINCT slot tracks whose time
+    windows overlap — the smoke/test assertion that the double buffer
+    actually overlapped staging with compute (≥ 1 pair means two slots
+    were concurrently occupied)."""
+    slots: Dict[int, list] = {}
+    names = {}
+    for ev in chrome.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            names[ev["tid"]] = ev["args"]["name"]
+    for ev in chrome.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        track = names.get(ev.get("tid"), "")
+        if not str(track).startswith("slot"):
+            continue
+        slots.setdefault(ev["tid"], []).append(
+            (ev["ts"], ev["ts"] + ev.get("dur", 0)))
+    pairs = 0
+    tids = sorted(slots)
+    for i, a in enumerate(tids):
+        for b in tids[i + 1:]:
+            for s0, e0 in slots[a]:
+                if any(s0 < e1 and s1 < e0 for s1, e1 in slots[b]):
+                    pairs += 1
+                    break
+    return pairs

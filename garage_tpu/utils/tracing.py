@@ -16,12 +16,13 @@ task.  A dead or slow collector drops batches after a short timeout —
 tracing must never hold up the data path.
 
 When no trace_sink is configured the tracer is disabled for EXPORT but
-not for the slow-op log: `span()` then returns a lightweight timing-only
-span (no ids, no buffering, no contextvar) that feeds the always-on
-top-N slow-op log — so "what were the slowest block ops this node ever
-ran" is answerable on a node that never configured a collector
-(round-5: the heal non-repro and the sub-floor headline were invisible
-precisely because nothing retained timings without a trace_sink).
+spans are still REAL (ids + contextvar parenting): they feed the
+always-on top-N slow-op log AND the request-waterfall recorder
+(utils/waterfall.py) — so "what were the slowest block ops this node
+ever ran" and "where did that slow PUT's time go" are both answerable
+on a node that never configured a collector.  Only the export buffer is
+skipped without a sink; the per-span cost is one 8-byte id + a record
+dict, paid so the critical-path attribution layer is never dark.
 """
 
 from __future__ import annotations
@@ -61,7 +62,8 @@ class SlowOpLog:
         self._lock = threading.Lock()
         self._seq = 0
 
-    def note(self, name: str, dur_s: float, attrs: Dict[str, Any]) -> None:
+    def note(self, name: str, dur_s: float, attrs: Dict[str, Any],
+             trace_id: Optional[str] = None) -> None:
         if dur_s < SLOW_LOG_MIN_S:
             return
         heap = self._heap
@@ -74,6 +76,10 @@ class SlowOpLog:
             "attrs": {k: v for k, v in attrs.items()
                       if isinstance(v, (str, int, float, bool))},
         }
+        if trace_id:
+            # the histogram-exemplar / waterfall link: a slow-op row's
+            # trace id keys straight into `request waterfall --trace`
+            rec["trace"] = trace_id
         with self._lock:
             self._seq += 1
             if len(heap) < self._size:
@@ -240,6 +246,17 @@ class TraceContext:
             return None
 
 
+def current_trace_id() -> Optional[str]:
+    """The current task's trace id (local span first, then the remote
+    context) — the histogram-exemplar hook (utils/metrics.py) reads it
+    so a p99 bucket can name the exact request that landed there."""
+    span = _current_span.get()
+    if span is not None:
+        return span.trace_id
+    ctx = _remote_ctx.get()
+    return ctx.trace_id if ctx is not None else None
+
+
 def current_trace_context() -> Optional[TraceContext]:
     """The context to INJECT into an outgoing RPC: the current local
     span's identity, or (when this node created no span of its own, e.g.
@@ -274,20 +291,47 @@ class Span:
                  "end_ns", "attrs", "error", "_tracer", "_token")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
-                 parent_id: Optional[str], attrs: Dict[str, Any]):
+                 parent_id: Optional[str], attrs: Dict[str, Any],
+                 start_ns: Optional[int] = None):
         self._tracer = tracer
         self.name = name
         self.trace_id = trace_id
         self.span_id = os.urandom(8).hex()
         self.parent_id = parent_id
         self.attrs = attrs
-        self.start_ns = time.time_ns()
+        # start_ns override: the API front door backdates the request
+        # root to intake time so admission — which runs BEFORE the trace
+        # is minted — still lands inside the root's interval and the
+        # waterfall's segments sum to the duration the client saw
+        self.start_ns = start_ns if start_ns is not None else time.time_ns()
         self.end_ns = 0
         self.error: Optional[str] = None
         self._token = None
 
     def set_attr(self, key: str, value: Any) -> None:
         self.attrs[key] = value
+
+    def mark_service_start(self) -> None:
+        """Queue-wait/service-time split: everything from the span's
+        start to THIS call was queue wait — the waterfall sweep
+        attributes that prefix to the `queue` segment, the rest to the
+        span's own segment."""
+        self.attrs["queue_s"] = round(
+            (time.time_ns() - self.start_ns) / 1e9, 6)
+
+    def to_record(self) -> Dict[str, Any]:
+        """The plain-dict shape the waterfall recorder stores and the
+        admin `trace_spans` command ships across nodes."""
+        return {
+            "trace": self.trace_id,
+            "span": self.span_id,
+            "parent": self.parent_id,
+            "name": self.name,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "attrs": {k: v for k, v in self.attrs.items()
+                      if isinstance(v, (str, int, float, bool))},
+        }
 
     def __enter__(self) -> "Span":
         self._token = _current_span.set(self)
@@ -300,33 +344,9 @@ class Span:
         _current_span.reset(self._token)
         self._tracer._record(self)
         self._tracer.slow.note(
-            self.name, (self.end_ns - self.start_ns) / 1e9, self.attrs
+            self.name, (self.end_ns - self.start_ns) / 1e9, self.attrs,
+            trace_id=self.trace_id,
         )
-        return False
-
-
-class _LiteSpan:
-    """Timing-only span for tracers without an exporter: no ids, no
-    buffering, no contextvar — just perf_counter in/out feeding the
-    always-on slow-op log.  Cost per op: one object + two clock reads."""
-
-    __slots__ = ("_log", "name", "attrs", "_t0")
-
-    def __init__(self, log: SlowOpLog, name: str, attrs: Dict[str, Any]):
-        self._log = log
-        self.name = name
-        self.attrs = attrs
-
-    def set_attr(self, key, value):
-        self.attrs[key] = value
-
-    def __enter__(self):
-        self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        self._log.note(self.name, time.perf_counter() - self._t0,
-                       self.attrs)
         return False
 
 
@@ -344,6 +364,11 @@ class Tracer:
         # always-on top-N slow-op retention — populated by every span
         # exit whether or not a collector is configured
         self.slow = SlowOpLog()
+        # request-waterfall recorder (utils/waterfall.py), attached by
+        # System next to the metrics registry; every finished span's
+        # record lands there so per-request critical-path attribution
+        # works with no collector configured
+        self.waterfall = None
         self._task: Optional[asyncio.Task] = None
 
     # --- span creation ---
@@ -351,10 +376,9 @@ class Tracer:
     def span(self, name: str, /, **attrs):
         """Child span of the context's current span; when there is none,
         of the REMOTE context extracted from an incoming RPC frame; a new
-        trace root otherwise.  Without an exporter, a timing-only lite
-        span still feeds the slow-op log."""
-        if not self.enabled:
-            return _LiteSpan(self.slow, name, attrs)
+        trace root otherwise.  Always a real span (ids + contextvar):
+        without an exporter it skips only the export buffer, still
+        feeding the slow-op log and the waterfall recorder."""
         parent = _current_span.get()
         if parent is not None:
             return Span(self, name, parent.trace_id, parent.span_id, attrs)
@@ -368,27 +392,53 @@ class Tracer:
         """Span parented on an EXPLICIT cross-node context (the netapp
         server side wraps request handlers in one).  Falls back to a
         plain span when the caller sent no context."""
-        if not self.enabled:
-            return _LiteSpan(self.slow, name, attrs)
         if ctx is None:
             return self.span(name, **attrs)
         return Span(self, name, ctx.trace_id, ctx.span_id, attrs)
 
     def new_trace(self, name: str, /, trace_id: Optional[str] = None,
-                  **attrs):
+                  start_ns: Optional[int] = None, **attrs):
         """Root span with a fresh trace id — one per API request (ref
         generic_server.rs:187-200 gen_trace_id).  `trace_id` lets the
         API layer supply the id it returns to the client
         (x-amz-request-id == trace id, so a support ticket quoting the
-        request id IS the trace lookup key)."""
-        if not self.enabled:
-            return _LiteSpan(self.slow, name, attrs)
-        return Span(self, name, trace_id or os.urandom(16).hex(), None, attrs)
+        request id IS the trace lookup key); `start_ns` backdates the
+        root to request intake so pre-trace work (admission) lands
+        inside it."""
+        return Span(self, name, trace_id or os.urandom(16).hex(), None,
+                    attrs, start_ns=start_ns)
+
+    def record_span(self, name: str, trace_id: str,
+                    parent_id: Optional[str], start_ns: int, end_ns: int,
+                    span_id: Optional[str] = None, **attrs) -> str:
+        """Record an ALREADY-FINISHED span with explicit timestamps —
+        the cross-thread path: the codec feeder and device transport
+        resolve a request's work on their own worker threads, where the
+        submitter's contextvars are gone, so they carry the submitter's
+        TraceContext on the work item and attribute the wait/compute
+        back to its trace here.  `span_id` lets the caller pre-allocate
+        the id so children recorded elsewhere can parent on it.
+        Returns the span id."""
+        s = Span(self, name, trace_id, parent_id, attrs, start_ns=start_ns)
+        if span_id is not None:
+            s.span_id = span_id
+        s.end_ns = end_ns
+        self._record(s)
+        self.slow.note(name, (end_ns - start_ns) / 1e9, attrs,
+                       trace_id=trace_id)
+        return s.span_id
 
     def _record(self, span: Span) -> None:
-        if len(self._buf) == self._buf.maxlen:
-            self.dropped += 1
-        self._buf.append(span)
+        if self.enabled:
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append(span)
+        wf = self.waterfall
+        if wf is not None:
+            try:
+                wf.note(span.to_record())
+            except Exception:  # noqa: BLE001 — attribution must never
+                logger.debug("waterfall note failed", exc_info=True)
 
     # --- export loop ---
 

@@ -1,0 +1,420 @@
+"""Request waterfalls — critical-path attribution for client requests.
+
+Through PR 12 the system could say *that* a request was slow
+(`api_request_duration_seconds`, the slow-op log) but never *where the
+time went*: queue wait vs signature vs table quorum vs block RPC vs
+feeder wait vs device compute.  This module is the attribution layer on
+top of the PR 1–2 span plumbing:
+
+  - a **segment taxonomy** mapping every span name the system emits to
+    one of a small, stable set of segments (admission / queue /
+    signature / table / rpc / feeder / codec / transport / device /
+    disk / api / other);
+  - **critical-path segment math**: a timeline sweep over one request's
+    span tree that attributes every instant of the root span's duration
+    to the DEEPEST span covering it (ties to the latest-started), so
+    parallel fan-outs (a quorum write's concurrent RPCs) are never
+    double-counted and the per-segment seconds sum to the request
+    duration EXACTLY;
+  - a bounded, always-on **WaterfallRecorder**: every finished span
+    lands in a recent-span ring (the cross-node fetch window for the
+    admin `request waterfall` merge); when a request ROOT span
+    finishes, the request is sampled (top-N-slowest candidates always,
+    plus every `sample_every`-th request), its breakdown computed, the
+    dominant segment observed into
+    `request_critical_path_seconds{endpoint,segment}` (with the trace
+    id as the exemplar), and the slowest trees per endpoint retained as
+    p99 exemplars — the trace id printed next to a histogram bucket is
+    directly linkable to a retained waterfall.
+
+Everything is bounded: the ring, the per-endpoint heap, the endpoint
+map, the spans stored per retained tree.  The recorder never touches
+the network — cross-node merging is the admin layer's job
+(`admin/handler.py _cmd_request_waterfall` fans out `trace_spans`).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+# The segment taxonomy.  Every span name the system emits maps into one
+# of these; the admin smoke asserts a live request's dominant segment is
+# one of them.  docs/OBSERVABILITY.md "Critical path & saturation"
+# documents what each covers.
+SEGMENTS = (
+    "admission",   # front-door gate: WDRR queue wait + verdict
+    "queue",       # generic queue-wait split (a span's queue_s prefix)
+    "signature",   # SigV4 verification
+    "table",       # metadata table quorum ops
+    "rpc",         # RPC fabric: quorum calls + remote handler time
+    "feeder",      # codec feeder: submit-to-result envelope
+    "codec",       # CPU-side ragged codec compute
+    "transport",   # device transport EDF queue wait
+    "device",      # device stage + compute + collect
+    "disk",        # local block store I/O
+    "api",         # request handler self-time (parse, stream, respond)
+    "other",       # anything unmapped (kept visible, never hidden)
+)
+
+# longest-prefix-first mapping from span names (see the span creation
+# sites: api/common.request_trace, rpc_helper, netapp, table, manager,
+# feeder, transport)
+_PREFIX_SEGMENTS = (
+    ("admission", "admission"),
+    ("signature", "signature"),
+    ("Table ", "table"),
+    ("RPC handler ", "rpc"),
+    ("RPC ", "rpc"),
+    ("Block ", "disk"),
+    ("Feeder ", "feeder"),
+    ("Codec ", "codec"),
+    ("Transport ", "transport"),
+    ("Device ", "device"),
+    ("Scrub ", "codec"),
+    ("S3 ", "api"),
+    ("K2V ", "api"),
+    ("Web ", "api"),
+)
+
+
+def segment_of(name: str) -> str:
+    for prefix, seg in _PREFIX_SEGMENTS:
+        if name.startswith(prefix):
+            return seg
+    return "other"
+
+
+# --- critical-path segment math -------------------------------------------
+
+
+def _depths(records: List[dict], root: dict) -> Dict[str, int]:
+    """span_id -> tree depth (root = 0).  A span whose parent is not in
+    the set (e.g. a remote handler span fetched without its local rpc
+    parent) counts as a direct child of the root — it still attributes
+    deeper than the root, which is what the sweep needs."""
+    by_id = {r["span"]: r for r in records}
+    depths: Dict[str, int] = {root["span"]: 0}
+
+    def depth(sid: str) -> int:
+        d = depths.get(sid)
+        if d is not None:
+            return d
+        seen = []
+        cur = sid
+        while cur is not None and cur not in depths:
+            seen.append(cur)
+            if len(seen) > 128:  # cycle/chain guard
+                break
+            r = by_id.get(cur)
+            cur = r.get("parent") if r is not None else None
+        base = depths.get(cur, 0) if cur is not None else 0
+        for i, s in enumerate(reversed(seen)):
+            depths[s] = base + i + 1
+        return depths.get(sid, 1)
+
+    for r in records:
+        depth(r["span"])
+    return depths
+
+
+def segment_breakdown(records: List[dict],
+                      root: dict) -> Dict[str, float]:
+    """{segment: seconds} attributing the root span's whole duration.
+
+    Timeline sweep: every elementary interval between span boundaries is
+    attributed to the segment of the DEEPEST span covering it (ties to
+    the latest-started, then the later-recorded).  Parallel siblings
+    therefore never double-count, and the values sum to the root
+    duration exactly.  A span carrying a `queue_s` attribute splits: its
+    first `queue_s` seconds attribute to the `queue` segment at depth
+    just below its children (a child span covering the queue window
+    still wins)."""
+    t0, t1 = int(root["start_ns"]), int(root["end_ns"])
+    if t1 <= t0:
+        return {}
+    depths = _depths(records, root)
+    # (start, end, sortkey, segment) intervals; sortkey orders "deepest
+    # wins" with start-time and insertion tiebreaks
+    ivals: List[Tuple[int, int, tuple, str]] = []
+    for i, r in enumerate(records):
+        s = max(int(r["start_ns"]), t0)
+        e = min(int(r["end_ns"]), t1)
+        if e <= s:
+            continue
+        d = depths.get(r["span"], 1)
+        seg = segment_of(r["name"])
+        qs = (r.get("attrs") or {}).get("queue_s")
+        if isinstance(qs, (int, float)) and qs > 0:
+            qe = min(s + int(float(qs) * 1e9), e)
+            if qe > s:
+                # queue window: deeper than the span itself (the +0.5),
+                # shallower than its children (at d+1)
+                ivals.append((s, qe, (d + 0.5, s, i), "queue"))
+            ivals.append((s, e, (d, s, i), seg))
+        else:
+            ivals.append((s, e, (d, s, i), seg))
+    if not ivals:
+        return {segment_of(root["name"]): (t1 - t0) / 1e9}
+    # incremental sweep: this runs inside Span.__exit__ on the event
+    # loop for sampled requests, so the cost must track the ACTIVE
+    # overlap (tree depth + parallel siblings, typically < 10), not
+    # intervals².  Boundary events add/remove intervals from an active
+    # map; each elementary interval takes max() over the active set.
+    events: List[Tuple[int, int, int]] = []  # (boundary, +1/-1, ival idx)
+    for i, (s, e, _key, _seg) in enumerate(ivals):
+        events.append((s, 1, i))
+        events.append((e, -1, i))
+    events.sort(key=lambda ev: (ev[0], ev[1]))  # removals before adds
+    out: Dict[str, float] = {}
+    active: Dict[int, None] = {}
+    root_seg = segment_of(root["name"])
+    prev = t0
+    for bound, kind, idx in events:
+        b = min(max(bound, t0), t1)
+        if b > prev:
+            if active:
+                _key, seg = max(ivals[i][2:] for i in active)
+            else:
+                seg = root_seg
+            out[seg] = out.get(seg, 0.0) + (b - prev) / 1e9
+            prev = b
+        if kind == 1:
+            active[idx] = None
+        else:
+            active.pop(idx, None)
+    if t1 > prev:
+        out[root_seg] = out.get(root_seg, 0.0) + (t1 - prev) / 1e9
+    return out
+
+
+def dominant_segment(segments: Dict[str, float]) -> Tuple[str, float]:
+    if not segments:
+        return "other", 0.0
+    seg = max(segments, key=lambda s: segments[s])
+    return seg, segments[seg]
+
+
+def build_tree(records: List[dict], root: dict,
+               max_spans: int = 512) -> dict:
+    """Nested {name, span, start_ns, end_ns, seconds, segment, attrs,
+    children} tree for rendering; children sorted by start time.  Spans
+    whose parent is absent attach under the root."""
+    by_parent: Dict[Optional[str], List[dict]] = {}
+    ids = {r["span"] for r in records} | {root["span"]}
+    for r in records[:max_spans]:
+        if r["span"] == root["span"]:
+            continue
+        parent = r.get("parent")
+        if parent not in ids:
+            parent = root["span"]
+        by_parent.setdefault(parent, []).append(r)
+
+    def node(r: dict) -> dict:
+        kids = sorted(by_parent.get(r["span"], []),
+                      key=lambda c: c["start_ns"])
+        return {
+            "name": r["name"],
+            "span": r["span"],
+            "start_ns": int(r["start_ns"]),
+            "end_ns": int(r["end_ns"]),
+            "seconds": round((int(r["end_ns"]) - int(r["start_ns"])) / 1e9,
+                             6),
+            "segment": segment_of(r["name"]),
+            "attrs": {k: v for k, v in (r.get("attrs") or {}).items()
+                      if isinstance(v, (str, int, float, bool))},
+            "children": [node(c) for c in kids],
+        }
+
+    return node(root)
+
+
+# --- the recorder ----------------------------------------------------------
+
+
+class WaterfallRecorder:
+    """Always-on, bounded request-waterfall retention for one node."""
+
+    KEEP = 4             # slowest trees retained per endpoint
+    RING = 4096          # recent finished spans (cross-node fetch window)
+    MAX_ENDPOINTS = 64   # endpoint label cardinality bound
+    MAX_TRACE_SPANS = 256
+    SAMPLE_EVERY = 8     # non-candidate requests sampled 1-in-N
+
+    def __init__(self, metrics=None, keep: int = KEEP, ring: int = RING,
+                 sample_every: int = SAMPLE_EVERY):
+        self._ring: deque = deque(maxlen=ring)
+        self._lock = threading.Lock()
+        self._keep = max(1, keep)
+        self._sample_every = max(1, sample_every)
+        # endpoint -> min-heap of (seconds, seq, entry)
+        self._top: Dict[str, list] = {}
+        # endpoint -> {"count": sampled requests, "seconds": total root
+        # seconds, "segments": {segment: seconds}} — the bench phases
+        # read deltas of this for their per-phase breakdown
+        self._totals: Dict[str, dict] = {}
+        self._seq = 0
+        self.sampled = 0
+        self.finalized = 0
+        if metrics is not None:
+            self.m_cp = metrics.histogram(
+                "request_critical_path_seconds",
+                "Self-time of the dominant critical-path segment per "
+                "sampled request, by endpoint and segment",
+                exemplars=True)
+            # no _total suffix: that suffix is counter-reserved in the
+            # Prometheus conventions and this renders as a gauge
+            metrics.gauge(
+                "request_waterfall_sampled",
+                "Requests whose span tree was sampled for critical-path "
+                "attribution", fn=lambda: float(self.sampled))
+        else:
+            self.m_cp = None
+
+    # --- ingest (called by Tracer._record for every finished span) ---
+
+    def note(self, rec: dict) -> None:
+        with self._lock:
+            self._ring.append(rec)
+        # request roots: minted by api/common.request_trace (no parent,
+        # an `api` attr).  Background roots (resync loops, scrub) have
+        # no api attr and just age out of the ring.
+        if rec.get("parent") is None and (rec.get("attrs") or {}).get("api"):
+            self._finalize(rec)
+
+    def _endpoint_of(self, rec: dict) -> str:
+        attrs = rec.get("attrs") or {}
+        ep = attrs.get("endpoint") or rec["name"]
+        with self._lock:
+            # the cap INCLUDES the overflow bucket: one slot is held in
+            # reserve so forged endpoint floods pool instead of growing
+            if (ep not in self._totals
+                    and len(self._totals) >= self.MAX_ENDPOINTS - 1
+                    and ep != "~overflow"):
+                return "~overflow"
+        return str(ep)
+
+    def _finalize(self, root: dict) -> None:
+        self.finalized += 1
+        dur_s = (int(root["end_ns"]) - int(root["start_ns"])) / 1e9
+        if dur_s <= 0:
+            return
+        endpoint = self._endpoint_of(root)
+        tid = root["trace"]
+        with self._lock:
+            self._seq += 1
+            heap = self._top.setdefault(endpoint, [])
+            qualifies = len(heap) < self._keep or dur_s > heap[0][0]
+            if not qualifies and self._seq % self._sample_every != 0:
+                return
+            spans = [r for r in self._ring if r["trace"] == tid]
+        spans = spans[-self.MAX_TRACE_SPANS:]
+        segments = segment_breakdown(spans, root)
+        dom, dom_s = dominant_segment(segments)
+        entry = {
+            "trace_id": tid,
+            "endpoint": endpoint,
+            "seconds": round(dur_s, 6),
+            "ts": round(time.time(), 3),
+            "segments": {k: round(v, 6) for k, v in sorted(
+                segments.items(), key=lambda kv: -kv[1])},
+            "dominant": dom,
+            "span_count": len(spans),
+            # the local span records, retained with the tree so the
+            # waterfall survives ring eviction (admin merges remote
+            # spans in at fetch time)
+            "local_spans": spans,
+        }
+        with self._lock:
+            self.sampled += 1
+            tot = self._totals.setdefault(
+                endpoint, {"count": 0, "seconds": 0.0, "segments": {}})
+            tot["count"] += 1
+            tot["seconds"] += dur_s
+            for seg, s in segments.items():
+                tot["segments"][seg] = tot["segments"].get(seg, 0.0) + s
+            if qualifies:
+                if len(heap) >= self._keep:
+                    heapq.heapreplace(heap, (dur_s, self._seq, entry))
+                else:
+                    heapq.heappush(heap, (dur_s, self._seq, entry))
+        if self.m_cp is not None:
+            self.m_cp.observe(dom_s, trace_exemplar=tid,
+                              endpoint=endpoint, segment=dom)
+
+    # --- read side -------------------------------------------------------
+
+    def endpoints(self) -> List[dict]:
+        """Per-endpoint summary: sampled count, mean duration, dominant
+        segment of the cumulative breakdown, retained exemplar count."""
+        out = []
+        with self._lock:
+            for ep, tot in sorted(self._totals.items()):
+                dom, _s = dominant_segment(tot["segments"])
+                out.append({
+                    "endpoint": ep,
+                    "sampled": tot["count"],
+                    "mean_ms": round(
+                        tot["seconds"] / tot["count"] * 1000.0, 3)
+                    if tot["count"] else 0.0,
+                    "dominant": dom,
+                    "retained": len(self._top.get(ep, [])),
+                })
+        return out
+
+    def entries(self, endpoint: Optional[str] = None) -> List[dict]:
+        """Retained waterfall entries (without the raw spans), slowest
+        first."""
+        with self._lock:
+            heaps = ([self._top.get(endpoint, [])] if endpoint
+                     else list(self._top.values()))
+            items = [e for h in heaps for _d, _s, e in h]
+        items.sort(key=lambda e: -e["seconds"])
+        return [{k: v for k, v in e.items() if k != "local_spans"}
+                for e in items]
+
+    def entry_for(self, trace_id: Optional[str] = None,
+                  endpoint: Optional[str] = None) -> Optional[dict]:
+        """One retained entry WITH its local spans: by trace id, or the
+        slowest retained for `endpoint`, or the slowest overall."""
+        with self._lock:
+            candidates = [e for h in self._top.values() for _d, _s, e in h]
+        if trace_id is not None:
+            for e in candidates:
+                if e["trace_id"] == trace_id:
+                    return e
+            return None
+        if endpoint is not None:
+            candidates = [e for e in candidates if e["endpoint"] == endpoint]
+        return max(candidates, key=lambda e: e["seconds"], default=None)
+
+    def spans_for_trace(self, trace_id: str) -> List[dict]:
+        """Every span record this node holds for `trace_id`: the recent
+        ring plus any retained entry's spans (the cross-node fetch the
+        admin waterfall merge calls on peers)."""
+        with self._lock:
+            out = {r["span"]: r for r in self._ring
+                   if r["trace"] == trace_id}
+            for h in self._top.values():
+                for _d, _s, e in h:
+                    if e["trace_id"] == trace_id:
+                        for r in e["local_spans"]:
+                            out.setdefault(r["span"], r)
+        return list(out.values())
+
+    def totals(self) -> Dict[str, dict]:
+        """Cumulative per-endpoint sampled breakdown (bench reads phase
+        deltas of this)."""
+        with self._lock:
+            return {
+                ep: {
+                    "count": tot["count"],
+                    "seconds": round(tot["seconds"], 6),
+                    "segments": {k: round(v, 6)
+                                 for k, v in tot["segments"].items()},
+                }
+                for ep, tot in self._totals.items()
+            }

@@ -42,7 +42,8 @@ class WebServer:
             self._m_errors = m.counter(
                 "api_error_counter", "API requests answered with an error")
             self._m_duration = m.histogram(
-                "api_request_duration_seconds", "API request latency")
+                "api_request_duration_seconds", "API request latency",
+                exemplars=True)
         else:
             self._m_requests = self._m_errors = self._m_duration = None
 

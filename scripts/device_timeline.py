@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Export the device/transport pipeline timeline as Chrome-trace JSON.
+
+Pulls the bounded timeline ring (utils/timeline.py, fed by the codec
+feeder and the device transport) from a running node over the admin RPC
+and writes catapult JSON for chrome://tracing or https://ui.perfetto.dev
+— the staging-overlap picture behind docs/DEVICE_TRANSPORT.md.
+
+Usage:
+    scripts/dev_cluster.sh &            # or any running daemon
+    python scripts/device_timeline.py [-c CONFIG] [-o OUT.json] [--drive N]
+
+--drive N first performs N concurrent 1 MiB S3 PUTs against the node so
+the exported window is guaranteed non-empty (requires dev_configure.sh's
+smoke credentials, or set GARAGE_TPU_KEY_ID / GARAGE_TPU_SECRET).
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASE = os.environ.get("GARAGE_TPU_DEV_DIR", "/tmp/garage_tpu_dev")
+
+
+async def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-c", "--config",
+                    default=f"{BASE}/node0/garage.toml")
+    ap.add_argument("-o", "--out", default="device_timeline.json")
+    ap.add_argument("-n", "--limit", type=int, default=None)
+    ap.add_argument("--drive", type=int, default=0,
+                    help="run N concurrent 1 MiB PUTs first so the "
+                         "window is non-empty")
+    args = ap.parse_args()
+
+    if args.drive:
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        from test_s3_api import S3Client
+
+        kid = os.environ.get("GARAGE_TPU_KEY_ID")
+        sec = os.environ.get("GARAGE_TPU_SECRET")
+        if not (kid and sec):
+            print("--drive needs GARAGE_TPU_KEY_ID/GARAGE_TPU_SECRET",
+                  file=sys.stderr)
+            return 2
+        c = S3Client(3900, kid, sec)
+        await c.req("PUT", "/timelinebkt")
+        sem = asyncio.Semaphore(8)
+
+        async def put(i):
+            async with sem:
+                st, _h, _b = await c.req(
+                    "PUT", f"/timelinebkt/obj-{i}", body=os.urandom(1 << 20))
+                assert st == 200, st
+
+        await asyncio.gather(*[put(i) for i in range(args.drive)])
+
+    from garage_tpu.cli import AdminClient
+
+    client = AdminClient(args.config, None)
+    msg = {"cmd": "device_timeline"}
+    if args.limit:
+        msg["limit"] = args.limit
+    chrome = await client.call(msg)
+    events = [e for e in chrome["traceEvents"] if e.get("ph") != "M"]
+    with open(args.out, "w") as f:
+        json.dump(chrome, f)
+    print(f"wrote {len(events)} events to {args.out} "
+          f"(open in chrome://tracing or https://ui.perfetto.dev)")
+    return 0 if events else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

@@ -192,6 +192,46 @@ async def main() -> None:
     print(f"feeder smoke ok (16 puts @8 conc, "
           f"{int(dispatches)} ragged dispatches)")
 
+    # 8. critical-path attribution smoke (ISSUE 13): the concurrent PUTs
+    # above were sampled by the gateway's waterfall recorder — pull a
+    # live waterfall through the CLI, assert the dominant segment is a
+    # known taxonomy value and the segments sum to the request duration
+    # (within 10%), export a non-empty chrome trace, and check every
+    # live family has a docs/OBSERVABILITY.md row
+    import json as _json
+
+    from garage_tpu.utils.metricsdoc import undocumented_families
+    from garage_tpu.utils.waterfall import SEGMENTS
+
+    listing = _json.loads(cli("request", "waterfall", "--json"))
+    puts = [e for e in listing["retained"] if e["endpoint"] == "PutObject"]
+    assert puts, f"no retained PutObject waterfall: {listing['endpoints']}"
+    wf = _json.loads(cli("request", "waterfall", "--trace",
+                         puts[0]["trace_id"], "--json"))
+    assert wf["dominant"] in SEGMENTS, wf["dominant"]
+    seg_sum = sum(wf["segments"].values())
+    assert abs(seg_sum - wf["seconds"]) <= 0.1 * wf["seconds"], \
+        (seg_sum, wf["seconds"])
+    assert wf["span_count"] >= 3, wf
+    chrome = _json.loads(cli("timeline"))
+    n_events = sum(1 for e in chrome["traceEvents"] if e.get("ph") != "M")
+    assert n_events > 0, "empty chrome-trace export on the gateway"
+    doc = open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read()
+    bodies = {}
+    async with aiohttp.ClientSession() as s:
+        for port in ADMIN_PORTS:
+            async with s.get(f"http://127.0.0.1:{port}/metrics") as r:
+                assert r.status == 200
+                bodies[port] = await r.text()
+            assert not lint_exposition(bodies[port]), port
+            missing = undocumented_families(bodies[port], doc)
+            assert not missing, f":{port} undocumented families: {missing}"
+    assert "request_critical_path_seconds" in bodies[ADMIN_PORTS[0]]
+    print(f"critical-path smoke ok (PutObject dominant={wf['dominant']}, "
+          f"{wf['span_count']} spans, segments sum "
+          f"{seg_sum * 1000:.1f}ms of {wf['seconds'] * 1000:.1f}ms, "
+          f"{n_events} timeline events, docs lint clean on 3 nodes)")
+
     print("SMOKE OK")
 
 

@@ -3,6 +3,11 @@
 # reference script/test-smoke.sh): put/get/diff at several sizes across
 # different nodes, multipart with out-of-order + skipped part numbers,
 # ranged reads, list pagination, website serving, and batch deletes.
+# smoke.py step 8 (ISSUE 13) additionally pulls a live `request
+# waterfall` via the CLI (dominant segment must be a taxonomy value,
+# segments must sum to the request duration within 10%), exports a
+# non-empty chrome-trace timeline, and runs the metrics-docs lint
+# (every live family needs a docs/OBSERVABILITY.md row) on all 3 nodes.
 #
 # Usage: scripts/dev_cluster.sh &   (wait for boot)
 #        scripts/dev_configure.sh

@@ -1,0 +1,371 @@
+"""Critical-path attribution (ISSUE 13): request waterfalls, segment
+math, the device timeline's chrome-trace export, histogram exemplars,
+table-depth gauges, and the metrics-docs lint.
+
+Acceptance shape: a PUT against node 0 of a 3-node cluster yields a
+retained waterfall whose cross-node merged tree contains a replica's
+`RPC handler` span, whose segments sum to the request duration (within
+10%), and whose dominant segment is one of the taxonomy values;
+`request_critical_path_seconds` renders promlint-clean; every live
+family has a docs/OBSERVABILITY.md row.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from garage_tpu.utils.metrics import MetricsRegistry
+from garage_tpu.utils.promlint import lint_exposition
+from garage_tpu.utils.timeline import Timeline, overlapping_slot_windows
+from garage_tpu.utils.tracing import Tracer
+from garage_tpu.utils.waterfall import (
+    SEGMENTS,
+    WaterfallRecorder,
+    build_tree,
+    dominant_segment,
+    segment_breakdown,
+    segment_of,
+)
+
+from test_model import make_garage_cluster, shutdown
+
+pytestmark = pytest.mark.asyncio
+
+MS = 1_000_000  # ns
+
+
+def _rec(name, span, parent, start_ms, end_ms, trace="t" * 32, **attrs):
+    return {"trace": trace, "span": span, "parent": parent, "name": name,
+            "start_ns": start_ms * MS, "end_ns": end_ms * MS,
+            "attrs": attrs}
+
+
+# --- segment math on a synthetic tree ----------------------------------
+
+
+async def test_segment_breakdown_synthetic_tree():
+    """Known tree: parallel RPC fan-out never double-counts, queue_s
+    splits a span, and the per-segment seconds sum to the root duration
+    EXACTLY."""
+    root = _rec("S3 PUT", "r", None, 0, 100, api="s3")
+    records = [
+        root,
+        _rec("signature verify", "sig", "r", 0, 10),
+        _rec("Table object insert", "tab", "r", 10, 30),
+        # parallel quorum RPCs covering the same 30–60 window: the sweep
+        # must attribute those 30ms ONCE
+        _rec("RPC garage/block_rw", "rpc1", "r", 30, 60),
+        _rec("RPC garage/block_rw", "rpc2", "r", 32, 58),
+        # feeder envelope 60–90 with 20ms queue wait, inner codec
+        # compute 80–90 (deeper than the queue window)
+        _rec("Feeder hash", "fe", "r", 60, 90, queue_s=0.020),
+        _rec("Codec hash", "co", "fe", 80, 90),
+    ]
+    segs = segment_breakdown(records, root)
+    assert abs(sum(segs.values()) - 0.100) < 1e-9
+    assert abs(segs["signature"] - 0.010) < 1e-9
+    assert abs(segs["table"] - 0.020) < 1e-9
+    assert abs(segs["rpc"] - 0.030) < 1e-9       # not 0.056: no double count
+    assert abs(segs["queue"] - 0.020) < 1e-9     # the queue_s split
+    assert abs(segs["codec"] - 0.010) < 1e-9
+    assert "feeder" not in segs or abs(segs["feeder"]) < 1e-9
+    assert abs(segs["api"] - 0.010) < 1e-9       # root self-time 90–100
+    dom, dom_s = dominant_segment(segs)
+    assert dom == "rpc" and abs(dom_s - 0.030) < 1e-9
+    assert all(s in SEGMENTS for s in segs)
+
+
+async def test_build_tree_orphans_attach_to_root():
+    root = _rec("S3 GET", "r", None, 0, 50, api="s3")
+    # a remote handler span whose local rpc parent was never fetched
+    orphan = _rec("RPC handler garage/table/object", "h1", "missing",
+                  10, 20)
+    tree = build_tree([root, orphan], root)
+    assert tree["name"] == "S3 GET"
+    assert [c["name"] for c in tree["children"]] == [orphan["name"]]
+    assert tree["children"][0]["segment"] == "rpc"
+    assert segment_of("Block write") == "disk"
+    assert segment_of("Device scrub") == "device"
+    assert segment_of("whatever") == "other"
+
+
+# --- the recorder: sampling, retention bounds, metric ------------------
+
+
+async def test_recorder_bounded_retention_and_metric():
+    m = MetricsRegistry()
+    wf = WaterfallRecorder(metrics=m, keep=2, ring=128, sample_every=4)
+    # 80 endpoints × several requests: the endpoint map must clamp at
+    # MAX_ENDPOINTS with the rest pooling under ~overflow, heaps at
+    # `keep`, and the ring at its maxlen
+    for i in range(80):
+        for j in range(3):
+            tid = os.urandom(16).hex()
+            root = {"trace": tid, "span": f"s{i}-{j}", "parent": None,
+                    "name": "S3 PUT",
+                    "start_ns": 0, "end_ns": (j + 1) * 10 * MS,
+                    "attrs": {"api": "s3", "endpoint": f"Ep{i}"}}
+            wf.note(root)
+    assert len(wf._ring) <= 128
+    assert len(wf._totals) <= WaterfallRecorder.MAX_ENDPOINTS
+    assert all(len(h) <= 2 for h in wf._top.values())
+    assert any(e["endpoint"] == "~overflow" for e in wf.endpoints())
+    assert wf.sampled > 0
+    # every sampled request observed the critical-path histogram with a
+    # taxonomy segment label; the exposition stays promlint-clean
+    body = m.render()
+    assert "request_critical_path_seconds" in body
+    assert not lint_exposition(body)
+    entries = wf.entries()
+    assert entries and all(e["dominant"] in SEGMENTS for e in entries)
+    # totals are the bench phases' source: counts + per-segment seconds
+    tot = wf.totals()
+    assert sum(t["count"] for t in tot.values()) == wf.sampled
+
+
+async def test_recorder_span_overhead_bounded():
+    """2000 spans through a waterfall-attached tracer stay cheap and
+    bounded (the always-on cost the tentpole pays)."""
+    tr = Tracer("test", None)
+    tr.waterfall = WaterfallRecorder(metrics=None)
+    t0 = time.perf_counter()
+    for _ in range(2000):
+        with tr.span("Block read", block="ab"):
+            pass
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"span overhead blew up: {dt:.3f}s for 2000 spans"
+    assert len(tr.waterfall._ring) <= WaterfallRecorder.RING
+    assert len(tr._buf) == 0  # no exporter → no export buffering
+
+
+# --- queue split + slow-op trace ids -----------------------------------
+
+
+async def test_mark_service_start_and_slow_op_trace_id():
+    tr = Tracer("test", None)
+    wf = WaterfallRecorder()
+    tr.waterfall = wf
+    with tr.new_trace("S3 GET", api="s3", endpoint="GetObject") as root:
+        with tr.span("Table object get") as s:
+            time.sleep(0.012)
+            s.mark_service_start()
+    assert s.attrs["queue_s"] >= 0.011
+    # the slow-op log rows now carry the trace id — the link to
+    # `request waterfall --trace`
+    snap = tr.slow.snapshot()
+    assert snap and snap[0]["trace"] == root.trace_id
+
+
+# --- chrome-trace export ----------------------------------------------
+
+
+async def test_timeline_chrome_trace_shape_and_overlap():
+    tl = Timeline(size=64)
+    t0 = time.monotonic_ns()
+    tl.event("stage hash", "slot0", t0, t0 + 5 * MS, cls="fg", blocks=8)
+    tl.event("compute hash", "slot0", t0 + 5 * MS, t0 + 20 * MS)
+    # slot1 stages WHILE slot0 computes — the double-buffer overlap
+    tl.event("stage hash", "slot1", t0 + 6 * MS, t0 + 12 * MS)
+    tl.event("edf_pop hash", "edf", t0 + 1 * MS, cls="fg")
+    tl.counter("transport_queue", t0, fg=2, bg=1)
+    chrome = tl.chrome_trace()
+    evs = chrome["traceEvents"]
+    assert chrome["displayTimeUnit"] == "ms"
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert {"slot0", "slot1", "edf", "counters"} <= names
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert xs and all("dur" in e and "ts" in e for e in xs)
+    assert any(e.get("ph") == "C" for e in evs)
+    assert overlapping_slot_windows(chrome) >= 1
+    # JSON-serializable end to end (the admin endpoint ships it)
+    import json
+
+    json.dumps(chrome)
+    # bounded: overflow events increment dropped, ring stays capped
+    for i in range(200):
+        tl.event("x", "slot0", t0 + i)
+    assert len(tl._ring) <= 64 and tl.dropped > 0
+
+
+async def test_transport_feeds_timeline_golden_shape():
+    """A real DeviceTransport round (synthetic async device) lands
+    stage/submit/compute/collect events on slot tracks and edf events on
+    the queue track — the golden shape the export contract promises."""
+    import hashlib
+
+    import numpy as np
+
+    from garage_tpu.ops.codec import CodecParams
+    from garage_tpu.ops.cpu_codec import CpuCodec
+    from garage_tpu.ops.transport import DeviceTransport, TransportItem
+    from garage_tpu.testing.synthetic_device import SyntheticLinkCodec
+
+    p = CodecParams(rs_data=4, rs_parity=2, block_size=4096)
+    dev = SyntheticLinkCodec(p, link_gibs=100.0, compute_real=True)
+    tr = DeviceTransport(dev, p, fallback=CpuCodec(p))
+    rng = np.random.default_rng(0)
+    for seed in range(3):
+        blocks = [rng.integers(0, 256, (4096,), dtype=np.uint8).tobytes()
+                  for _ in range(8)]
+        it = TransportItem("hash", blocks, len(blocks),
+                           sum(map(len, blocks)))
+        tr.submit_items("hash", [it])
+        digs = it.future.result(timeout=30)
+        assert [bytes(d) for d in digs] == [
+            hashlib.blake2s(b, digest_size=32).digest() for b in blocks]
+    tr.shutdown()
+    chrome = tr.obs.timeline.chrome_trace()
+    kinds = {e["name"].split(" ")[0] for e in chrome["traceEvents"]
+             if e.get("ph") in ("X", "i")}
+    assert {"enqueue", "edf_pop", "stage", "submit", "collect"} <= kinds
+    assert tr.device_busy_now() > 0.0
+    assert tr.link_busy_seconds > 0.0
+
+
+# --- histogram exemplars -----------------------------------------------
+
+
+async def test_histogram_exemplars_openmetrics_render():
+    m = MetricsRegistry()
+    h = m.histogram("api_request_duration_seconds", "t", exemplars=True)
+    tr = Tracer("test", None)
+    with tr.new_trace("S3 GET", api="s3") as root:
+        h.observe(0.2, api="s3")   # trace id pulled from the context
+    h.observe(0.05, trace_exemplar="beef" * 8, api="s3")  # not the max
+    snap = h.exemplar_snapshot()
+    assert snap[0]["trace_id"] == root.trace_id
+    assert snap[0]["value"] == 0.2
+    plain = m.render()
+    assert "# {" not in plain and not lint_exposition(plain)
+    om = m.render(openmetrics=True)
+    assert f'# {{trace_id="{root.trace_id}"}}' in om
+
+
+# --- the acceptance cluster: cross-node waterfall + docs lint ----------
+
+
+async def test_cross_node_waterfall_and_docs_lint(tmp_path):
+    """One PUT against node 0 of a 3-node cluster: the admin `request
+    waterfall` merge returns a tree containing a REPLICA node's handler
+    span, segments sum to the duration within 10%, the dominant segment
+    is in the taxonomy, the critical-path family lints clean, every
+    live family has a doc row, and the admin timeline export is
+    non-empty."""
+    import aiohttp
+    import yarl
+
+    from garage_tpu.admin.handler import AdminRpcHandler
+    from garage_tpu.api.admin_server import metrics_body
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.api.signature import sign_request
+    from garage_tpu.utils.metricsdoc import undocumented_families
+
+    garages = await make_garage_cluster(tmp_path)
+    # one admin handler per node: the waterfall merge fans `trace_spans`
+    # out over the layout, exactly as live daemons answer it
+    admins = [AdminRpcHandler(g) for g in garages]
+    g = garages[0]
+    helper = g.helper()
+    key = await helper.create_key("wf")
+    key.params().allow_create_bucket.update(True)
+    await g.key_table.insert(key)
+    server = S3ApiServer(g)
+    await server.start("127.0.0.1:0")
+    sport = server.port
+    kid, secret = key.key_id, key.params().secret_key
+
+    async def req(method, path, body=b""):
+        headers = {"host": f"127.0.0.1:{sport}"}
+        headers.update(sign_request(kid, secret, "garage", method, path,
+                                    [], headers, body, path_is_raw=True))
+        async with aiohttp.ClientSession() as s:
+            async with s.request(
+                method, yarl.URL(f"http://127.0.0.1:{sport}{path}",
+                                 encoded=True),
+                data=body, headers=headers,
+            ) as r:
+                return r.status, r.headers.copy()
+
+    st, _ = await req("PUT", "/wfbkt")
+    assert st == 200
+    t0 = time.perf_counter()
+    st, hdrs = await req("PUT", "/wfbkt/obj", os.urandom(2 << 20))
+    wall_s = time.perf_counter() - t0
+    assert st == 200
+    rid = hdrs["x-amz-request-id"]
+
+    # list surface: the PUT is retained per endpoint
+    listing = await admins[0]._cmd_request_waterfall({})
+    eps = {e["endpoint"] for e in listing["endpoints"]}
+    assert "PutObject" in eps
+    assert any(e["trace_id"] == rid for e in listing["retained"])
+
+    # merged detail: remote spans join the tree, segments sum to the
+    # measured duration (the sweep makes the sum exact over the root;
+    # the 10% bound checks it against the CLIENT-side wall clock)
+    wf = await admins[0]._cmd_request_waterfall({"trace": rid})
+    assert wf["endpoint"] == "PutObject"
+    assert wf["dominant"] in SEGMENTS
+    seg_sum = sum(wf["segments"].values())
+    assert abs(seg_sum - wf["seconds"]) <= 0.1 * wf["seconds"] + 1e-6
+    assert wf["seconds"] <= wall_s * 1.1
+
+    def names(node, acc):
+        acc.append(node["name"])
+        for c in node["children"]:
+            names(c, acc)
+        return acc
+
+    all_names = names(wf["tree"], [])
+    assert any(n.startswith("RPC handler") for n in all_names), all_names
+    assert wf["nodes_contributing"] >= 2
+    # admission landed inside the backdated root
+    assert "admission" in all_names
+
+    # exemplars: the hot request's trace id is fetchable
+    exemplars = await admins[0]._cmd_exemplars({})
+    assert any(e["family"] == "request_critical_path_seconds"
+               for e in exemplars)
+
+    # timeline export non-empty (feeder dispatch events at minimum)
+    chrome = await admins[0]._cmd_device_timeline({})
+    assert any(e.get("ph") in ("X", "i") for e in chrome["traceEvents"])
+
+    # the full exposition lints clean AND every family has a doc row
+    doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "OBSERVABILITY.md")).read()
+    body = metrics_body(g)
+    assert "request_critical_path_seconds" in body
+    assert not lint_exposition(body)
+    missing = undocumented_families(body, doc)
+    assert not missing, f"undocumented metric families: {missing}"
+
+    await server.stop()
+    await shutdown(garages)
+
+
+# --- table depth gauges + sync rounds ----------------------------------
+
+
+async def test_table_depth_gauges_and_sync_rounds(tmp_path):
+    from garage_tpu.table.sync import TableSyncer
+
+    garages = await make_garage_cluster(tmp_path, n=2, mode="2")
+    g0, g1 = garages
+    syncers = [TableSyncer(g.system, g.object_table.data,
+                           g.object_table.merkle) for g in garages]
+    await syncers[0]._do_sync_with(0, g1.system.id)
+    for g in garages:
+        for t in g.tables:
+            t.observe_gauges()
+    body = g0.system.metrics.render()
+    for fam in ("table_merkle_todo", "table_insert_queue",
+                "table_gc_todo", "table_merkle_sync_rounds_total"):
+        assert fam in body, fam
+    assert ('table_merkle_sync_rounds_total{result="in_sync"'
+            in body or 'result="synced"' in body), body
+    assert not lint_exposition(body)
+    await shutdown(garages)
