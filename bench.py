@@ -800,14 +800,16 @@ class _S3:
         import aiohttp  # noqa: F401
         import yarl
 
-        from garage_tpu.api.signature import sign_request
+        from garage_tpu.api.signature import sign_request, uri_encode
 
         headers = {"host": f"127.0.0.1:{self.port}"}
         headers.update(sign_request(
             self.kid, self.secret, "garage", method, path, list(query),
             headers, body, path_is_raw=True,
         ))
-        qs = "&".join(f"{k}={v}" for k, v in query)
+        # wire query must equal the signed canonical encoding (values
+        # like continuation tokens carry '=' and '+')
+        qs = "&".join(f"{uri_encode(k)}={uri_encode(v)}" for k, v in query)
         url = yarl.URL(
             f"http://127.0.0.1:{self.port}{path}" + (f"?{qs}" if qs else ""),
             encoded=True)
@@ -1952,6 +1954,412 @@ async def _transport_phase_async() -> dict:
     return out
 
 
+# --- metadata plane at millions of objects (ISSUE 14) ----------------------
+#
+# Drives the CRDT table engine itself at production cardinality: 1M
+# objects across 8 buckets loaded straight through the table update
+# transaction (the S3 layer is exercised by the listing half), the
+# batched Merkle updater draining live, paired serial/batched Merkle
+# A/B, serial/sharded listing p50/p99 at three prefixes, batched
+# anti-entropy convergence of a cold diverged pair, and index-counter
+# exactness after delete+reinsert churn.
+
+META_OBJECTS = int(os.environ.get("GARAGE_BENCH_META_OBJECTS", "1000000"))
+META_SYNC_OBJECTS = int(
+    os.environ.get("GARAGE_BENCH_META_SYNC_OBJECTS", "20000"))
+META_AB_WINDOW = 2000       # items per paired Merkle A/B drain window
+META_LIST_ROUNDS = 6        # alternating serial/sharded listing windows
+
+
+def _meta_key(i: int) -> str:
+    # 50 "directories" per bucket: gives the delimiter listing real
+    # common-prefix aggregation work and the prefix listing a multi-page
+    # walk
+    return f"d{(i // 8) % 50:02d}/obj{i:07d}"
+
+
+def _meta_mk_object(bucket_id, key: str, ts: int):
+    from garage_tpu.model.s3.object_table import (
+        Object, ObjectVersion, ObjectVersionData, ObjectVersionHeaders,
+        ObjectVersionMeta)
+    from garage_tpu.utils.data import gen_uuid
+
+    meta = ObjectVersionMeta.new(ObjectVersionHeaders.new(), 0, "etag")
+    v = ObjectVersion(gen_uuid(), ts,
+                      ["complete", ObjectVersionData.inline(meta, b"")])
+    return Object(bucket_id, key, [v])
+
+
+async def _meta_listing_ab(s3, garages, bucket: str) -> dict:
+    """Paired serial (list_shards=1) vs sharded listing latencies at
+    three prefixes: bucket root (one full page), one directory walked to
+    completion (multi-page), delimiter aggregation at the root."""
+
+    async def walk(query_base):
+        lats = []
+        token = None
+        while True:
+            q = [("list-type", "2")] + list(query_base)
+            if token is not None:
+                q.append(("continuation-token", token))
+            t0 = time.perf_counter()
+            st, body, _h = await s3.req("GET", f"/{bucket}", query=q)
+            lats.append((time.perf_counter() - t0) * 1000.0)
+            assert st == 200, body[:300]
+            tok = body.split(b"<NextContinuationToken>")
+            token = (tok[1].split(b"<")[0].decode()
+                     if len(tok) > 1 else None)
+            if token is None:
+                return lats
+
+    cases = {
+        "root_page": [("max-keys", "1000")],
+        "dir_walk": [("prefix", "d07/"), ("max-keys", "1000")],
+        "delimiter": [("delimiter", "/"), ("max-keys", "1000")],
+    }
+    lat = {name: {"serial": [], "sharded": []} for name in cases}
+    for _round in range(META_LIST_ROUNDS):
+        for mode, shards in (("serial", 1), ("sharded", 4)):
+            for g in garages:
+                g.config.table.list_shards = shards
+            for name, qb in cases.items():
+                lat[name][mode] += await walk(qb)
+    for g in garages:
+        g.config.table.list_shards = 4
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(len(xs) * p))], 2)
+
+    out = {}
+    for name, modes in lat.items():
+        for mode, xs in modes.items():
+            out[f"{name}_{mode}_p50_ms"] = pct(xs, 0.50)
+            out[f"{name}_{mode}_p99_ms"] = pct(xs, 0.99)
+    return out
+
+
+def _meta_merkle_ab(system) -> dict:
+    """Offline paired A/B on bare tables (no live workers): identical
+    churn sets drained in alternating serial/batched windows; trees must
+    come out bit-identical."""
+    from garage_tpu.db import open_db
+    from garage_tpu.rpc.replication_mode import parse_replication_mode
+    from garage_tpu.table import Table, TableShardedReplication
+
+    m = parse_replication_mode("1")
+
+    def mk():
+        repl = TableShardedReplication(
+            system, m.replication_factor, m.read_quorum, m.write_quorum)
+        from garage_tpu.model.index_counter import counter_table_schema
+
+        return Table(system, counter_table_schema("bench_meta_ab"),
+                     repl, open_db("memory"))
+
+    ta, tb = mk(), mk()
+    from garage_tpu.model.index_counter import CounterEntry
+
+    n = META_AB_WINDOW * 6
+    for i in range(n):
+        e = CounterEntry(b"%032d" % (i % 997), f"s{i:06d}",
+                         {"objects": {b"n0": [i, i]}})
+        enc = e.encode()
+        ta.data.update_entry(enc)
+        tb.data.update_entry(enc)
+
+    def drain_window(t, batched: bool, limit: int) -> float:
+        t0 = time.perf_counter()
+        done = 0
+        while done < limit:
+            items = t.data.merkle_todo.range_scan(
+                limit=min(256, limit - done))
+            if not items:
+                break
+            if batched:
+                done += t.merkle.update_batch(items)
+            else:
+                for k, _tv in items:
+                    t.merkle.update_item(k)
+                done += len(items)
+        return time.perf_counter() - t0
+
+    serial_s = batched_s = 0.0
+    for _ in range(3):  # alternating paired windows cancel host drift
+        serial_s += drain_window(ta, False, META_AB_WINDOW)
+        batched_s += drain_window(tb, True, META_AB_WINDOW)
+    # drain remainders fully, then compare the whole trees
+    drain_window(ta, False, n)
+    drain_window(tb, True, n)
+    ident = (dict(ta.data.merkle_tree.items())
+             == dict(tb.data.merkle_tree.items()))
+    per_window = 3 * META_AB_WINDOW
+    return {
+        "merkle_serial_items_per_s": round(per_window / serial_s, 1),
+        "merkle_batched_items_per_s": round(per_window / batched_s, 1),
+        "merkle_batched_speedup": round(serial_s / batched_s, 3),
+        "merkle_bit_identical": ident,
+    }
+
+
+async def _meta_sync_ab(tmp) -> dict:
+    """Cold-node convergence: a 2-node pair diverged by META_SYNC_OBJECTS
+    entries, synced per-node vs batched — same final roots, counted RPC
+    rounds."""
+    from garage_tpu.db import open_db
+    from garage_tpu.model.index_counter import (CounterEntry,
+                                                counter_table_schema)
+    from garage_tpu.rpc.layout import ClusterLayout, NodeRole
+    from garage_tpu.rpc.replication_mode import parse_replication_mode
+    from garage_tpu.rpc.system import System
+    from garage_tpu.table import (Table, TableShardedReplication,
+                                  TableSyncer)
+    from garage_tpu.utils.config import config_from_dict
+    from garage_tpu.utils.data import blake2sum
+
+    async def mk_pair(tag):
+        systems = []
+        for i in range(2):
+            cfg = config_from_dict({
+                "metadata_dir": str(tmp / f"sync{tag}{i}" / "meta"),
+                "data_dir": str(tmp / f"sync{tag}{i}" / "data"),
+                "replication_mode": "2",
+                "rpc_bind_addr": "127.0.0.1:0",
+                "rpc_secret": "bench-meta",
+                "bootstrap_peers": [],
+            })
+            s = System(cfg)
+            await s.netapp.listen("127.0.0.1:0")
+            systems.append(s)
+        ports = [s.netapp._server.sockets[0].getsockname()[1]
+                 for s in systems]
+        await systems[0].netapp.connect(
+            f"127.0.0.1:{ports[1]}", expected_id=systems[1].id)
+        lay = systems[0].layout
+        for s in systems:
+            lay.stage_role(bytes(s.id), NodeRole("dc1", 1000))
+        lay.apply_staged_changes()
+        enc = lay.encode()
+        m = parse_replication_mode("2")
+        tables, syncers = [], []
+        for s in systems:
+            s.layout = ClusterLayout.decode(enc)
+            s._rebuild_ring()
+            repl = TableShardedReplication(
+                s, m.replication_factor, m.read_quorum, m.write_quorum)
+            t = Table(s, counter_table_schema("bench_meta_sync"), repl,
+                      open_db("memory"))
+            tables.append(t)
+            syncers.append(TableSyncer(s, t.data, t.merkle))
+        # diverge: node 0 holds everything, node 1 is the cold joiner
+        for i in range(META_SYNC_OBJECTS):
+            tables[0].data.update_entry(CounterEntry(
+                b"%032d" % (i % 997), f"s{i:06d}",
+                {"objects": {b"n0": [i, i]}}).encode())
+        for t in tables:
+            while True:
+                items = t.data.merkle_todo.range_scan(limit=512)
+                if not items:
+                    break
+                t.merkle.update_batch(items)
+        return systems, tables, syncers
+
+    async def converge(tables, syncers):
+        t0 = time.perf_counter()
+        for part, fh in tables[0].replication.partitions():
+            await syncers[0].sync_partition(part, fh)
+        wall = time.perf_counter() - t0
+        for t in tables:
+            while True:
+                items = t.data.merkle_todo.range_scan(limit=512)
+                if not items:
+                    break
+                t.merkle.update_batch(items)
+        roots = set()
+        for part, _fh in tables[0].replication.partitions():
+            for t in tables:
+                roots.add((part,
+                           bytes(t.merkle.partition_root_hash(part))))
+        # one root tuple per partition == both nodes agree everywhere
+        agreed = len(roots) == len(tables[0].replication.partitions())
+        return wall, agreed
+
+    out = {}
+    stores = []
+    for mode, batch in (("pernode", 1), ("batched", 0)):
+        systems, tables, syncers = await mk_pair(mode)
+        if batch:
+            for s in syncers:
+                s.sync_batch_nodes = 1
+        wall, agreed = await converge(tables, syncers)
+        out[f"sync_{mode}_s"] = round(wall, 2)
+        out[f"sync_{mode}_rpc_rounds"] = syncers[0].node_rpcs
+        out[f"sync_{mode}_roots_agree"] = agreed
+        stores.append(dict(tables[1].data.store.items()))
+        for s in systems:
+            await s.netapp.shutdown()
+    out["sync_objects"] = META_SYNC_OBJECTS
+    out["sync_rpc_ratio"] = round(
+        out["sync_pernode_rpc_rounds"]
+        / max(1, out["sync_batched_rpc_rounds"]), 1)
+    out["sync_stores_identical"] = stores[0] == stores[1]
+    return out
+
+
+async def _metadata_phase_async() -> dict:
+    """--metadata-phase: the metadata plane at production cardinality."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="garage_tpu_bench_meta_"))
+    try:
+        garages, server, port, kid, secret = await _mk_cluster(
+            tmp, n=1, repl="none", codec_cfg={"backend": "cpu"},
+            db="native")
+        g = garages[0]
+        out = {"meta_objects": META_OBJECTS}
+        async with aiohttp.ClientSession() as session:
+            s3 = _S3(session, port, kid, secret)
+            buckets = [f"meta{b}" for b in range(8)]
+            for b in buckets:
+                st, _b, _h = await s3.req("PUT", f"/{b}")
+                assert st == 200, st
+            helper = g.helper()
+            bucket_ids = [await helper.resolve_global_bucket_name(b)
+                          for b in buckets]
+
+            # --- load: straight through the table update transaction
+            # (the metadata plane under test), Merkle worker draining
+            # live through the batched path + codec feeder
+            def load(lo, hi):
+                data = g.object_table.data
+                for i in range(lo, hi):
+                    data.update_entry(_meta_mk_object(
+                        bucket_ids[i % 8], _meta_key(i),
+                        1_000_000 + i).encode())
+
+            t0 = time.perf_counter()
+            await asyncio.to_thread(load, 0, META_OBJECTS)
+            load_s = time.perf_counter() - t0
+            while g.object_table.data.merkle_todo_len() > 0:
+                await asyncio.sleep(0.2)
+            pipeline_s = time.perf_counter() - t0
+            out["meta_load_s"] = round(load_s, 1)
+            out["meta_insert_per_s"] = round(META_OBJECTS / load_s, 1)
+            out["meta_pipeline_objects_per_s"] = round(
+                META_OBJECTS / pipeline_s, 1)
+            out["meta_merkle_residual_drain_s"] = round(
+                pipeline_s - load_s, 1)
+            assert g.object_table.data.store_len() >= META_OBJECTS
+
+            # --- paired Merkle A/B (offline tables, identical churn)
+            out.update(_meta_merkle_ab(g.system))
+            assert out["merkle_bit_identical"], "batched tree diverged"
+
+            # --- listing p50/p99, serial vs sharded, three prefixes
+            out.update(await _meta_listing_ab(s3, garages, "meta0"))
+
+            # --- churn + counter exactness
+            rng = np.random.default_rng(14)
+            victims = sorted(
+                int(i) * 8 for i in rng.choice(
+                    META_OBJECTS // 8, size=min(2000, META_OBJECTS // 16),
+                    replace=False))
+            for i in victims:
+                st, _b, _h = await s3.req(
+                    "DELETE", f"/meta0/{_meta_key(i)}")
+                assert st in (200, 204), st
+            reinserted = victims[: len(victims) // 2]
+
+            def reinsert():
+                from garage_tpu.utils.crdt import now_msec
+
+                data = g.object_table.data
+                # versions must postdate the S3 delete markers (stamped
+                # now_msec) or the CRDT merge prunes them as stale
+                ts0 = now_msec() + 60_000
+                for j, i in enumerate(reinserted):
+                    data.update_entry(_meta_mk_object(
+                        bucket_ids[0], _meta_key(i), ts0 + j).encode())
+
+            await asyncio.to_thread(reinsert)
+            for _ in range(600):
+                if (g.object_table.data.merkle_todo_len() == 0
+                        and all(len(t.data.insert_queue) == 0
+                                for t in g.tables)):
+                    break
+                await asyncio.sleep(0.1)
+
+            # live rows in bucket 0, counted from the store itself
+            def live_count(bucket_id) -> int:
+                from garage_tpu.table.schema import hash_partition_key
+
+                data = g.object_table.data
+                pfx = bytes(hash_partition_key(bucket_id))
+                n = 0
+                pos = pfx
+                while True:
+                    page = data.store.range_scan(pos, None, 4096)
+                    for k, v in page:
+                        if not k.startswith(pfx):
+                            return n
+                        if data.decode_entry(v).last_data_version() \
+                                is not None:
+                            n += 1
+                    if len(page) < 4096:
+                        return n
+                    pos = page[-1][0] + b"\x00"
+
+            expect0 = (META_OBJECTS + 7) // 8 - len(victims) \
+                + len(reinserted)
+            live0 = await asyncio.to_thread(live_count, bucket_ids[0])
+            totals0 = await g.object_counter.get_totals(
+                bytes(bucket_ids[0]))
+            totals1 = await g.object_counter.get_totals(
+                bytes(bucket_ids[1]))
+            drift = sum(abs(t.data.merkle_todo.reconcile())
+                        + abs(t.data.insert_queue.reconcile())
+                        + abs(t.data.gc_todo.reconcile())
+                        for t in g.tables)
+            out["meta_churned"] = len(victims)
+            out["meta_reinserted"] = len(reinserted)
+            out["meta_bucket0_live"] = live0
+            out["meta_bucket0_counter"] = totals0.get("objects", 0)
+            out["meta_bucket1_counter"] = totals1.get("objects", 0)
+            out["meta_counters_exact"] = (
+                live0 == expect0 == totals0.get("objects", 0)
+                and totals1.get("objects", 0) == (META_OBJECTS + 6) // 8)
+            out["meta_counted_tree_drift"] = drift
+            assert out["meta_counters_exact"], (
+                live0, expect0, totals0, totals1)
+            assert drift == 0, drift
+
+        # --- cold-node sync convergence A/B (bare 2-node pairs)
+        out.update(await _meta_sync_ab(tmp))
+        assert out["sync_batched_roots_agree"] \
+            and out["sync_pernode_roots_agree"]
+        assert out["sync_stores_identical"]
+        assert out["sync_rpc_ratio"] >= 10.0, out["sync_rpc_ratio"]
+
+        # paired win-or-tie contract (generous noise slack on a shared
+        # 1-core host; the structural wins are multiples, not percents)
+        assert out["merkle_batched_speedup"] >= 0.95, out
+        for name in ("root_page", "dir_walk", "delimiter"):
+            assert out[f"{name}_sharded_p50_ms"] <= \
+                1.25 * out[f"{name}_serial_p50_ms"] + 2.0, (name, out)
+
+        out.update(_phase_critical_path(garages, "meta"))
+        await server.stop()
+        for g2 in garages:
+            await g2.shutdown()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 _PHASES = {
     "--put-phase": _put_phase_async,
     "--put-solo-phase": _put_solo_phase_async,
@@ -1964,6 +2372,7 @@ _PHASES = {
     "--overload-phase": _overload_phase_async,
     "--tenants-phase": _tenants_phase_async,
     "--transport-phase": _transport_phase_async,
+    "--metadata-phase": _metadata_phase_async,
 }
 
 
@@ -2344,6 +2753,11 @@ def main() -> None:
     out.update(run_phase_subprocess("--transport-phase"))
     emit()
     out.update(run_phase_subprocess("--wan-phase"))
+    emit()
+    # metadata plane at 1M objects: load + live batched-Merkle drain +
+    # listing/sync A/B — the longest cluster phase, so it runs after
+    # every latency-sensitive phase already checkpointed
+    out.update(run_phase_subprocess("--metadata-phase", timeout=1800))
     emit()
 
     baseline = max(baseline, bench_reference_serial(batches))

@@ -10,6 +10,7 @@ from the MPU row.
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import xml.etree.ElementTree as ET
 from typing import List, Optional, Tuple
@@ -52,6 +53,207 @@ def _after_prefix(p: str) -> str:
     return p + "\x00"
 
 
+class _ShardScanner:
+    """Bucket-sharded listing driver ([table] list_shards).
+
+    Serves ordered pages of a bucket enumeration.  The first page is the
+    serial walk (a listing that fits one page pays zero extra RPCs); once
+    it comes back full, the remaining keyspace fans out across disjoint
+    sub-ranges whose first pages fetch CONCURRENTLY — each sub-range is
+    its own quorum read with its own continuation cursor, so a deep
+    enumeration pipelines its round-trips instead of paying one at a
+    time.  Pages are consumed strictly in boundary order (shard i only
+    serves after shards < i exhausted), so emission order and
+    continuation semantics are identical to the serial walk; skewed key
+    distributions only lose the prefetch win, never correctness."""
+
+    def __init__(self, ctx, prefix: str):
+        g = ctx.garage
+        self.table = g.object_table
+        self.bucket_id = ctx.bucket_id
+        self.prefix = prefix
+        tcfg = getattr(getattr(g, "config", None), "table", None)
+        self.n = max(1, int(getattr(tcfg, "list_shards", 1) or 1))
+        self.shards = None  # lazy fan-out after the first full page
+        self.pages = 0
+        self.fanned_out = False
+        # adaptive speculation: sequential consumers keep the next page
+        # in flight; a walk whose jumps outrun whole pages (delimiter
+        # strides wider than PAGE keys) turns it off and seeks straight
+        # to each requested position instead of paying a mostly-missed
+        # page per jump
+        self._prefetch_on = True
+        m = getattr(g.system, "metrics", None)
+        if m is not None:
+            self._m_pages = m.histogram(
+                "api_list_pages",
+                "Table range pages fetched per ListObjects-family "
+                "enumeration",
+                buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0))
+            self._m_fanout = m.counter(
+                "api_list_fanout_total",
+                "Listings that fanned out across sharded sub-range "
+                "scans (vs served by the serial first page)")
+        else:
+            self._m_pages = self._m_fanout = None
+
+    async def _fetch(self, pos: str, end=None):
+        self.pages += 1
+        return await self.table.get_range(
+            self.bucket_id, pos, filter="any", limit=PAGE,
+            end_sort_key=end)
+
+    def _fan_out(self, batch, fetch_pos: str) -> None:
+        # split points on the first code point after the user prefix,
+        # evenly spaced over printable ASCII — correctness never depends
+        # on balance (the last shard is unbounded above, the first
+        # starts at the serial cursor), only the prefetch win does
+        start_pos = batch[-1].key + "\x00"
+        lo, hi = 0x21, 0x7F
+        bounds = sorted({
+            self.prefix + chr(lo + (hi - lo) * i // self.n)
+            for i in range(1, self.n)
+        })
+        bounds = [b for b in bounds if b > start_pos]
+        ends = bounds + [None]
+        # the serial first page becomes the first shard's buffer: a
+        # delimiter jump back into its discarded tail is served from it,
+        # never skipped past
+        self.shards = [
+            {
+                "start": fetch_pos,
+                "end": ends[0],
+                "buf": batch,            # last completed page, servable
+                "buf_start": fetch_pos,  # position it was fetched from
+                "task_start": start_pos,
+                "task": asyncio.ensure_future(
+                    self._fetch(start_pos, ends[0])),
+            }
+        ] + [
+            {
+                "start": s,
+                "end": e,
+                "buf": None,
+                "buf_start": None,
+                "task_start": s,
+                "task": asyncio.ensure_future(self._fetch(s, e)),
+            }
+            for s, e in zip(bounds, ends[1:])
+        ]
+        # everything below this key is proven fully enumerated (chained
+        # through exhausted shards) — what makes a boundary-anchored
+        # speculative page safe to serve at a shard handoff
+        self._covered_to = fetch_pos
+        self.fanned_out = True
+        if self._m_fanout is not None:
+            self._m_fanout.inc()
+
+    def _usable_from(self, sh, pos: str, at) -> bool:
+        # a page fetched from `at` serves `pos` when it starts at or
+        # before it (anything it skipped is < pos, which the caller
+        # already consumed), or at this shard's boundary with everything
+        # below the boundary proven enumerated — anything else would
+        # silently skip every key in [pos, at)
+        return at is not None and (
+            at <= pos
+            or (at == sh["start"] and self._covered_to == sh["start"]))
+
+    async def page(self, pos: str):
+        """(objects with key >= pos in key order, done) — `done` means
+        the enumeration is complete after this (possibly empty) page."""
+        if self.shards is None:
+            batch = await self._fetch(pos)
+            if len(batch) < PAGE or self.n <= 1:
+                return batch, len(batch) < PAGE
+            self._fan_out(batch, pos)
+            return batch, False
+        # The caller may re-request from ANY pos after the one it last
+        # asked for (a delimiter jump discards the tail of the returned
+        # batch and resumes after the common prefix — possibly BEHIND
+        # keys it was already handed).  So each shard KEEPS its last
+        # fetched page: re-requests into the tail are served from the
+        # buffer instead of paying a fresh quorum fetch per jump, and a
+        # shard only retires once its buffered tail proves there is no
+        # key at or after pos — never while a jump could still land in
+        # it.
+        while self.shards:
+            sh = self.shards[0]
+            if sh["end"] is not None and pos >= sh["end"]:
+                # the jump moved past this whole shard
+                self._cancel(sh)
+                self.shards.pop(0)
+                continue
+            buf = sh["buf"]
+            if buf is not None:
+                if not self._usable_from(sh, pos, sh["buf_start"]):
+                    # pos regressed behind the buffer — start over at pos
+                    sh["buf"] = sh["buf_start"] = None
+                    self._cancel(sh)
+                    continue
+                out = [o for o in buf if o.key >= pos]
+                if out:
+                    return out, False
+                if len(buf) < PAGE:
+                    # bounded partial page: no key in [pos, end) at all
+                    self._cancel(sh)
+                    if sh["end"] is None:
+                        return [], True
+                    self._covered_to = sh["end"]
+                    self.shards.pop(0)
+                    continue
+                # pos is past the full buffered page
+                if pos == buf[-1].key + "\x00":
+                    # pure sequential continuation: speculation pays
+                    self._prefetch_on = True
+                else:
+                    # long jump: the next sequential page mostly misses
+                    # — stop speculating and seek straight to pos,
+                    # unless a speculative page already finished (then
+                    # trying it is free)
+                    self._prefetch_on = False
+                    t0 = sh["task"]
+                    if t0 is not None and not t0.done():
+                        self._cancel(sh)
+            t = sh["task"]
+            if t is not None and not self._usable_from(
+                    sh, pos, sh["task_start"]):
+                self._cancel(sh)
+                t = None
+            if t is None:
+                sh["task_start"] = pos
+                sh["task"] = asyncio.ensure_future(
+                    self._fetch(pos, sh["end"]))
+            fetched_from = sh["task_start"]
+            page = await sh["task"]
+            sh["task"] = None
+            sh["buf"], sh["buf_start"] = page, fetched_from
+            if len(page) == PAGE and self._prefetch_on:
+                # prefetch the next page while the caller consumes this
+                # one — jumps within the buffer don't invalidate it, and
+                # a short jump past it lands inside the prefetched
+                # page's range, so speculation is almost always consumed
+                nxt = page[-1].key + "\x00"
+                sh["task_start"] = nxt
+                sh["task"] = asyncio.ensure_future(
+                    self._fetch(nxt, sh["end"]))
+            # loop: the buffer branch serves (or retires) from the new
+            # page
+        return [], True
+
+    @staticmethod
+    def _cancel(sh) -> None:
+        t = sh.get("task")
+        if t is not None and not t.done():
+            t.cancel()
+        sh["task"] = None
+
+    def close(self) -> None:
+        if self._m_pages is not None and self.pages:
+            self._m_pages.observe(float(self.pages))
+        for sh in self.shards or ():
+            self._cancel(sh)
+
+
 async def _collect(
     ctx,
     prefix: str,
@@ -67,17 +269,28 @@ async def _collect(
     to the client (v1 semantics — suppresses a re-emitted common prefix).
     Returns (entries, prefixes, truncated, last_returned) where entries =
     [(key, version…)] in key order."""
-    garage = ctx.garage
     entries: List[Tuple[str, object]] = []
     prefixes: List[str] = []
     last_returned: Optional[str] = None
     if pos is None:
         pos = prefix
 
-    while True:
-        batch = await garage.object_table.get_range(
-            ctx.bucket_id, pos, filter="any", limit=PAGE
+    scanner = _ShardScanner(ctx, prefix)
+    try:
+        return await _collect_inner(
+            scanner, prefix, delimiter, pos, max_keys, marker,
+            uploads, upload_id_marker, entries, prefixes, last_returned,
         )
+    finally:
+        scanner.close()
+
+
+async def _collect_inner(
+    scanner, prefix, delimiter, pos, max_keys, marker, uploads,
+    upload_id_marker, entries, prefixes, last_returned,
+):
+    while True:
+        batch, done = await scanner.page(pos)
         jumped = False
         for obj in batch:
             k = obj.key
@@ -132,7 +345,7 @@ async def _collect(
                 last_returned = ("key", k)
         if jumped:
             continue
-        if len(batch) < PAGE:
+        if done:
             return entries, prefixes, False, last_returned
         pos = batch[-1].key + "\x00"
 

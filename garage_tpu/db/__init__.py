@@ -78,6 +78,29 @@ class IDb:
             return kv
         return None
 
+    def range_scan(
+        self,
+        tree: int,
+        start: Optional[bytes],
+        end: Optional[bytes],
+        limit: int,
+        reverse: bool = False,
+    ) -> List[Tuple[bytes, bytes]]:
+        """One materialized page of at most `limit` rows — the building
+        block of paged/parallel scans: engines answer it in a single
+        seek + bounded read (sqlite: one LIMIT query; memory: one slice
+        under the lock) instead of a chunked cursor walk per row.
+        Callers resume with start = last_key + b"\\x00" (forward) or
+        end = last_key (reverse)."""
+        out: List[Tuple[bytes, bytes]] = []
+        if limit <= 0:
+            return out
+        for kv in self.iter_range(tree, start, end, reverse):
+            out.append(kv)
+            if len(out) >= limit:
+                break
+        return out
+
     def transaction(self, fn: Callable[["Transaction"], T]) -> T:
         raise NotImplementedError
 
@@ -164,6 +187,16 @@ class Tree:
         self, start: Optional[bytes] = None, end: Optional[bytes] = None
     ) -> Iterator[Tuple[bytes, bytes]]:
         return self.db.backend.iter_range(self.idx, start, end, reverse=True)
+
+    def range_scan(
+        self,
+        start: Optional[bytes] = None,
+        end: Optional[bytes] = None,
+        limit: int = 1000,
+        reverse: bool = False,
+    ) -> List[Tuple[bytes, bytes]]:
+        """One page of at most `limit` rows (see IDb.range_scan)."""
+        return self.db.backend.range_scan(self.idx, start, end, limit, reverse)
 
     def get_gt(self, key: bytes) -> Optional[Tuple[bytes, bytes]]:
         """First entry with key strictly greater (cursor-style resumable

@@ -53,6 +53,28 @@ class CountedTree:
     def get_gt(self, key: bytes) -> Optional[Tuple[bytes, bytes]]:
         return self.tree.get_gt(key)
 
+    def range_scan(self, start=None, end=None, limit: int = 1000,
+                   reverse: bool = False) -> list:
+        return self.tree.range_scan(start, end, limit, reverse)
+
+    def reconcile(self) -> int:
+        """Re-count from the tree and return the drift the cached
+        counter had accumulated (0 = exact).  The metadata-at-millions
+        accuracy check: worker gauges and scheduling decisions read the
+        cached count, so any drift under delete+reinsert churn is a
+        first-class bug — bench/tests assert reconcile() == 0 after
+        churn, and a production caller can use it as a self-repair."""
+        # length read under the counter lock: every adapter runs
+        # on_commit hooks (the only other _lock takers) AFTER releasing
+        # its own lock, so this nesting cannot deadlock — and a length
+        # read outside the lock could install a stale count, turning the
+        # self-repair into the drift it is meant to fix
+        with self._lock:
+            real = len(self.tree)
+            drift = self._count - real
+            self._count = real
+        return drift
+
     def _add(self, delta: int) -> None:
         with self._lock:
             self._count += delta

@@ -423,6 +423,30 @@ class MemoryDb(IDb):
             if v is not None:
                 yield k, v
 
+    def range_scan(
+        self,
+        tree: int,
+        start: Optional[bytes],
+        end: Optional[bytes],
+        limit: int,
+        reverse: bool = False,
+    ) -> List[Tuple[bytes, bytes]]:
+        # one slice + gather under a single lock hold: the per-key
+        # lock round-trip of iter_range is what a page-sized scan pays
+        # for a consistency it does not need
+        if limit <= 0:
+            return []
+        with self._lock:
+            t = self._trees[tree]
+            lo = 0 if start is None else bisect.bisect_left(t.keys, start)
+            hi = (len(t.keys) if end is None
+                  else bisect.bisect_left(t.keys, end))
+            if reverse:
+                ks = t.keys[max(lo, hi - limit):hi][::-1]
+            else:
+                ks = t.keys[lo:min(hi, lo + limit)]
+            return [(k, t.data[k]) for k in ks]
+
     def transaction(self, fn: Callable[[Transaction], object]):
         with self._lock:
             tx = _MemTx(self)

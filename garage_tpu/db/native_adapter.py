@@ -218,6 +218,25 @@ class NativeDb(IDb):
         finally:
             self._lib.ldb_iter_free(it)
 
+    def range_scan(
+        self,
+        tree: int,
+        start: Optional[bytes],
+        end: Optional[bytes],
+        limit: int,
+        reverse: bool = False,
+    ) -> List[Tuple[bytes, bytes]]:
+        # one native iterator, freed after `limit` rows — the in-RAM
+        # ordered index seeks once and preads values on demand
+        if limit <= 0:
+            return []
+        out: List[Tuple[bytes, bytes]] = []
+        for kv in self.iter_range(tree, start, end, reverse):
+            out.append(kv)
+            if len(out) >= limit:
+                break
+        return out
+
     def transaction(self, fn: Callable[[Transaction], object]):
         with self._lock:
             tx = _NativeTx(self)

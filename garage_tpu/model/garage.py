@@ -320,6 +320,10 @@ class Garage:
 
     def spawn_workers(self) -> None:
         for t in self.tables:
+            # batched Merkle hashing rides the codec feeder's ragged
+            # mhash path (class bg) — the trie drain shares the data
+            # plane's batching engine instead of hashing node-at-a-time
+            t.merkle.feeder = self.block_manager.feeder
             t.syncer = TableSyncer(self.system, t.data, t.merkle)
             t.gc = TableGc(self.system, t.data)
             self.bg.spawn(MerkleWorker(t.merkle))

@@ -219,6 +219,37 @@ class BlockCodec:
             i += len(g)
         return out
 
+    def mhash_batch(self, bufs: Sequence[bytes]) -> List[Hash]:
+        """Metadata (Merkle trie node/key) hashing: BLAKE2b-256, the
+        table engine's `blake2sum` — bit-identical to the serial
+        per-node path by construction.  Kept separate from batch_hash
+        because block content hashes are BLAKE2s (the device kernel's
+        algorithm) while the Merkle trie is BLAKE2b: mixing them in one
+        device batch would either change the trie hash (a rolling-
+        upgrade divergence: mixed-version replicas could never agree on
+        node hashes for identical data) or silently fall back.  The
+        hashing itself is hashlib per buffer; the feeder route buys one
+        dispatch + one observability record per batch and is the single
+        seam a future multi-buffer BLAKE2b kernel drops into."""
+        from ..utils.data import blake2sum
+
+        return [blake2sum(b) for b in bufs]
+
+    def mhash_ragged(self, groups: Sequence[Sequence[bytes]]
+                     ) -> List[List[Hash]]:
+        """Per-submission metadata-hash lists in one mhash_batch pass
+        (the Merkle updater/syncer's feeder entry point)."""
+        flat: List[bytes] = [b for g in groups for b in g]
+        if not flat:
+            return [[] for _ in groups]
+        digs = self.mhash_batch(flat)
+        out: List[List[Hash]] = []
+        i = 0
+        for g in groups:
+            out.append(digs[i:i + len(g)])
+            i += len(g)
+        return out
+
     def rs_encode_ragged(self, groups: Sequence[Sequence[bytes]]
                          ) -> List[np.ndarray]:
         """RS parity for many submissions in ONE pass over the

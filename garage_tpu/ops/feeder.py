@@ -51,7 +51,7 @@ import numpy as np
 
 logger = logging.getLogger("garage_tpu.ops.feeder")
 
-KINDS = ("hash", "encode", "decode", "scrub")
+KINDS = ("hash", "encode", "decode", "scrub", "mhash")
 
 # histogram edges tuned to the objects being measured: waits are bounded
 # by slo_ms (default 2 ms), batch sizes by max_batch_blocks
@@ -286,6 +286,20 @@ class CodecFeeder:
             max(1, int(shards.shape[0])), int(shards.nbytes), peers=peers,
             cls=cls))
 
+    def submit_mhash(self, bufs: Sequence[bytes],
+                     peers: Optional[int] = None, cls: str = "bg"):
+        """Metadata (Merkle node/key) BLAKE2b hashing for the table
+        engine — the trie updater and the sync descent submit whole node
+        batches here instead of hashing one node at a time.  Always
+        dispatched on the CPU side (the trie hash is BLAKE2b; the device
+        kernel is BLAKE2s — see BlockCodec.mhash_batch), class bg so a
+        Merkle backlog drain never preempts foreground codec batches.
+        Future resolves to List[Hash] in submission order."""
+        bufs = list(bufs)
+        return self._submit(_Item(
+            "mhash", bufs, len(bufs), sum(len(b) for b in bufs),
+            peers=peers, cls=cls))
+
     def submit_scrub(self, blocks: Sequence[bytes], hashes: Sequence,
                      want_parity: bool = True, cls: str = "bg"):
         """One scrub/resync batch (scrub_encode_batch semantics: fused
@@ -408,13 +422,22 @@ class CodecFeeder:
                     # the deadline out when all its expected peers have
                     # already arrived
                     fg = [it for it in self._pending if it.cls == "fg"]
-                    hints = [it.peers for it in fg]
+                    # a PURELY background window where every submitter
+                    # hinted (the Merkle updater's mhash batches pass
+                    # peers=1) may short-circuit on its own hints — the
+                    # updater blocks on each batch, so sleeping the SLO
+                    # out per batch is pure added drain latency.  Scrub
+                    # deliberately never hints (peers=None coalesces a
+                    # repair storm over the full window), so any
+                    # co-pending scrub still holds the deadline.
+                    pool = fg if fg else list(self._pending)
+                    hints = [it.peers for it in pool]
                     if hints and None not in hints:
                         want = max(hints)
                         if want <= 1:
                             reason = "lone"
                             break
-                        if len(fg) >= want:
+                        if len(pool) >= want:
                             reason = "peers"
                             break
                     left = deadline - time.perf_counter()
@@ -468,7 +491,8 @@ class CodecFeeder:
         side = getattr(self.codec, "ragged_side", lambda: "cpu")()
         all_items = [it for its in by_kind.values() for it in its]
         if (side == "cpu" and all_items
-                and all(it.cls == "bg" for it in all_items)):
+                and all(it.cls == "bg" for it in all_items)
+                and any(it.kind != "mhash" for it in all_items)):
             # a PURELY background batch against a closed/unprobed gate
             # pays the (TTL-cached) link probe — the old stealing feeder
             # probed every scrub pass; with scrub riding this queue the
@@ -502,7 +526,10 @@ class CodecFeeder:
             # resolves the items' futures (and counts their bytes) at
             # collect.  A closed/absent transport, or one the device
             # codec cannot serve for this kind, dispatches inline below.
-            if side == "tpu":
+            if side == "tpu" and kind != "mhash":
+                # mhash (Merkle BLAKE2b) is CPU-only by contract — the
+                # device kernel hashes BLAKE2s and must never route a
+                # trie batch (see BlockCodec.mhash_batch)
                 tr = getattr(self.codec, "transport", None)
                 if tr is not None and tr.alive and tr.supports(kind):
                     try:
@@ -530,10 +557,16 @@ class CodecFeeder:
                 continue
             t_disp_mono = time.monotonic_ns()
             t_disp_ns = time.time_ns()
+            # metadata hashing is CPU-side even when the gate is open —
+            # its bytes must not count as device traffic
+            kside = "cpu" if kind == "mhash" else side
             try:
-                with self.obs.stage("feeder_dispatch", side):
+                with self.obs.stage("feeder_dispatch", kside):
                     if kind == "hash":
                         results = self.codec.hash_ragged(
+                            [it.payload for it in items])
+                    elif kind == "mhash":
+                        results = self.codec.mhash_ragged(
                             [it.payload for it in items])
                     elif kind == "encode":
                         results = self.codec.rs_encode_ragged(
@@ -541,7 +574,7 @@ class CodecFeeder:
                     else:
                         results = self.codec.rs_reconstruct_ragged(
                             [it.payload for it in items])
-                self.obs.add_bytes(side, sum(it.nbytes for it in items))
+                self.obs.add_bytes(kside, sum(it.nbytes for it in items))
             except BaseException as e:  # noqa: BLE001 — fan the error out
                 for it in items:
                     if not it.future.done():
@@ -551,7 +584,7 @@ class CodecFeeder:
             self.obs.timeline.event(
                 f"dispatch {kind}", "feeder", t_disp_mono,
                 time.monotonic_ns(), cat="feeder", blocks=nblocks,
-                reason=reason, side=side)
+                reason=reason, side=kside)
             tracer = self.obs.tracer
             if tracer is not None:
                 # the inline compute is a CHILD of each item's feeder
@@ -561,7 +594,7 @@ class CodecFeeder:
                     if it.tctx is not None:
                         tracer.record_span(
                             f"Codec {kind}", it.tctx.trace_id,
-                            it.span_id, t_disp_ns, end_ns, side=side,
+                            it.span_id, t_disp_ns, end_ns, side=kside,
                             blocks=nblocks)
             for it, res in zip(items, results):
                 if not it.future.done():

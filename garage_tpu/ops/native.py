@@ -225,3 +225,52 @@ def get_native_blake2s_multi() -> Optional[Callable[[Sequence[bytes]], List[byte
 
     _b2_fn = fn
     return _b2_fn
+
+
+_b2_rows_resolved = False
+_b2_rows_fn: Optional[Callable] = None
+
+
+def get_native_blake2s_rows() -> Optional[Callable]:
+    """Strided in-place variant of the multi-buffer kernel: hash the
+    first `n` rows of a C-contiguous uint8 matrix WITHOUT materializing
+    per-row bytes copies — the lane pointers index straight into the
+    (lane-aligned-stride) staging buffer where the rows already lie.
+    This is the CPU-floor close of the SIMD-friendly staging layout:
+    c_char_p only accepts bytes, so consuming a staging buffer through
+    the plain wrapper costs one full copy pass per row.
+
+    Returns fn(arr_2d, lengths, n) -> [32-byte digests], or None when
+    the kernel is unavailable (callers fall back to hashlib over row
+    views, which are zero-copy too, just not multi-buffer)."""
+    global _b2_rows_resolved, _b2_rows_fn
+    if _b2_rows_resolved:
+        return _b2_rows_fn
+    _b2_rows_resolved = True
+    if get_native_blake2s_multi() is None:
+        return None
+    lib = _load_or_build("libblake2smb.so", "blake2s_mb.cpp")
+
+    def fn(arr, lengths, n: int) -> List[bytes]:
+        if n == 0:
+            return []
+        assert arr.dtype.itemsize == 1 and arr.flags["C_CONTIGUOUS"]
+        stride = arr.strides[0]
+        base = arr.ctypes.data
+        order = sorted(range(n), key=lambda i: int(lengths[i]))
+        ptrs = (ctypes.c_char_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        for pos, i in enumerate(order):
+            ptrs[pos] = ctypes.cast(base + i * stride, ctypes.c_char_p)
+            lens[pos] = int(lengths[i])
+        out = (ctypes.c_uint8 * (32 * n))()
+        lib.blake2s256_multi(ptrs, lens,
+                             ctypes.cast(out, ctypes.c_void_p), n)
+        raw = bytes(out)
+        digests: List[bytes] = [b""] * n
+        for pos, i in enumerate(order):
+            digests[i] = raw[pos * 32:(pos + 1) * 32]
+        return digests
+
+    _b2_rows_fn = fn
+    return _b2_rows_fn

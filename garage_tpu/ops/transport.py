@@ -641,6 +641,7 @@ class DeviceTransport:
             self._collect_oldest()
 
     def _stage_and_submit(self, batch: _Batch, slot: int) -> None:
+        staged = None
         try:
             batch.t_stage0 = time.monotonic_ns()
             with self.obs.stage("host_staging", "tpu"):
@@ -673,11 +674,14 @@ class DeviceTransport:
             if self.m_staged is not None:
                 self.m_staged.inc(batch.nbytes, copies="1")
         except BaseException as e:  # noqa: BLE001 — device down ≠ caller down
+            self._device_failed("submit", e)
+            # absorb BEFORE releasing the slot: the hash fallback reads
+            # the staged rows in place, and the worker thread only
+            # reuses a slot buffer after this returns
+            self._absorb_on_cpu(batch, e, staged=staged)
             with self._cond:
                 self._slot_free.append(slot)
                 self._cond.notify_all()
-            self._device_failed("submit", e)
-            self._absorb_on_cpu(batch, e)
 
     def _collect_oldest(self) -> None:
         with self._cond:
@@ -832,11 +836,24 @@ class DeviceTransport:
         if lo is not None:
             arr[lo:lanes] = 0
 
+    # SIMD-friendly staging layout (the ROADMAP CPU-floor item): hash
+    # rows are placed at strides that are a multiple of this, so the
+    # multi-buffer CPU hash (ops/native.py get_native_blake2s_rows) can
+    # consume a staged batch IN PLACE — lane pointers into the buffer,
+    # no per-row bytes materialization — when a device failure absorbs
+    # the batch on the CPU.  64 B = one AVX-512 vector / cache line.
+    HASH_ROW_ALIGN = 64
+
     def _geometry(self, nlanes: int, maxlen: int, kind: str):
         geom = getattr(self.device, "staging_geometry", None)
-        if geom is not None:
-            return geom(nlanes, maxlen, kind)
-        return nlanes, maxlen
+        lanes, cols = (geom(nlanes, maxlen, kind) if geom is not None
+                       else (nlanes, maxlen))
+        if kind == "hash":
+            # applied INSIDE _geometry so the budget estimator and the
+            # actual staging agree on the bucketed row width (device
+            # power-of-two widths >= 64 are already aligned: no-op)
+            cols += (-cols) % self.HASH_ROW_ALIGN
+        return lanes, cols
 
     def _stage(self, batch: _Batch, slot: int):
         kind = batch.kind
@@ -990,9 +1007,14 @@ class DeviceTransport:
 
     # --- CPU absorption of device failures ----------------------------------
 
-    def _absorb_on_cpu(self, batch: _Batch, cause: BaseException) -> None:
+    def _absorb_on_cpu(self, batch: _Batch, cause: BaseException,
+                       staged=None) -> None:
         """A failed device batch degrades to an inline CPU computation —
-        zero caller-visible errors — unless no fallback codec exists."""
+        zero caller-visible errors — unless no fallback codec exists.
+        A hash batch that already reached staging is consumed IN PLACE
+        (`staged`): the rows sit at lane-aligned strides, so the
+        multi-buffer CPU hash runs straight over the staging buffer
+        instead of re-reading the original payloads."""
         cpu = self.fallback
         if cpu is None:
             for part in batch.parts:
@@ -1001,6 +1023,9 @@ class DeviceTransport:
         self.fallbacks += 1
         self.obs.event("transport_fallback", reason=batch.kind,
                        blocks=batch.blocks)
+        if batch.kind == "hash" and staged is not None:
+            if self._absorb_hash_staged(batch, staged):
+                return
         for part in batch.parts:
             it = part.item
             try:
@@ -1028,6 +1053,39 @@ class DeviceTransport:
                 part.sink.deliver(part.index, res)
             except BaseException as e:  # noqa: BLE001
                 part.sink.fail(e)
+
+    def _absorb_hash_staged(self, batch: _Batch, staged) -> bool:
+        """Hash a staged batch's rows in place (SIMD-friendly staging
+        layout: lane-aligned strides, zero re-copies).  Returns False on
+        any surprise so the caller's payload-based fallback runs."""
+        try:
+            arr, lengths, spans = staged
+            total = spans[-1][0] + spans[-1][1] if spans else 0
+            from .native import get_native_blake2s_rows
+
+            rows_fn = get_native_blake2s_rows()
+            if rows_fn is not None:
+                raw = rows_fn(arr, lengths, total)
+            else:
+                import hashlib
+
+                # row views of a C-contiguous matrix are zero-copy too —
+                # just one lane at a time instead of 8/16
+                raw = [
+                    hashlib.blake2s(arr[r, :int(lengths[r])],
+                                    digest_size=32).digest()
+                    for r in range(total)
+                ]
+            digs = [Hash(d) for d in raw]
+            for part, (o, n) in zip(batch.parts, spans):
+                self.obs.add_bytes(
+                    "cpu", int(sum(int(x) for x in lengths[o:o + n])))
+                part.sink.deliver(part.index, digs[o:o + n])
+            return True
+        except BaseException:  # noqa: BLE001 — fall back to payloads
+            logger.warning("in-place staged hash fallback failed",
+                           exc_info=True)
+            return False
 
     # --- the gate's probe ---------------------------------------------------
 

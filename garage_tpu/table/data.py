@@ -10,7 +10,6 @@ is the partition leader (data.rs:198-267).
 
 from __future__ import annotations
 
-import asyncio
 import logging
 import struct
 from typing import Any, Callable, List, Optional, Tuple
@@ -18,6 +17,7 @@ from typing import Any, Callable, List, Optional, Tuple
 from ..db import Db, Transaction, Tree
 from ..db.counted_tree import CountedTree
 from ..rpc.system import System
+from ..utils.background import LoopSafeEvent
 from ..utils.crdt import now_msec
 from ..utils.data import Hash, blake2sum
 from .replication import TableReplication
@@ -46,9 +46,28 @@ class TableData:
         self.merkle_todo: CountedTree = CountedTree(db.open_tree(f"{name}:merkle_todo"))
         self.insert_queue: CountedTree = CountedTree(db.open_tree(f"{name}:insert_queue"))
         self.gc_todo: CountedTree = CountedTree(db.open_tree(f"{name}:gc_todo_v2"))
-        # notified when merkle_todo / insert_queue gain items
-        self.merkle_todo_notify = asyncio.Event()
-        self.insert_queue_notify = asyncio.Event()
+        # notified when merkle_todo / insert_queue gain items —
+        # LoopSafeEvent, not asyncio.Event: batched Merkle/queue passes
+        # commit from worker threads, and a plain Event set off-loop
+        # wakes nobody (the drainer would sleep out a full
+        # wait_for_work interval on a refill that landed mid-batch)
+        self.merkle_todo_notify = LoopSafeEvent()
+        self.insert_queue_notify = LoopSafeEvent()
+        # [table] tunables (None outside a full daemon: defaults)
+        tcfg = getattr(getattr(system, "config", None), "table", None)
+        self.scan_page = int(getattr(tcfg, "scan_page", 1024) or 1024)
+        m = getattr(system, "metrics", None)
+        if m is not None:
+            self._m_scan_pages = m.counter(
+                "table_scan_pages_total",
+                "range_scan pages served by the local table store, per "
+                "table")
+            self._m_scan_rows = m.counter(
+                "table_scan_rows_total",
+                "Rows scanned (before filtering) by local range reads, "
+                "per table")
+        else:
+            self._m_scan_pages = self._m_scan_rows = None
 
     # --- reads (ref data.rs:92-160) ---
 
@@ -68,34 +87,60 @@ class TableData:
         filter: Any,
         limit: int,
         reverse: bool = False,
+        end_sort_key: Optional[bytes] = None,
     ) -> List[bytes]:
-        """Encoded entries of one partition from `start_sort_key`, filtered
-        (ref data.rs:112-160)."""
-        first = bytes(partition_hash) + (start_sort_key or b"")
+        """Encoded entries of one partition from `start_sort_key`,
+        filtered (ref data.rs:112-160), bounded above (exclusive) by
+        `end_sort_key` — the sub-range contract sharded listings fan out
+        over.  Pages through Tree.range_scan: one engine seek + bounded
+        read per page instead of a per-row cursor walk."""
+        pfx = bytes(partition_hash)
+        first = pfx + (start_sort_key or b"")
         # partition keyspace upper bound: hash ‖ 0xff… is not representable,
         # so bound by incrementing the 32-byte prefix
-        end = _prefix_upper_bound(bytes(partition_hash))
+        end = _prefix_upper_bound(pfx)
+        if end_sort_key is not None:
+            bounded = pfx + end_sort_key
+            end = bounded if end is None else min(end, bounded)
         out: List[bytes] = []
         if reverse:
             # descending from the start sort key *inclusive* (ref
             # data.rs range_rev(..=first)); no start key = whole partition
-            rev_end = first + b"\x00" if start_sort_key is not None else end
-            it = self.store.items_rev(bytes(partition_hash), rev_end)
+            pos_hi = first + b"\x00" if start_sort_key is not None else end
+            lo = pfx
         else:
-            it = self.store.items(first, end)
-        for k, v in it:
-            if not k.startswith(bytes(partition_hash)):
-                break
-            try:
-                ent = self.decode_entry(v)
-            except Exception:
-                logger.exception("undecodable entry at %s", k.hex()[:16])
-                continue
-            if filter is None or self.schema.matches_filter(ent, filter):
-                out.append(v)
-                if len(out) >= limit:
-                    break
-        return out
+            pos = first
+        while True:
+            # floor of 64: a filter-heavy tail must not degenerate into
+            # one-row pages (the fetch is cheap; decode stops at limit)
+            page_size = max(min(limit - len(out), self.scan_page), 64)
+            if reverse:
+                page = self.store.range_scan(lo, pos_hi, page_size,
+                                             reverse=True)
+            else:
+                page = self.store.range_scan(pos, end, page_size)
+            if self._m_scan_pages is not None and page:
+                self._m_scan_pages.inc(table_name=self.schema.TABLE_NAME)
+                self._m_scan_rows.inc(
+                    len(page), table_name=self.schema.TABLE_NAME)
+            for k, v in page:
+                if not k.startswith(pfx):
+                    return out
+                try:
+                    ent = self.decode_entry(v)
+                except Exception:
+                    logger.exception("undecodable entry at %s", k.hex()[:16])
+                    continue
+                if filter is None or self.schema.matches_filter(ent, filter):
+                    out.append(v)
+                    if len(out) >= limit:
+                        return out
+            if len(page) < page_size:
+                return out
+            if reverse:
+                pos_hi = page[-1][0]
+            else:
+                pos = page[-1][0] + b"\x00"
 
     # --- mutations (ref data.rs:174-267) ---
 

@@ -53,6 +53,11 @@ class InsertQueueWorker(Worker):
         self.status().queue_length = len(data.insert_queue)
         return WorkerState.BUSY
 
+    # wait_for_work's len re-check plus the LoopSafeEvent notify
+    # (table/data.py) close the mid-batch-refill idle gap: an insert
+    # queued from a worker thread while a batch was in flight wakes the
+    # drainer instead of waiting out a full notify interval
+
     async def wait_for_work(self) -> None:
         data = self.table.data
         data.insert_queue_notify.clear()

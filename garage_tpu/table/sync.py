@@ -29,6 +29,7 @@ from .merkle import (
     EMPTY,
     EMPTY_HASH,
     MerkleUpdater,
+    _encode_node,
     _is_int,
     _is_leaf,
     int_children,
@@ -41,6 +42,7 @@ logger = logging.getLogger("garage_tpu.table.sync")
 ANTI_ENTROPY_INTERVAL = 600.0  # ref sync.rs:30 (10 min)
 BATCH_SIZE = 256               # ref sync.rs push batches
 OFFLOAD_BATCH = 1024
+SYNC_NODE_RPC_MAX = 65536      # server-side sanity cap on one get_nodes
 
 
 class TableSyncer:
@@ -53,6 +55,16 @@ class TableSyncer:
         )
         self.endpoint.set_handler(self._handle)
         self.worker: Optional[SyncWorker] = None
+        # [table] sync_batch_nodes: Merkle nodes shipped per descent RPC
+        # round (<= 1 restores the legacy one-node-per-round walk)
+        tcfg = getattr(getattr(system, "config", None), "table", None)
+        self.sync_batch_nodes = int(
+            getattr(tcfg, "sync_batch_nodes", 512) or 512)
+        # peers that answered `get_nodes` with unknown-rpc: mixed-version
+        # fallback to the per-node descent until the process restarts
+        self._peer_pernode: dict = {}
+        # cumulative descent RPC rounds (bench A/B evidence)
+        self.node_rpcs = 0
         # sync item counters (ref table/metrics.rs sync_items_sent/received)
         # — families shared across tables via registry name-dedup
         m = getattr(system, "metrics", None)
@@ -75,8 +87,20 @@ class TableSyncer:
                     "offload = partition handed to its new replicas, "
                     "error = round failed)"),
             }
+            self._m["node_rpcs"] = m.counter(
+                "table_sync_node_rpc_total",
+                "Merkle descent RPC rounds by mode (batched = whole "
+                "frontier per round, pernode = legacy one node per "
+                "round); the batched/pernode ratio is the convergence "
+                "win at cold-node join")
         else:
             self._m = None
+
+    def _node_rpc(self, mode: str) -> None:
+        self.node_rpcs += 1
+        if self._m is not None:
+            self._m["node_rpcs"].inc(
+                mode=mode, table_name=self.data.schema.TABLE_NAME)
 
     def _round(self, result: str) -> None:
         if self._m is not None:
@@ -136,6 +160,95 @@ class TableSyncer:
         if bytes(local_hash) == remote_hash:
             self._round("in_sync")
             return
+        bn = self.sync_batch_nodes
+        if bn <= 1 or self._peer_pernode.get(bytes(who)):
+            await self._descend_pernode(partition, who, root_nk)
+        else:
+            try:
+                await self._descend_batched(partition, who, root_nk, bn)
+            except GarageError as e:
+                if "unknown sync rpc" not in str(e):
+                    raise
+                # a pre-batching peer: remember it and walk per-node
+                self._peer_pernode[bytes(who)] = True
+                logger.info(
+                    "%s: peer lacks get_nodes; falling back to per-node "
+                    "descent", self.data.schema.TABLE_NAME)
+                await self._descend_pernode(partition, who, root_nk)
+        self._round("synced")
+
+    async def _descend_batched(self, partition: int, who: FixedBytes32,
+                               root_nk: bytes, batch_nodes: int) -> None:
+        """Breadth-wise batched descent: the whole differing frontier
+        ships in ≤ `batch_nodes` node sets per RPC round, so a cold
+        node's catch-up costs O(depth) round-trips instead of O(nodes).
+        Pushes the same item set as the per-node walk: the per-level
+        child-hash comparison is identical, only the fetch granularity
+        changes.  Leaf verification hashes ride the Merkle updater's
+        batched hash path (codec feeder, bg class)."""
+        frontier: List[bytes] = [root_nk]
+        to_send: List[bytes] = []
+        while frontier:
+            chunk, frontier = frontier[:batch_nodes], frontier[batch_nodes:]
+            lmap = {nk: self.merkle.read_node(None, nk) for nk in chunk}
+            # local EMPTY: remote has extra data; its own round pushes
+            ask = [nk for nk in chunk if lmap[nk] is not EMPTY]
+            if not ask:
+                continue
+            r = await self.endpoint.call(
+                who, {"t": "get_nodes", "nks": ask}, prio=PRIO_BACKGROUND
+            )
+            self._node_rpc("batched")
+            rnodes = r.get("nodes")
+            if not isinstance(rnodes, list) or len(rnodes) != len(ask):
+                raise GarageError(
+                    f"get_nodes answered {len(rnodes or [])} nodes "
+                    f"for {len(ask)}")
+            # batched sync-time node verification: every leaf pair's
+            # hashes in ONE ragged feeder batch (the serial walk hashes
+            # one node per round-trip)
+            pairs = [(nk, rn) for nk, rn in zip(ask, rnodes)
+                     if _is_leaf(lmap[nk])]
+            enc: List[bytes] = []
+            for nk, rn in pairs:
+                enc.append(_encode_node(lmap[nk]))
+                if rn is not None:
+                    enc.append(_encode_node(rn))
+            # off-loop: hash_many blocks on the feeder future — parking
+            # the event loop would stall every foreground request for
+            # the duration of each descent round
+            digs = iter(await asyncio.to_thread(self.merkle.hash_many,
+                                                enc) if enc else ())
+            leaf_diff: dict = {}
+            for nk, rn in pairs:
+                lh = bytes(next(digs))
+                rh = bytes(next(digs)) if rn is not None else bytes(EMPTY_HASH)
+                leaf_diff[nk] = lh != rh
+            for nk, rnode in zip(ask, rnodes):
+                node = lmap[nk]
+                if _is_leaf(node):
+                    if leaf_diff[nk]:
+                        to_send.append(bytes(node[1]))
+                else:
+                    rchildren = (
+                        dict(int_children(rnode))
+                        if rnode is not None and _is_int(rnode)
+                        else {}
+                    )
+                    for b, h in int_children(node):
+                        if rchildren.get(b) != h:
+                            frontier.append(nk + bytes([b]))
+                while len(to_send) >= BATCH_SIZE:
+                    await self._send_items(who, to_send[:BATCH_SIZE])
+                    to_send = to_send[BATCH_SIZE:]
+        if to_send:
+            await self._send_items(who, to_send)
+
+    async def _descend_pernode(self, partition: int, who: FixedBytes32,
+                               root_nk: bytes) -> None:
+        """Legacy descent (ref sync.rs:286-415): one node per RPC round
+        — kept as the mixed-version fallback and the bench's paired-A/B
+        baseline."""
         todo: List[bytes] = [root_nk]
         to_send: List[bytes] = []
         while todo:
@@ -146,6 +259,7 @@ class TableSyncer:
             r = await self.endpoint.call(
                 who, {"t": "get_node", "nk": nk}, prio=PRIO_BACKGROUND
             )
+            self._node_rpc("pernode")
             rnode = r.get("node")
             if _is_leaf(node):
                 rh = node_hash(rnode) if rnode is not None else EMPTY_HASH
@@ -166,7 +280,6 @@ class TableSyncer:
                 to_send = []
         if to_send:
             await self._send_items(who, to_send)
-        self._round("synced")
 
     async def _send_items(self, who: FixedBytes32, keys: List[bytes]) -> None:
         values = []
@@ -228,6 +341,14 @@ class TableSyncer:
         if t == "get_node":
             node = self.merkle.read_node(None, bytes(msg["nk"]))
             return {"node": node}, None
+        if t == "get_nodes":
+            nks = [bytes(nk) for nk in msg["nks"]]
+            if len(nks) > SYNC_NODE_RPC_MAX:
+                raise GarageError(
+                    f"get_nodes batch of {len(nks)} exceeds "
+                    f"{SYNC_NODE_RPC_MAX}")
+            return {"nodes": [self.merkle.read_node(None, nk)
+                              for nk in nks]}, None
         if t == "items":
             self.data.update_many([bytes(v) for v in msg["vs"]])
             self._count("recv", len(msg["vs"]))

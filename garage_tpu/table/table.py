@@ -266,16 +266,19 @@ class Table:
         filter: Any = None,
         limit: int = 100,
         reverse: bool = False,
+        end_sort_key: Optional[Any] = None,
     ) -> List[Entry]:
         """Quorum range read, merged per key, with read-repair of divergent
-        items (ref table.rs:314-407)."""
+        items (ref table.rs:314-407).  `end_sort_key` (exclusive) bounds
+        the scan — the sub-range contract sharded listings fan out over."""
         with self._span("get_range"), self._read_timer():
             return await self._get_range_inner(
-                p, start_sort_key, filter, limit, reverse
+                p, start_sort_key, filter, limit, reverse, end_sort_key
             )
 
     async def _get_range_inner(
-        self, p, start_sort_key=None, filter=None, limit=100, reverse=False
+        self, p, start_sort_key=None, filter=None, limit=100, reverse=False,
+        end_sort_key=None,
     ) -> List[Entry]:
         h = hash_partition_key(p)
         who = self.replication.read_nodes(h)
@@ -287,6 +290,8 @@ class Table:
             "limit": limit,
             "rev": reverse,
         }
+        if end_sort_key is not None:
+            msg["ek"] = sort_key_bytes(end_sort_key)
         resps = await self.system.rpc.try_call_many(
             self.endpoint,
             who,
@@ -380,6 +385,7 @@ class Table:
                 msg.get("filter"),
                 int(msg.get("limit", 100)),
                 bool(msg.get("rev", False)),
+                bytes(msg["ek"]) if msg.get("ek") is not None else None,
             )
             return {"vs": vs}, None
         raise GarageError(f"unknown table rpc {t!r}")

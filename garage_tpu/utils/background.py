@@ -62,6 +62,49 @@ class WorkerStatus:
         }
 
 
+class LoopSafeEvent:
+    """asyncio.Event whose set() is safe from ANY thread.
+
+    asyncio.Event.set() from a foreign thread flips the flag but wakes
+    nothing — a worker parked in wait_for_work() sleeps through the
+    notification until something else stirs the loop.  That is exactly
+    the idle gap the batched table paths expose: a Merkle/insert-queue
+    refill committed from a worker thread (batched passes run under
+    asyncio.to_thread) must wake the drainer NOW.  The waiting side
+    captures its loop; set() routes through call_soon_threadsafe when
+    called off-loop.  A set() before the first wait() only flips the
+    flag, which wait() observes before sleeping — no wakeup is lost."""
+
+    def __init__(self) -> None:
+        self._ev = asyncio.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def clear(self) -> None:
+        self._ev.clear()
+
+    def is_set(self) -> bool:
+        return self._ev.is_set()
+
+    async def wait(self) -> bool:
+        self._loop = asyncio.get_running_loop()
+        return await self._ev.wait()
+
+    def set(self) -> None:
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is not loop:
+                try:
+                    loop.call_soon_threadsafe(self._ev.set)
+                    return
+                except RuntimeError:
+                    pass  # loop shut down between checks: flag-only set
+        self._ev.set()
+
+
 class Worker:
     """Subclass and implement `work` (one step, returns a WorkerState) and
     optionally `wait_for_work` (ref background/worker.rs:41-59)."""

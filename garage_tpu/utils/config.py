@@ -188,6 +188,35 @@ class CodecConfig:
 
 
 @dataclass
+class TableTunables:
+    """[table] — metadata-plane scaling knobs (docs/OBSERVABILITY.md
+    "Metadata plane"): batched Merkle digestion, batched anti-entropy
+    descent and bucket-sharded listing fan-out.  Every knob has a
+    `<= 1` escape hatch that restores the serial/per-node behavior
+    (the bench's paired A/B baseline)."""
+
+    # todo items drained per batched Merkle pass (table/merkle.py):
+    # shared trie path nodes are rewritten and re-hashed ONCE per batch
+    # instead of once per item, and node hashes ride the codec feeder as
+    # one ragged batch.  <= 1 = legacy one-transaction-per-item updates.
+    merkle_batch: int = 256
+    # Merkle nodes fetched per anti-entropy RPC round (table/sync.py):
+    # the syncer ships whole subtree frontiers breadth-wise, collapsing
+    # cold-node convergence from O(nodes) round-trips to O(depth).
+    # <= 1 = legacy one-node-per-round descent.
+    sync_batch_nodes: int = 512
+    # concurrent sub-range scans a large ListObjects enumeration fans
+    # out across once its first page comes back full (api/s3/list.py):
+    # disjoint key sub-ranges prefetch in parallel and are consumed in
+    # order, so deep listings stop paying one quorum round-trip per
+    # serial page.  <= 1 = serial single-cursor walk.
+    list_shards: int = 4
+    # rows per server-side range_scan page when a filtered read_range
+    # has to keep scanning past rejected rows
+    scan_page: int = 1024
+
+
+@dataclass
 class ConsulDiscoveryConfig:
     """[consul_discovery] (ref util/config.rs:185-210, rpc/consul.rs)."""
     consul_http_addr: str = ""
@@ -267,6 +296,9 @@ class Config:
     admin_trace_sink: Optional[str] = None  # OTLP/HTTP collector endpoint
     k2v_api_bind_addr: Optional[str] = None
     codec: CodecConfig = field(default_factory=CodecConfig)
+    # [table] — metadata-plane scaling: batched Merkle hashing, batched
+    # sync descent, bucket-sharded listing fan-out
+    table: TableTunables = field(default_factory=TableTunables)
     # [rpc] — degraded-mode resilience tunables (adaptive timeouts,
     # retry/backoff, read hedging, per-peer circuit breaker, the
     # static block-transfer timeout, and the end-to-end request
@@ -445,6 +477,22 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
     if cfg.api.retry_after_max < max(int(cfg.api.retry_after), 1):
         raise ConfigError(
             "api.retry_after_max must be >= api.retry_after (and >= 1)")
+
+    table = raw.get("table", {})
+    known = {f.name for f in dataclasses.fields(TableTunables)}
+    bad = set(table) - known
+    if bad:
+        raise ConfigError(f"unknown [table] keys: {sorted(bad)}")
+    cfg.table = TableTunables(**table)
+    for key in ("merkle_batch", "sync_batch_nodes", "list_shards",
+                "scan_page"):
+        v = getattr(cfg.table, key)
+        if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+            raise ConfigError(f"table.{key} must be a positive integer")
+    if cfg.table.list_shards > 64:
+        raise ConfigError("table.list_shards must be <= 64")
+    if cfg.table.sync_batch_nodes > 65536:
+        raise ConfigError("table.sync_batch_nodes must be <= 65536")
 
     codec = raw.get("codec", {})
     known = {f.name for f in dataclasses.fields(CodecConfig)}

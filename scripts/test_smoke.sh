@@ -15,6 +15,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/smoke.py "$@"
+# metadata-plane smoke (ISSUE 14): 5k objects loaded live, listings from
+# all 3 nodes agree (sharded fan-out on), table_merkle_todo drains to 0
+# through the batched Merkle updater, and the merkle_batch_* /
+# table_scan_* / api_list_* families render promlint-clean
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/metadata_smoke.py
 # zero-copy device transport smoke (ISSUE 11): the hybrid gate must
 # OPEN through the transport on the synthetic in-process backend
 # (sustained_tpu_frac > 0), staging must pay ≤ 1 host copy per block,

@@ -261,6 +261,33 @@ def test_dead_device_degrades_to_inline_cpu_with_zero_errors():
     tr.shutdown()
 
 
+def test_staged_hash_absorbed_in_place_on_device_failure():
+    """A hash batch whose device dies AT SUBMIT (after staging) is
+    hashed straight off the lane-aligned staging rows — digests
+    bit-identical to hashlib, rows consumed in place (the SIMD-friendly
+    staging-layout contract), and the staging stride is 64-aligned."""
+    p = _params()
+
+    class _DeadHash(SyntheticLinkCodec):
+        def hash_submit(self, arr, lengths):
+            # prove the absorb used THIS staging buffer: remember it
+            self.seen = (arr, lengths)
+            raise RuntimeError("device gone")
+
+    dev = _DeadHash(p, link_gibs=100.0, compute_real=True)
+    cpu = CpuCodec(p)
+    tr = DeviceTransport(dev, p, fallback=cpu)
+    blocks, hashes = _blocks(n=6)
+    it = TransportItem("hash", blocks, len(blocks), sum(map(len, blocks)))
+    tr.submit_items("hash", [it])
+    digs = it.future.result(timeout=30)
+    assert [bytes(d) for d in digs] == [bytes(h) for h in hashes]
+    arr, _lengths = dev.seen
+    assert arr.shape[1] % DeviceTransport.HASH_ROW_ALIGN == 0, arr.shape
+    assert tr.fallbacks == 1
+    tr.shutdown()
+
+
 def test_feeder_routes_inline_when_transport_closed():
     """The feeder's dispatch falls back to the inline (CPU) ragged path
     when the codec's transport is closed — shutdown races degrade, they
