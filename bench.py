@@ -678,7 +678,8 @@ MP_TIME_CAP = 300.0
 
 async def _mk_cluster(tmp, n=1, repl="none", codec_cfg=None, quotas=None,
                       data_repl=None, db="native", wan_delay=None,
-                      proxies_out=None, rpc_cfg=None, api_cfg=None):
+                      proxies_out=None, rpc_cfg=None, api_cfg=None,
+                      health_cfg=None):
     """n in-process Garage daemons with an applied layout + one S3 server
     on node 0; returns (garages, server, port, key_id, secret)."""
     from garage_tpu.api.s3.api_server import S3ApiServer
@@ -705,6 +706,8 @@ async def _mk_cluster(tmp, n=1, repl="none", codec_cfg=None, quotas=None,
             cfg["rpc"] = dict(rpc_cfg)
         if api_cfg:
             cfg["api"] = dict(api_cfg)
+        if health_cfg:
+            cfg["health"] = dict(health_cfg)
         garages.append(Garage(config_from_dict(cfg)))
     for g in garages:
         await g.system.netapp.listen("127.0.0.1:0")
@@ -752,6 +755,65 @@ async def _mk_cluster(tmp, n=1, repl="none", codec_cfg=None, quotas=None,
     return garages, server, server.port, key.key_id, key.params().secret_key
 
 
+def _phase_slo_report(garages, prefix: str) -> dict:
+    """{f"{prefix}_slo_report": ...}: per-endpoint error-budget spend
+    aggregated across the cluster nodes' SLO trackers (utils/slo.py).
+    Burn rates are recomputed over the MERGED window counts — averaging
+    per-node burns would let an idle node dilute a burning one — and
+    the worst (endpoint, objective) is named so the headline guard can
+    say WHICH SLO was burning when a run regressed."""
+    merged: dict = {}
+    for g in garages:
+        slo = getattr(g, "slo", None)
+        if slo is None:
+            continue
+        for ep, rep in slo.report().items():
+            m = merged.setdefault(ep, {
+                "availability_target": rep["availability_target"],
+                "latency_target_ms": rep["latency_target_ms"],
+                "fast": {"total": 0, "err": 0, "slow": 0},
+                "slow": {"total": 0, "err": 0, "slow": 0},
+            })
+            for w in ("fast", "slow"):
+                for k in ("total", "err", "slow"):
+                    m[w][k] += rep[w][k]
+    if not merged:
+        return {}
+    endpoints: dict = {}
+    worst = None
+    for ep, m in sorted(merged.items()):
+        budget = max(1.0 - m["availability_target"], 1e-9)
+        ent = {"availability_target": m["availability_target"],
+               "latency_target_ms": m["latency_target_ms"],
+               "events": m["slow"]["total"]}
+        for slo_name, key in (("availability", "err"),
+                              ("latency", "slow")):
+            burns = {}
+            for w in ("fast", "slow"):
+                t = m[w]["total"]
+                burns[w] = round((m[w][key] / t) / budget, 3) if t else 0.0
+            t = m["slow"]["total"]
+            spent = round(m["slow"][key] / (t * budget), 4) if t else 0.0
+            ent[slo_name] = {
+                "bad": m["slow"][key],
+                "burn_fast": burns["fast"],
+                "burn_slow": burns["slow"],
+                "budget_spent": spent,
+            }
+            cand = (burns["slow"], burns["fast"], spent, ep, slo_name)
+            if worst is None or cand > worst:
+                worst = cand
+        endpoints[ep] = ent
+    rep = {"endpoints": endpoints}
+    if worst is not None:
+        rep["worst"] = {
+            "endpoint": worst[3], "slo": worst[4],
+            "burn_slow": worst[0], "burn_fast": worst[1],
+            "budget_spent": worst[2],
+        }
+    return {f"{prefix}_slo_report": rep}
+
+
 def _phase_critical_path(garages, prefix: str) -> dict:
     """{f"{prefix}_critical_path": per-endpoint sampled breakdown} from
     the cluster nodes' waterfall recorders (utils/waterfall.py): for
@@ -786,7 +848,11 @@ def _phase_critical_path(garages, prefix: str) -> dict:
                 for k, v in sorted(m["segments"].items(),
                                    key=lambda kv: -kv[1])},
         }
-    return {f"{prefix}_critical_path": out} if out else {}
+    # every cluster phase carries its SLO verdict next to its segment
+    # split: "where did the time go" AND "who paid for it in budget"
+    merged_out = {f"{prefix}_critical_path": out} if out else {}
+    merged_out.update(_phase_slo_report(garages, prefix))
+    return merged_out
 
 
 class _S3:
@@ -2624,17 +2690,44 @@ def _dominant_stage(out: dict) -> str:
     return max(stages, key=lambda k: stages[k].get("seconds", 0.0))
 
 
+def _burning_slo(out: dict) -> str:
+    """The worst (endpoint, objective) across every phase's
+    `*_slo_report` block — "PutObject availability (burn 3.2x slow / "
+    "14.1x fast, budget spent 0.42 in rs42)" — or "none".  The guard
+    prints it next to the dominant segment so a regressed run opens
+    with both WHERE the time went and WHO paid for it in budget."""
+    worst = None
+    for k, v in out.items():
+        if not str(k).endswith("_slo_report") or not isinstance(v, dict):
+            continue
+        w = v.get("worst")
+        if not w:
+            continue
+        cand = (float(w.get("burn_slow") or 0.0),
+                float(w.get("burn_fast") or 0.0), w,
+                str(k)[:-len("_slo_report")])
+        if worst is None or cand[:2] > worst[:2]:
+            worst = cand
+    if worst is None or worst[:2] <= (0.0, 0.0):
+        return "none"
+    w, phase = worst[2], worst[3]
+    return (f"{w['endpoint']} {w['slo']} (burn {w['burn_slow']}x slow / "
+            f"{w['burn_fast']}x fast, budget spent "
+            f"{w['budget_spent']} in {phase})")
+
+
 def _headline_guard(out: dict) -> int:
     """ROADMAP's explicit ask: regression-guard the headline in bench.py.
     Returns a nonzero exit code (after the JSON is emitted) when `value`
     drops more than (1 - HEADLINE_REGRESSION_FRAC) below the best prior
     round, with a message naming both numbers AND the dominant
-    critical-path stage of the attribution block."""
+    critical-path stage of the attribution block AND the burning SLO."""
     best, src = _best_prior_headline()
     out["headline_best_prior_gibs"] = round(best, 4)
     out["headline_best_prior_src"] = src
     dominant = _dominant_stage(out)
     out["headline_dominant_segment"] = dominant
+    out["headline_burning_slo"] = _burning_slo(out)
     value = float(out.get("value") or 0.0)
     if best > 0.0 and value < HEADLINE_REGRESSION_FRAC * best:
         put_cp = out.get("put_critical_path") or {}
@@ -2644,7 +2737,8 @@ def _headline_guard(out: dict) -> int:
             f"# HEADLINE REGRESSION: value {value:.3f} GiB/s is more than "
             f"{round((1 - HEADLINE_REGRESSION_FRAC) * 100)}% below the best "
             f"prior round ({best:.3f} GiB/s in {src}) — failing the run. "
-            f"Dominant critical-path segment: {dominant}"
+            f"Dominant critical-path segment: {dominant}; burning SLO: "
+            f"{out['headline_burning_slo']}"
             + (f" (API phases: {put_dom})" if put_dom else "") + ". "
             f"Attribution: gate={out.get('hybrid_gate')} "
             f"link={out.get('hybrid_link_gibs')} GiB/s "

@@ -398,27 +398,15 @@ class AdminRpcHandler:
         for nid, st in sys.peering.peers.items():
             conn = sys.netapp.conns.get(nid)
             status = sys.node_status.get(nid)
-            peers.append({
-                "id": bytes(nid).hex(),
+            # the shared health core (zone/up/rtt/breaker/pressure/
+            # health_score/fail_slow/disk/version — same truth the
+            # flight-recorder `peers` section snapshots), plus the
+            # connection-level detail only this view renders
+            row = sys.peer_core_row(nid, st)
+            row.update({
                 "hostname": status.hostname if status else None,
                 "addr": st.addr,
-                # committed-layout failure domain — the grouping key for
-                # the per-zone rollup below
-                "zone": sys.zone_of(nid),
-                "up": st.is_up,
-                # gossiped worst data-root health: a remote node gone
-                # read-only (StorageFull/-Error rejections) is visible
-                # here without waiting for a failed PUT
-                "disk_state": status.disk_state if status else None,
-                "breaker": sys.peering.breaker_state(nid),
-                # handshake-learned build (gossiped as fallback): the
-                # rolling-upgrade skew signal
-                "version": (sys.netapp.peer_versions.get(nid)
-                            or (status.version if status else None)),
                 "connected": conn is not None and not conn._closed,
-                "rtt_ewma_ms": (
-                    round(st.latency * 1000.0, 3)
-                    if st.latency is not None else None),
                 "consecutive_failures": st.failures,
                 "reconnects": st.reconnects,
                 "ping_failures": st.ping_failures,
@@ -427,6 +415,7 @@ class AdminRpcHandler:
                     if st.last_seen is not None else None),
                 "traffic": conn.traffic_stats() if conn is not None else None,
             })
+            peers.append(row)
         # zone grouping: peers sort by zone so a zone outage reads as one
         # contiguous block, and the rollup makes it one line
         peers.sort(key=lambda p: (p["zone"] or "~", not p["up"], p["id"]))
@@ -838,6 +827,52 @@ class AdminRpcHandler:
                 for ex in m.exemplar_snapshot():
                     out.append({"family": m.name, **ex})
         return out
+
+    # --- fleet health & SLOs (docs/OBSERVABILITY.md "Fleet health &
+    #     SLOs"; utils/slo.py + utils/flightrec.py) --------------------
+
+    async def _cmd_slo_status(self, msg) -> Dict:
+        """Per-(endpoint, objective) budget table: targets, window
+        event counts, fast/slow burn rates, budget remaining — the CLI
+        `slo status` payload."""
+        slo = getattr(self.garage, "slo", None)
+        if slo is None:
+            raise GarageError("no SLO tracker on this node")
+        return {
+            "node_id": bytes(self.garage.system.id).hex(),
+            "windows": {"fast_s": slo.tun.fast_window_s,
+                        "slow_s": slo.tun.slow_window_s},
+            "fast_burn_threshold": slo.tun.fast_burn_threshold,
+            "fast_burn_breaches": slo.fast_burn_breaches,
+            "rows": slo.status(),
+        }
+
+    async def _cmd_incident_capture(self, msg) -> Dict:
+        """Manual flight-recorder capture (skips the auto debounce —
+        an operator asking for a snapshot always gets one).  Collectors
+        run here on the loop (race-free reads of loop-owned state, at
+        Prometheus-scrape cost); the expensive serialize + disk write
+        runs off it — manual captures happen exactly when the node is
+        degraded, and writing a large bundle inline would stall every
+        in-flight request (same split as the auto path in
+        utils/flightrec.py)."""
+        import asyncio
+
+        fr = getattr(self.garage, "flightrec", None)
+        if fr is None:
+            raise GarageError("no flight recorder on this node")
+        bundle = fr.collect(msg.get("reason") or "manual",
+                            trigger="manual")
+        path = await asyncio.to_thread(fr.write, bundle)
+        return {"path": path, "captures": fr.captures,
+                "suppressed": fr.suppressed}
+
+    async def _cmd_incident_list(self, msg) -> List[Dict]:
+        """Retained incident bundles, oldest first (headers only)."""
+        fr = getattr(self.garage, "flightrec", None)
+        if fr is None:
+            raise GarageError("no flight recorder on this node")
+        return fr.bundles()
 
     async def _cmd_launch_repair(self, msg) -> str:
         what = msg.get("what", "tables")

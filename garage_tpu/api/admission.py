@@ -169,6 +169,28 @@ class AdmissionToken:
         latency.  Called by the streaming handlers."""
         self._sojourn_excluded = True
 
+    def service_latency(self) -> Optional[float]:
+        """Server-side service seconds so far, for the latency SLO
+        (utils/slo.py) — None when this request's duration is
+        client-paced (streamed response / long-poll: the client's drain
+        pace must not burn the latency budget, exactly the CoDel
+        exclusion).  Uploads anchor at body completion (`body_done`),
+        like the CoDel sojourn, so a trickled body measures only its
+        post-body service time."""
+        if self._sojourn_excluded:
+            return None
+        start = self._t_body if self._t_body is not None else self._t0
+        return self._gate.clock() - start
+
+    def body_anchored(self) -> bool:
+        """True once ``body_done`` stamped the post-body anchor — the
+        only case where ``service_latency`` is a BETTER latency-SLO
+        measurement than intake-to-completion (it subtracts the
+        client-paced body transfer).  For everything else the intake
+        anchor wins: it includes the admission queue wait, which is
+        server-side latency and must burn the budget."""
+        return self._t_body is not None
+
     # --- byte reconciliation (Content-Length-less bodies) ---------------
 
     def note_body_bytes(self, n: int) -> None:

@@ -174,6 +174,32 @@ async def admit_request(gate, request, tenant: Optional[str] = None,
         request.path)
 
 
+def slo_service_latency(request, token, t_intake_ns: int
+                        ) -> Tuple[float, bool]:
+    """``(seconds, client_paced)`` for the latency SLO (utils/slo.py),
+    shared by the S3 and K2V middlewares at request completion.
+    Client-paced durations (streamed GET responses, long-polls — the
+    admission token's CoDel sojourn exclusion, or the request-level
+    ``slo_client_paced`` flag the streaming/poll handlers set so the
+    exclusion holds with admission disabled) count toward availability
+    but must never mark slow.  Uploads anchor at body completion, like
+    the adaptive watermark (subtracting the client-paced body
+    transfer); everything else keeps the INTAKE anchor — it includes
+    the admission (WDRR) queue wait, which is server-side latency the
+    client observes and must burn the budget."""
+    import time
+
+    lat_s = (time.time_ns() - t_intake_ns) / 1e9
+    paced = bool(request.get("slo_client_paced"))
+    if token is not None and not paced:
+        sl = token.service_latency()
+        if sl is None:
+            paced = True
+        elif token.body_anchored():
+            lat_s = sl
+    return lat_s, paced
+
+
 def request_deadline_budget(config) -> Optional[float]:
     """The per-request deadline budget the API servers arm, from
     ``[rpc] deadline_default``; None = deadlines disabled."""

@@ -38,6 +38,7 @@ from .common import (
     int_param,
     request_deadline_budget,
     request_trace,
+    slo_service_latency,
     start_site,
 )
 from .signature import check_signature, raw_query_pairs
@@ -70,6 +71,9 @@ class K2VApiServer:
         # node-wide admission gate + request deadline budget, shared with
         # the S3 server (docs/ROBUSTNESS.md "Overload & brownout")
         self.gate = getattr(garage, "admission", None)
+        # SLO burn-rate tracker (utils/slo.py): K2V requests classify
+        # by method ("K2V:GET", …) — sheds included
+        self.slo = getattr(garage, "slo", None)
         self.deadline_s = request_deadline_budget(garage.config)
         self._runner: Optional[web.AppRunner] = None
 
@@ -130,6 +134,10 @@ class K2VApiServer:
                 self.gate, request, remote_pressure=remote_p, bucket=bname)
             t_admitted_ns = _time.time_ns()
             if shed is not None:
+                if self.slo is not None:
+                    self.slo.note(f"K2V:{request.method}",
+                                  (_time.time_ns() - t_intake_ns) / 1e9,
+                                  ok=False)
                 return shed
             if token is not None:
                 # the long-poll handlers park this token while waiting so
@@ -148,6 +156,15 @@ class K2VApiServer:
                 with trace:
                     resp = await self._handle_with_errors(request, rid)
                     trace.set_attr("status", resp.status)
+                    if self.slo is not None:
+                        # long-polls (PollItem/PollRange) wait out the
+                        # CLIENT's chosen window — excluded from the
+                        # latency SLO by the shared helper
+                        lat_s, paced = slo_service_latency(
+                            request, token, t_intake_ns)
+                        self.slo.note(
+                            f"K2V:{request.method}", lat_s,
+                            ok=resp.status < 500, client_paced=paced)
                     if not resp.prepared:
                         resp.headers["x-amz-request-id"] = rid
                     return resp
@@ -320,6 +337,11 @@ class K2VApiServer:
         # no node resources while waiting, and N pollers must not brown
         # out PUT/GET admission for up to 600 s each
         token = request.get("admission_token") if request is not None else None
+        if request is not None:
+            # poll duration is the client's chosen window, not service
+            # latency: keep it out of the latency SLO even when no
+            # admission token exists to carry the CoDel exclusion
+            request["slo_client_paced"] = True
         if token is not None:
             token.park()
         try:
@@ -592,6 +614,7 @@ class K2VApiServer:
 
                 # park the admission slot for the wait (same rationale as
                 # poll_item: a parked poller must not starve the gate)
+                request["slo_client_paced"] = True
                 token = request.get("admission_token")
                 if token is not None:
                     token.park()

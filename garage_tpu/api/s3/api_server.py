@@ -38,6 +38,7 @@ from ..common import (
     parse_bucket_key,
     request_deadline_budget,
     request_trace,
+    slo_service_latency,
     start_site,
 )
 from ..signature import (
@@ -65,6 +66,10 @@ class S3ApiServer:
         # the per-request deadline budget
         self.gate = getattr(garage, "admission", None)
         self.probe = getattr(garage, "admission_probe", None)
+        # SLO burn-rate tracker (utils/slo.py): every finished request —
+        # sheds included — lands in it, so admission verdicts burn the
+        # availability budget like any other server-side failure
+        self.slo = getattr(garage, "slo", None)
         self.deadline_s = request_deadline_budget(garage.config)
         self._runner: Optional[web.AppRunner] = None
         # metrics (ref generic_server.rs:63-95)
@@ -146,6 +151,13 @@ class S3ApiServer:
                 self.error_counter += 1
                 if self._m is not None:
                     self._m["errors"].inc(api="s3", status="503")
+                if self.slo is not None:
+                    # the shed verdict burns the ENDPOINT's availability
+                    # budget, not a generic bucket: classify the request
+                    # the same way routing would have
+                    self.slo.note(
+                        self._slo_endpoint(request, bname, key),
+                        (_time.time_ns() - t_intake_ns) / 1e9, ok=False)
                 return shed
             if token is not None:
                 # streaming handlers reconcile Content-Length-less bodies
@@ -177,12 +189,37 @@ class S3ApiServer:
                         # the waterfall groups by this (PutObject,
                         # GetObject, …), not by raw method
                         trace.set_attr("endpoint", ep)
+                    if self.slo is not None:
+                        # 5xx burns availability; 4xx is the client's
+                        # problem; a SLOW success burns the latency SLO
+                        # (client-paced exclusion + body-completion
+                        # anchor shared with K2V in slo_service_latency)
+                        lat_s, paced = slo_service_latency(
+                            request, token, t_intake_ns)
+                        self.slo.note(
+                            ep or self._slo_endpoint(request, bname, key),
+                            lat_s, ok=resp.status < 500,
+                            client_paced=paced)
                     if not resp.prepared:
                         resp.headers["x-amz-request-id"] = rid
                     return resp
             finally:
                 if token is not None:
                     token.release()
+
+    def _slo_endpoint(self, request, bname, key) -> str:
+        """Endpoint classification for requests that never reached the
+        router (sheds): the same parse routing uses, degraded to
+        'Unknown' on malformed input — classification must stay cheap
+        and must never raise on a request we are rejecting anyway."""
+        try:
+            ep = parse_endpoint(
+                request.method, bname, key,
+                [(k, v) for k, v in request.query.items()],
+                {k.lower(): v for k, v in request.headers.items()})
+            return ep.name
+        except Exception:  # noqa: BLE001
+            return "Unknown"
 
     async def _handle_with_errors(self, request, rid: str) -> web.StreamResponse:
         try:

@@ -270,7 +270,9 @@ async def _stream_blocks_range(
     hdrs["Content-Length"] = str(end - begin)
     hdrs.update(ctx.cors_headers)  # immutable after prepare()
     # a streamed download's duration is the CLIENT's drain pace — keep
-    # it out of the CoDel admitted-latency law (api/admission.py)
+    # it out of the CoDel admitted-latency law (api/admission.py) and
+    # out of the latency SLO (api_server middleware reads the flag)
+    ctx.request["slo_client_paced"] = True
     token = ctx.request.get("admission_token")
     if token is not None:
         token.exclude_sojourn()

@@ -11,8 +11,10 @@ PAPERS.md schemes the ROADMAP names:
   1. **Exact-k survivor selection** ("Boosting the Performance of
      Degraded Reads in RS-coded Distributed Storage Systems"): candidate
      pieces are ranked by their best holder's `RpcHelper.peer_rank` —
-     the per-peer RTT EWMA, circuit-breaker state, and zone locality the
-     resilience layer already maintains — with data members before
+     the per-peer RTT EWMA, circuit-breaker state, zone locality,
+     gossiped load-governor pressure and fail-slow verdict (the
+     least-loaded / healthiest-survivor half of the same paper;
+     utils/health_score.py) — with data members before
      parity (parity only fills the gap left by dead members) and
      pieces whose every holder is breaker-open last.  Exactly k fetches
      go out; a *ranked replacement* launches only when a fetch fails, or
@@ -128,11 +130,15 @@ class RepairPlanner:
     def rank_pieces(self, pieces: Sequence[_Piece]) -> List[_Piece]:
         """Fetch order: data members before parity (parity only fills
         the gap left by dead members), each band ordered by the piece's
-        BEST holder under RpcHelper.peer_rank (self < local-zone <
-        cross-zone < breaker-open; measured RTT before unknown), and
-        pieces whose every holder is breaker-open dead-last — even
-        behind healthy parity, since their fetches can only burn
-        timeouts that healthy pieces avoid."""
+        BEST holder under RpcHelper.peer_rank — the (breaker,
+        fail-slow, zone, pressure-bucket, RTT) survivor key: self <
+        local-zone < cross-zone < FAIL-SLOW < breaker-open, and within
+        a zone band lightly-loaded holders (gossiped governor pressure,
+        System.peer_pressure) before pressured ones — the load-aware
+        survivor scheduling of the degraded-reads paper.  Pieces whose
+        every holder is breaker-open rank dead-last — even behind
+        healthy parity, since their fetches can only burn timeouts that
+        healthy pieces avoid."""
         rpc = self.manager.system.rpc
 
         def key(p: _Piece):

@@ -226,6 +226,27 @@ def _build_parser() -> argparse.ArgumentParser:
         help="current-window histogram exemplars: the trace id behind "
              "each family's max observation")
 
+    psl = sub.add_parser(
+        "slo",
+        help="per-endpoint SLO budgets & burn rates (utils/slo.py)")
+    sls = psl.add_subparsers(dest="slo_cmd", required=True)
+    slst = sls.add_parser(
+        "status",
+        help="budget table: per-endpoint availability + latency "
+             "objectives, fast/slow burn rates, budget remaining")
+    slst.add_argument("--json", action="store_true")
+
+    pin = sub.add_parser(
+        "incident",
+        help="incident flight recorder (one-call diagnostic bundles)")
+    ins = pin.add_subparsers(dest="incident_cmd", required=True)
+    icap = ins.add_parser(
+        "capture",
+        help="write a bundle NOW (metrics, waterfalls, timeline, "
+             "breaker/disk/governor/peer state, event rings)")
+    icap.add_argument("--reason", default="manual")
+    ins.add_parser("list", help="retained bundles on the node")
+
     ptl = sub.add_parser(
         "timeline",
         help="device/transport pipeline timeline as Chrome-trace JSON "
@@ -409,8 +430,8 @@ async def _amain(args) -> None:
                 f" v{st['version']}" if st.get("version") else "")
             print(f"==== Node: {st['node_id'][:16]}…{me} — peer health "
                   f"(grouped by zone) ====")
-            rows = ["ZONE\tPEER\tADDR\tUP\tBRK\tDISK\tVER\tRTT\tFAILS"
-                    "\tRECONN\tTX\tRX\tBG TX%"]
+            rows = ["ZONE\tPEER\tADDR\tUP\tBRK\tHEALTH\tDISK\tVER\tRTT"
+                    "\tFAILS\tRECONN\tTX\tRX\tBG TX%"]
             for p in st["peers"]:
                 tr = p.get("traffic") or {}
                 tx = sum(v["tx_bytes"] for v in tr.values())
@@ -418,6 +439,12 @@ async def _amain(args) -> None:
                 bg = tr.get("background", {}).get("tx_bytes", 0)
                 rtt = p["rtt_ewma_ms"]
                 brk = p.get("breaker")
+                hs = p.get("health_score")
+                # the fail-slow column: a peer can be up with its
+                # breaker closed and still be SLOW! — the gray-failure
+                # case the comparative scorer exists for
+                health = ("SLOW!" if p.get("fail_slow")
+                          else f"{hs:.1f}x" if hs is not None else "-")
                 rows.append("\t".join([
                     p.get("zone") or "-",
                     f"{p['id'][:16]}…",
@@ -425,6 +452,7 @@ async def _amain(args) -> None:
                     "up" if p["up"] else "DOWN",
                     {"closed": "-", "half_open": "half",
                      "open": "OPEN"}.get(brk, brk or "-"),
+                    health,
                     p.get("disk_state") or "-",
                     p.get("version") or "-",
                     f"{rtt}ms" if rtt is not None else "-",
@@ -750,6 +778,47 @@ async def _amain(args) -> None:
         render(wf["tree"], 0)
         return
 
+    if args.command == "slo":
+        st = await client.call({"cmd": "slo_status"})
+        if args.json:
+            print(json.dumps(st, indent=2))
+            return
+        w = st["windows"]
+        print(f"==== SLO budgets — node {st['node_id'][:16]}… "
+              f"(fast {w['fast_s']:.0f}s / slow {w['slow_s']:.0f}s; "
+              f"fast-burn threshold {st['fast_burn_threshold']}x, "
+              f"{st['fast_burn_breaches']} breach(es)) ====")
+        rows = ["ENDPOINT\tSLO\tTARGET\tEVENTS\tBAD\tBURN fast\t"
+                "BURN slow\tBUDGET LEFT"]
+        for r in st["rows"]:
+            rows.append("\t".join([
+                r["endpoint"], r["slo"], r["target"],
+                str(r["events"]), str(r["bad"]),
+                f"{r['burn_fast']:.2f}x", f"{r['burn_slow']:.2f}x",
+                f"{r['budget_remaining'] * 100:.1f}%",
+            ]))
+        print(format_table(rows))
+        return
+
+    if args.command == "incident":
+        if args.incident_cmd == "capture":
+            out = await client.call({"cmd": "incident_capture",
+                                     "reason": args.reason})
+            print(f"bundle written: {out['path']}")
+        else:
+            rows = ["CAPTURED\tTRIGGER\tREASON\tSECTIONS\tPATH"]
+            for b in await client.call({"cmd": "incident_list"}):
+                ts = b.get("captured_at")
+                rows.append("\t".join([
+                    f"{ts:.0f}" if ts else "-",
+                    b.get("trigger") or "-",
+                    b.get("reason") or "-",
+                    str(len(b.get("sections") or [])),
+                    b["path"],
+                ]))
+            print(format_table(rows))
+        return
+
     if args.command == "timeline":
         msg = {"cmd": "device_timeline"}
         if args.limit:
@@ -769,9 +838,17 @@ async def _amain(args) -> None:
 
 
 def main() -> None:
+    # log↔trace correlation: every record emitted inside a request
+    # scope carries the request's trace id (== x-amz-request-id), so
+    # flight-recorder bundles and `request waterfall --trace` key
+    # straight into the log stream; "-" outside any request
+    from .utils.tracing import install_log_trace_ids
+
+    install_log_trace_ids()
     logging.basicConfig(
         level=os.environ.get("GARAGE_TPU_LOG", "INFO"),
-        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+        format="%(asctime)s %(levelname).1s %(name)s [%(trace_id)s]: "
+               "%(message)s",
     )
     args = _build_parser().parse_args()
     try:

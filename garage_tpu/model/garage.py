@@ -310,11 +310,129 @@ class Garage:
         if hasattr(codec, "set_governor"):
             codec.set_governor(self.governor.ratio)
 
+        # --- fleet health plane (docs/OBSERVABILITY.md "Fleet health &
+        # SLOs"): the SLO burn-rate engine fed by the API front doors,
+        # and the incident flight recorder its fast-burn breaches (plus
+        # fail-slow flips and disk/cluster degradation) trigger ---
+        from ..utils.flightrec import FlightRecorder
+        from ..utils.slo import SloTracker
+
+        self.flightrec = FlightRecorder(
+            os.path.join(config.metadata_dir, "incidents"),
+            node_id=bytes(self.system.id).hex()[:16],
+            max_bundles=getattr(config, "incident_max_bundles", 16),
+            debounce_s=getattr(config, "incident_debounce_secs", 60.0),
+            metrics=self.system.metrics,
+        )
+        self.slo = SloTracker(
+            getattr(config, "slo", None), metrics=self.system.metrics,
+            on_fast_burn=lambda ep, slo, burn: self.flightrec.trigger(
+                "slo_fast_burn",
+                {"endpoint": ep, "slo": slo, "burn": round(burn, 2)}),
+        )
+        self._wire_flight_recorder()
+
         self.bg = BackgroundRunner()
         # background workers duty-cycle against foreground pressure
         self.bg.governor = self.governor
         self.bg_vars = BgVars()
         self.scrub_worker: Optional[ScrubWorker] = None
+
+    def _wire_flight_recorder(self) -> None:
+        """Collectors (what a bundle contains) + auto-triggers (when one
+        is captured).  Everything here is a SYNC snapshot of state the
+        node already holds — a capture must never wait on the network;
+        cross-node context comes from the gossip tables."""
+        fr = self.flightrec
+        sys_ = self.system
+        mgr = self.block_manager
+
+        fr.add_collector("metrics", lambda: sys_.metrics.render())
+        fr.add_collector("slo", lambda: self.slo.status())
+
+        def _waterfalls():
+            wf = getattr(sys_.tracer, "waterfall", None)
+            if wf is None:
+                return None
+            return {"endpoints": wf.endpoints(), "retained": wf.entries()}
+
+        fr.add_collector("waterfalls", _waterfalls)
+        fr.add_collector(
+            "device_timeline",
+            lambda: mgr.codec.obs.timeline.chrome_trace(2048))
+        fr.add_collector(
+            "gate_events", lambda: mgr.codec.obs.events_list(128))
+        fr.add_collector("slow_ops", lambda: sys_.tracer.slow.snapshot(32))
+
+        fr.add_collector("peers", lambda: [
+            sys_.peer_core_row(nid, st)
+            for nid, st in sys_.peering.peers.items()
+        ])
+        fr.add_collector("governor", lambda: {
+            "pressure": round(self.governor.pressure(), 4),
+            "ratio": round(self.governor.ratio(), 4),
+            "signals": self.governor.signals(),
+        })
+        fr.add_collector("disk", lambda: {
+            "states": mgr.health.states(),
+            "worst": mgr.health.worst_state(),
+            "error_counts": {f"{op}:{kind}": n for (op, kind), n in
+                             dict(mgr.health.error_counts).items()},
+            "quarantined": mgr.quarantined,
+        })
+        fr.add_collector("heals", lambda: dict(mgr.heal_counts))
+        fr.add_collector("resync_enqueues", lambda: (
+            dict(mgr.resync.enqueue_counts)
+            if mgr.resync is not None else None))
+        fr.add_collector("admission", lambda: {
+            "occupancy": round(self.admission.occupancy(), 4),
+            "retry_after_hint": self.admission.retry_after_hint(),
+        })
+
+        def _cluster():
+            h = sys_.health()
+            return {"status": h.status,
+                    "connected_nodes": h.connected_nodes,
+                    "known_nodes": h.known_nodes,
+                    "partitions_quorum": h.partitions_quorum,
+                    "partitions": h.partitions}
+
+        fr.add_collector("cluster_health", _cluster)
+
+        # auto-trigger: fail-slow flag transitions (the scorer runs on
+        # the gossip cadence; a flip means the fleet just gained or
+        # healed a straggler — snapshot the evidence either way)
+        sys_.health_scorer.on_change = (
+            lambda peer, flagged, score: fr.trigger(
+                "fail_slow_set" if flagged else "fail_slow_clear",
+                {"peer": peer, "score": score}))
+
+        # auto-trigger: disk / cluster (zone) state degradation, watched
+        # on the status-gossip cadence.  Only DEGRADATIONS capture —
+        # recovery is good news and the degradation bundle already holds
+        # the interesting state
+        disk_rank = {"ok": 0, "degraded": 1, "failed": 2}
+        cluster_rank = {"healthy": 0, "degraded": 1, "unavailable": 2}
+        # baselines initialize from the FIRST observation, not an
+        # assumed-healthy state: a booting node is "unavailable" until
+        # the mesh connects, and that startup transient must not write
+        # a bundle (and eat the debounce window) on every boot
+        watch: dict = {}
+
+        def _degradation_watch():
+            d = mgr.health.worst_state()
+            if ("disk" in watch
+                    and disk_rank.get(d, 0) > disk_rank.get(watch["disk"], 0)):
+                fr.trigger("disk_degraded", {"state": d})
+            watch["disk"] = d
+            c = sys_.health().status
+            if ("cluster" in watch
+                    and cluster_rank.get(c, 0) > cluster_rank.get(
+                        watch["cluster"], 0)):
+                fr.trigger("cluster_degraded", {"status": c})
+            watch["cluster"] = c
+
+        sys_.status_tick_hooks.append(_degradation_watch)
 
     # --- workers (ref garage.rs:358-379, block/manager.rs:192-227) ---
 

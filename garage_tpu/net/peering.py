@@ -14,7 +14,7 @@ import logging
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Set, Tuple
+from typing import Callable, Dict, Optional, Set, Tuple
 
 from .netapp import NetApp, NodeID
 from .resilience import BREAKER_STATE_VALUES, CircuitBreaker, ResilienceTunables
@@ -65,6 +65,11 @@ class FullMeshPeering:
         self._addr_only: Set[str] = set()   # peers known only by address
         self._task: Optional[asyncio.Task] = None
         self._stopped = asyncio.Event()
+        # optional per-ping RTT tap (set by System): successful ping
+        # RTTs feed the fail-slow scorer's "ping" endpoint class, so a
+        # peer with NO data-plane traffic toward us is still judgeable
+        # against its siblings (utils/health_score.py)
+        self.rtt_note: Optional[Callable[[NodeID, float], None]] = None
         netapp.on_connected = self._on_connected
         netapp.on_disconnected = self._on_disconnected
         # per-peer health instruments: RTT EWMA / liveness / failure
@@ -280,6 +285,11 @@ class FullMeshPeering:
         try:
             rtt = await conn.ping()
             st.last_seen = time.monotonic()
+            if self.rtt_note is not None:
+                try:
+                    self.rtt_note(nid, rtt)
+                except Exception:  # noqa: BLE001 — scoring never breaks pings
+                    pass
             # breaker judges the fresh RTT against the PRE-ping EWMA: a
             # 10× blowup on an established baseline counts as a failure
             # even though the ping came back

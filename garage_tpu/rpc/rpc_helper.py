@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -120,6 +121,19 @@ class RpcHelper:
         # RpcHelper uses (tests, CLI clients) behave exactly as before.
         self.zone_of: Callable[[NodeID], Optional[str]] = lambda _n: None
         self.local_zone: Callable[[], Optional[str]] = lambda: None
+        # fleet-health sources (set by System once built): gossiped
+        # load-governor pressure and the fail-slow verdict per peer.
+        # Defaults keep every health feature inert — bare RpcHelper uses
+        # (tests, CLI clients) rank exactly as before.
+        self.pressure_of: Callable[[NodeID], float] = lambda _n: 0.0
+        self.fail_slow_of: Callable[[NodeID], bool] = lambda _n: False
+        # per-peer service-time feed for the fail-slow scorer
+        # (utils/health_score.py): called with (node, endpoint, seconds)
+        # for every COMPLETED call — the same timings that land in
+        # rpc_duration_seconds, with the peer dimension the histogram
+        # lacks
+        self.health_note: Optional[
+            Callable[[NodeID, str, float], None]] = None
         # per-RPC counters + latency histogram (ref rpc/metrics.rs:38)
         if metrics is not None:
             self.m_requests = metrics.counter(
@@ -165,6 +179,29 @@ class RpcHelper:
         change needs no re-wiring)."""
         self.zone_of = zone_of
         self.local_zone = local_zone
+
+    def set_health_source(
+        self,
+        pressure_of: Callable[[NodeID], float],
+        fail_slow_of: Callable[[NodeID], bool],
+        note: Optional[Callable[[NodeID, str, float], None]] = None,
+    ) -> None:
+        """Thread the fleet-health plane in (System wires this next to
+        the zone source): gossiped pressure + fail-slow verdicts feed
+        peer_rank, and every completed call's service time feeds the
+        comparative scorer via `note`."""
+        self.pressure_of = pressure_of
+        self.fail_slow_of = fail_slow_of
+        self.health_note = note
+
+    def _feed_health(self, node: NodeID, endpoint_path: str,
+                     seconds: float) -> None:
+        if self.health_note is None or node == self.our_id:
+            return
+        try:
+            self.health_note(node, endpoint_path, seconds)
+        except Exception:  # noqa: BLE001 — scoring must never break calls
+            pass
 
     def _instrument(self, endpoint_path: str, coro_fn):
         """Wrap one RPC attempt with counters + duration (the reference's
@@ -314,6 +351,7 @@ class RpcHelper:
                     raise
 
             fn = self._instrument(endpoint_path, attempt_once)
+            t_attempt = time.perf_counter()
             try:
                 result = await fn()
             except asyncio.CancelledError:
@@ -321,6 +359,14 @@ class RpcHelper:
                 raise
             except Exception as e:
                 self.note_result(node, e)
+                # a COMPLETED answer (domain error: the peer served the
+                # call, we just disliked the verdict) still measures the
+                # peer's service time; transport failures and budget
+                # expiries measure nothing about the peer
+                if (not is_transport_error(e)
+                        and not isinstance(e, DeadlineExceeded)):
+                    self._feed_health(node, endpoint_path,
+                                      time.perf_counter() - t_attempt)
                 retryable = (
                     attempt < retries
                     and not isinstance(e, PeerUnavailable)
@@ -338,6 +384,8 @@ class RpcHelper:
                 continue
             else:
                 self.note_result(node, None)
+                self._feed_health(node, endpoint_path,
+                                  time.perf_counter() - t_attempt)
                 return result
 
     # --- ordering (ref rpc_helper.rs:392-435) ---
@@ -359,23 +407,56 @@ class RpcHelper:
         which reproduces the pre-zone ordering exactly."""
         return sorted(nodes, key=self.peer_rank)
 
+    def pressure_bucket(self, n: NodeID) -> int:
+        """Gossiped load-governor pressure quantized into coarse bands
+        (0 = < 0.5 relaxed, 1 = < 1.0 warm, 2 = saturated): ranking on
+        the raw float would reorder candidates on every gossip tick,
+        defeating RTT ordering within a band — the bucket only demotes
+        peers that are MEANINGFULLY hotter."""
+        try:
+            p = float(self.pressure_of(n))
+        except Exception:  # noqa: BLE001 — a dead source is pressure 0
+            return 0
+        return 0 if p < 0.5 else (1 if p < 1.0 else 2)
+
     def peer_rank(self, n: NodeID) -> tuple:
-        """The candidate-ordering score request_order sorts by, exposed
+        """The candidate-ordering key request_order sorts by — exposed
         so planners can rank non-node resources by their best holder
-        (block/repair_plan.py ranks codeword pieces with it): band 0 =
-        self, 1 = local zone / unknown zone, 2 = cross-zone, 4 = breaker
-        open; within a band, measured latency before unknown."""
+        (block/repair_plan.py ranks codeword pieces with it).  The key
+        is (breaker/fail-slow/zone band, [zone within fail-slow,]
+        pressure bucket, measured-latency flag, RTT):
+
+          band 0 = self, 1 = local/unknown zone, 2 = cross-zone,
+          3 = FAIL-SLOW (up, pings fine, breaker closed — but a
+          sustained factor slower than its siblings for the same
+          endpoint class; utils/health_score.py), 4 = breaker open.
+
+        Fail-slow demotes after breaker-open and before RTT, per the
+        degraded-reads paper's healthy-survivor-first rule; within the
+        fail-slow band, zone then pressure then RTT still order the
+        flagged peers (if every candidate is flagged, the least-bad one
+        serves).  The pressure bucket is the load-aware half of the
+        same paper: a pressured-but-reachable peer yields to an idle
+        sibling in the same zone band, but never to a farther zone.
+        With no health source wired every bucket is 0 and the ordering
+        is exactly the pre-fleet-health (zone, RTT) one."""
         if n == self.our_id:
-            return (0, 0, 0.0)
+            return (0, 0, 0, 0.0)
         if self.peering.breaker_state(n) == "open":
-            return (4, 0, 0.0)
+            return (4, 0, 0, 0.0)
         lz = self.local_zone()
         nz = self.zone_of(n)
         zband = 1 if (lz is None or nz is None or nz == lz) else 2
+        pbucket = self.pressure_bucket(n)
         lat = self.peering.latency(n)
-        if lat is None:
-            return (zband, 1, 0.0)
-        return (zband, 0, lat)
+        measured = 1 if lat is None else 0
+        try:
+            flagged = bool(self.fail_slow_of(n))
+        except Exception:  # noqa: BLE001 — a dead source is healthy
+            flagged = False
+        if flagged:
+            return (3, zband, pbucket, measured, lat or 0.0)
+        return (zband, pbucket, measured, lat or 0.0)
 
     # --- single + many (ref rpc_helper.rs:121-172) ---
 

@@ -81,6 +81,14 @@ class NodeStatus:
     # "Multi-tenant fairness & noisy neighbors").  None = peer predates
     # the field / governor unwired
     governor_pressure: Optional[float] = None
+    # this node's comparative fail-slow view of ITS peers (utils/
+    # health_score.py): {peer hex16 prefix: score} plus the flagged
+    # subset.  Riding gossip means every node learns about a straggler
+    # from the nodes that actually call it — a gateway that never
+    # talks to a storage node directly still demotes it in repair /
+    # read ranking.  None = peer predates the fields / scorer dark
+    health_scores: Optional[Dict[str, float]] = None
+    fail_slow: Optional[List[str]] = None
 
     def pack(self):
         return dataclasses.asdict(self)
@@ -91,7 +99,7 @@ class NodeStatus:
             "hostname", "replication_factor", "layout_version",
             "layout_staging_hash", "data_avail", "data_total",
             "meta_avail", "meta_total", "disk_state", "version",
-            "governor_pressure",
+            "governor_pressure", "health_scores", "fail_slow",
         )})
 
 
@@ -181,6 +189,51 @@ class System:
             "in the slow-op log", fn=lambda: self.tracer.slow.max_seconds())
         self.rpc = RpcHelper(self.netapp, self.peering, metrics=self.metrics,
                              tracer=self.tracer, tunables=config.rpc)
+
+        # --- fleet health: comparative fail-slow scorer (utils/
+        # health_score.py).  Fed per-peer service times by RpcHelper's
+        # call path and the peering ping loop; its verdicts ride
+        # NodeStatus gossip, demote flagged peers in peer_rank, and
+        # trigger the incident flight recorder (model/garage.py hooks
+        # on_change) ---
+        from ..utils.health_score import FailSlowScorer, HealthTunables
+
+        self.health_scorer = FailSlowScorer(
+            getattr(config, "health", None) or HealthTunables())
+        self.rpc.set_health_source(
+            self.peer_pressure, self.peer_fail_slow,
+            note=self._health_note)
+        self.peering.rtt_note = (
+            lambda nid, rtt: self._health_note(nid, "ping", rtt))
+        # merged (local + fresh gossip) health view, cached briefly:
+        # peer_rank consults it per candidate, so a rank must be a dict
+        # lookup, not a gossip-table scan
+        self._health_view_cache: tuple = (-1e9, {})
+        self.metrics.gauge(
+            "peer_health_score",
+            "Comparative fail-slow score per peer (worst ratio of the "
+            "peer's per-endpoint-class service time to the cluster "
+            "lower-median; local + fresh gossiped views merged, max "
+            "wins)",
+            labeled_fn=lambda: [
+                ({"peer": p}, v[0])
+                for p, v in sorted(self._health_view().items())
+            ],
+        )
+        self.metrics.gauge(
+            "peer_fail_slow",
+            "1 while the peer is flagged fail-slow (up, pings fine, "
+            "breaker closed — but a sustained factor slower than its "
+            "siblings; demoted in read/repair ranking)",
+            labeled_fn=lambda: [
+                ({"peer": p}, 1.0 if v[1] else 0.0)
+                for p, v in sorted(self._health_view().items())
+            ],
+        )
+        # hooks run once per status-gossip round (and whenever a drill
+        # pushes a round by hand): the flight recorder's disk/cluster
+        # degradation watches live here (model/garage.py registers them)
+        self.status_tick_hooks: List[Callable[[], None]] = []
 
         # node disk gauges, observed at scrape time (ref
         # rpc/system_metrics.rs:77 statvfs-fed data/meta avail gauges);
@@ -303,6 +356,11 @@ class System:
             self.netapp.forget_peer_series(fb)
             self.node_status.pop(fb, None)
             self._status_at.pop(fb, None)
+            # scorer digests + fail-slow verdict go with the peer (a
+            # re-added node inherits no slowness history), and the
+            # merged view drops its series on the next rebuild
+            self.health_scorer.forget(nid)
+            self._health_view_cache = (-1e9, {})
         for cb in self._ring_callbacks:
             try:
                 cb(self.ring)
@@ -384,6 +442,18 @@ class System:
                     float(self.governor_pressure_fn()), 4)
             except Exception:  # noqa: BLE001 — gossip must never break
                 logger.exception("governor_pressure_fn failed")
+        try:
+            scores = self.health_scorer.scores()
+            if scores:
+                st.health_scores = {
+                    p: v["score"] for p, v in scores.items()
+                    if v["score"] is not None
+                }
+                st.fail_slow = [
+                    p for p, v in scores.items() if v["fail_slow"]
+                ]
+        except Exception:  # noqa: BLE001 — gossip must never break
+            logger.exception("health scorer snapshot failed")
         return st
 
     def _disk_stats(self) -> dict:
@@ -451,6 +521,15 @@ class System:
         # partition heals only by operator action (observed: star
         # survivors couldn't reach table quorums after node loss).
         # ref: netapp's FullMeshPeeringStrategy PeerList exchange.
+        # Status-tick hooks first (flight-recorder degradation watches,
+        # registered by model/garage.py): they observe state transitions
+        # on the gossip cadence, and a drill pushing a round by hand
+        # evaluates them immediately
+        for hook in self.status_tick_hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — watches never break gossip
+                logger.exception("status tick hook failed")
         msg = {
             "t": "advertise_status",
             "status": self._local_status().pack(),
@@ -552,8 +631,20 @@ class System:
             return {"ok": True}, None
         if t == "advertise_status":
             st = NodeStatus.unpack(msg["status"])
+            prev = self.node_status.get(FixedBytes32(remote))
             self.node_status[FixedBytes32(remote)] = st
             self._status_at[FixedBytes32(remote)] = time.monotonic()
+            # fresh gossip may carry new fail-slow verdicts: rebuild
+            # the merged health view on the next read (drills assert
+            # flag propagation within a bounded number of exchanges).
+            # Only when the health fields actually CHANGED — at fleet
+            # scale every node receives N-1 statuses per interval, and
+            # unconditionally nuking the ~1 s cache would make
+            # peer_rank re-score per gossip message
+            if (prev is None
+                    or prev.health_scores != st.health_scores
+                    or prev.fail_slow != st.fail_slow):
+                self._health_view_cache = (-1e9, {})
             # a peer with a newer layout triggers a pull
             if st.layout_version > self.layout.version:
                 asyncio.get_running_loop().create_task(self._pull_layout(remote))
@@ -655,6 +746,94 @@ class System:
         if at is None or time.monotonic() - at > self.PRESSURE_TTL:
             return 0.0
         return float(st.governor_pressure)
+
+    # --- fleet health (fail-slow detection; utils/health_score.py) ---
+
+    def _health_note(self, node, cls: str, seconds: float) -> None:
+        """Per-peer service-time tap (RpcHelper call outcomes + peering
+        ping RTTs).  DURABLE peers only: a throwaway CLI connection must
+        not grow scorer digests or `peer_health_score` series."""
+        st = self.peering.peers.get(FixedBytes32(bytes(node)))
+        if st is None or st.addr is None:
+            return
+        try:
+            self.health_scorer.note(bytes(node), cls, seconds)
+        except Exception:  # noqa: BLE001 — scoring never breaks calls
+            logger.debug("health note failed", exc_info=True)
+
+    def _health_view(self) -> Dict[str, list]:
+        """{peer hex16: [score, flagged]} — the LOCAL scorer's verdicts
+        merged with every fresh gossiped report (max score wins; any
+        fresh reporter's flag flags).  Gossip staleness uses the same
+        TTL as pressure: a reporter that died must not keep a peer
+        demoted forever.  Cached ~1 s — peer_rank consults this per
+        candidate."""
+        now = time.monotonic()
+        ts, view = self._health_view_cache
+        if now - ts < 1.0:
+            return view
+        view = {}
+        try:
+            for p, v in self.health_scorer.scores().items():
+                view[p] = [v["score"] if v["score"] is not None else 0.0,
+                           bool(v["fail_slow"])]
+        except Exception:  # noqa: BLE001
+            logger.debug("health scorer read failed", exc_info=True)
+        me = bytes(self.id).hex()[:16]
+        for nid, st in self.node_status.items():
+            at = self._status_at.get(nid)
+            if at is None or now - at > self.PRESSURE_TTL:
+                continue
+            for p, s in (st.health_scores or {}).items():
+                if p == me:
+                    continue  # peers' view of US never demotes a third
+                cur = view.setdefault(p, [0.0, False])
+                if s is not None and float(s) > cur[0]:
+                    cur[0] = float(s)
+            for p in (st.fail_slow or []):
+                if p == me:
+                    continue
+                view.setdefault(p, [0.0, False])[1] = True
+        self._health_view_cache = (now, view)
+        return view
+
+    def peer_core_row(self, nid, st) -> dict:
+        """The shared per-peer health core — admin ``cluster stats``
+        rows and the flight recorder's ``peers`` section both build on
+        it, so a new gossiped field lands in both views at once.
+        ``st`` is the peering PeerState for ``nid``."""
+        status = self.node_status.get(nid)
+        return {
+            "id": bytes(nid).hex(),
+            "zone": self.zone_of(nid),
+            "up": st.is_up,
+            "rtt_ewma_ms": (round(st.latency * 1000.0, 3)
+                            if st.latency is not None else None),
+            "breaker": self.peering.breaker_state(nid),
+            "pressure": self.peer_pressure(nid),
+            "health_score": self.peer_health_score(nid),
+            "fail_slow": self.peer_fail_slow(nid),
+            "disk_state": status.disk_state if status else None,
+            "version": (self.netapp.peer_versions.get(nid)
+                        or (status.version if status else None)),
+        }
+
+    def peer_health_score(self, nid) -> Optional[float]:
+        """Merged comparative health score for `nid` (None = nobody can
+        judge it yet)."""
+        v = self._health_view().get(bytes(nid).hex()[:16])
+        return v[0] if v is not None else None
+
+    def peer_fail_slow(self, nid) -> bool:
+        """Is `nid` fail-slow, per the local scorer OR any fresh
+        gossiped reporter?  Consumed by RpcHelper.peer_rank (read
+        ordering) and through it by RepairPlanner survivor ranking —
+        demotion only, never exclusion: a flagged peer still serves
+        when the healthy candidates are exhausted."""
+        if bytes(nid) == bytes(self.id):
+            return False
+        v = self._health_view().get(bytes(nid).hex()[:16])
+        return bool(v is not None and v[1])
 
     def get_known_nodes(self) -> List[dict]:
         """Peer list for status displays (ids as hex, JSON-safe)."""

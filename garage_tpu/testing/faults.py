@@ -68,6 +68,20 @@ FAST_CHAOS_RPC = {
     "block_rpc_timeout": 20.0,
 }
 
+# Fast-twitch [health] tunables for the fail_slow drill (and any test
+# that wants flag transitions inside seconds instead of the production
+# 30 s sustained window): factor/hysteresis are the PRODUCTION values —
+# only the time constants and sample floors shrink, so the drill proves
+# the same comparative logic the fleet runs.
+FAST_CHAOS_HEALTH = {
+    "fail_slow_factor": 3.0,
+    "clear_factor": 1.5,
+    "window_s": 0.4,
+    "min_samples": 4,
+    "min_baseline_peers": 1,
+    "sample_ttl_s": 60.0,
+}
+
 
 class FaultyLink(LatencyProxy):
     """One directed network path with live-tunable faults.  All knobs are

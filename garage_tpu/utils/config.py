@@ -26,7 +26,9 @@ from typing import Any, Dict, List, Optional
 
 from ..net.resilience import ResilienceTunables
 from ..ops.codec import CodecParams as _CodecParams
+from .health_score import HealthTunables
 from .overload import OverloadTunables
+from .slo import SloTunables
 
 _CODEC_DEFAULTS = _CodecParams()
 
@@ -309,6 +311,18 @@ class Config:
     # them) and the background load governor's thresholds; see
     # docs/ROBUSTNESS.md "Overload & brownout"
     api: OverloadTunables = field(default_factory=OverloadTunables)
+    # [health] — fail-slow peer detection: the comparative scorer's
+    # factor/window/hysteresis knobs (docs/OBSERVABILITY.md "Fleet
+    # health & SLOs")
+    health: HealthTunables = field(default_factory=HealthTunables)
+    # [slo] — per-endpoint availability + latency-threshold objectives
+    # tracked as multi-window burn rates; [[slo.objective]] tables
+    # override the defaults per endpoint
+    slo: SloTunables = field(default_factory=SloTunables)
+    # incident flight recorder (utils/flightrec.py): bundles kept on
+    # disk (oldest deleted first) and the auto-trigger debounce window
+    incident_max_bundles: int = 16
+    incident_debounce_secs: float = 60.0
     consul_discovery: Optional[ConsulDiscoveryConfig] = None
     kubernetes_discovery: Optional[KubernetesDiscoveryConfig] = None
     # raw parsed TOML for anything not modeled
@@ -477,6 +491,78 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
     if cfg.api.retry_after_max < max(int(cfg.api.retry_after), 1):
         raise ConfigError(
             "api.retry_after_max must be >= api.retry_after (and >= 1)")
+
+    health = raw.get("health", {})
+    known = {f.name for f in dataclasses.fields(HealthTunables)}
+    bad = set(health) - known
+    if bad:
+        raise ConfigError(f"unknown [health] keys: {sorted(bad)}")
+    cfg.health = HealthTunables(**health)
+    if cfg.health.fail_slow_factor <= 1.0:
+        raise ConfigError("health.fail_slow_factor must be > 1")
+    if not 1.0 <= cfg.health.clear_factor <= cfg.health.fail_slow_factor:
+        raise ConfigError(
+            "health.clear_factor must be in [1, fail_slow_factor] "
+            "(the hysteresis band)")
+    if cfg.health.window_s < 0:
+        raise ConfigError("health.window_s must be >= 0")
+    if cfg.health.min_samples < 1 or cfg.health.min_baseline_peers < 1:
+        raise ConfigError(
+            "health.min_samples and health.min_baseline_peers must be >= 1")
+    if cfg.health.sample_ttl_s <= 0:
+        raise ConfigError("health.sample_ttl_s must be > 0")
+
+    slo = dict(raw.get("slo", {}))
+    # TOML [[slo.objective]] array-of-tables → the objectives list
+    if "objective" in slo:
+        slo["objectives"] = list(slo.pop("objective") or [])
+    known = {f.name for f in dataclasses.fields(SloTunables)}
+    bad = set(slo) - known
+    if bad:
+        raise ConfigError(f"unknown [slo] keys: {sorted(bad)}")
+    cfg.slo = SloTunables(**slo)
+    if not 0 < cfg.slo.bucket_s <= cfg.slo.fast_window_s \
+            <= cfg.slo.slow_window_s:
+        raise ConfigError(
+            "[slo] needs 0 < bucket_s <= fast_window_s <= slow_window_s")
+    if not 0.0 < cfg.slo.default_availability < 1.0:
+        raise ConfigError("slo.default_availability must be in (0, 1)")
+    if cfg.slo.default_latency_ms <= 0:
+        raise ConfigError("slo.default_latency_ms must be > 0")
+    if cfg.slo.fast_burn_threshold <= 0 or cfg.slo.min_events < 1:
+        raise ConfigError(
+            "slo.fast_burn_threshold must be > 0 and slo.min_events >= 1")
+    if cfg.slo.max_endpoints < 1:
+        raise ConfigError("slo.max_endpoints must be >= 1")
+    for o in cfg.slo.objectives:
+        if not isinstance(o, dict) or not o.get("endpoint"):
+            raise ConfigError(
+                "[[slo.objective]] entries need an `endpoint` key")
+        extra = set(o) - {"endpoint", "availability", "latency_ms"}
+        if extra:
+            raise ConfigError(
+                f"unknown [[slo.objective]] keys: {sorted(extra)}")
+        av = o.get("availability")
+        if av is not None and not 0.0 < float(av) < 1.0:
+            raise ConfigError("objective availability must be in (0, 1)")
+        lm = o.get("latency_ms")
+        if lm is not None and float(lm) <= 0:
+            raise ConfigError("objective latency_ms must be > 0")
+
+    incident = raw.get("incident", {})
+    bad = set(incident) - {"max_bundles", "debounce_secs"}
+    if bad:
+        raise ConfigError(f"unknown [incident] keys: {sorted(bad)}")
+    if "max_bundles" in incident:
+        v = incident["max_bundles"]
+        if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+            raise ConfigError("incident.max_bundles must be an integer >= 1")
+        cfg.incident_max_bundles = v
+    if "debounce_secs" in incident:
+        v = float(incident["debounce_secs"])
+        if v < 0:
+            raise ConfigError("incident.debounce_secs must be >= 0")
+        cfg.incident_debounce_secs = v
 
     table = raw.get("table", {})
     known = {f.name for f in dataclasses.fields(TableTunables)}

@@ -257,6 +257,36 @@ def current_trace_id() -> Optional[str]:
     return ctx.trace_id if ctx is not None else None
 
 
+def install_log_trace_ids() -> None:
+    """Log↔trace correlation: inject the task-local trace id into every
+    log record as ``record.trace_id`` ("-" outside any request scope),
+    so a log line emitted while serving a request carries the SAME key
+    as the retained waterfall (`request waterfall --trace`), the
+    histogram exemplar, and the x-amz-request-id the client holds —
+    grep the incident bundle's trace id through the logs and every line
+    of that request lines up.
+
+    Implemented as a record FACTORY, not a Filter: factories apply to
+    every logger/handler at once (one formatter change in cli.main
+    turns it on), and a filter attached to the root handler would miss
+    records from handlers added later.  Idempotent; never raises into
+    the logging call."""
+    old = logging.getLogRecordFactory()
+    if getattr(old, "_garage_tpu_trace", False):
+        return
+
+    def factory(*args, **kwargs):
+        record = old(*args, **kwargs)
+        try:
+            record.trace_id = current_trace_id() or "-"
+        except Exception:  # noqa: BLE001 — logging must never break
+            record.trace_id = "-"
+        return record
+
+    factory._garage_tpu_trace = True
+    logging.setLogRecordFactory(factory)
+
+
 def current_trace_context() -> Optional[TraceContext]:
     """The context to INJECT into an outgoing RPC: the current local
     span's identity, or (when this node created no span of its own, e.g.

@@ -6,6 +6,12 @@ PUT/GET traffic through a sequence of network-fault phases:
 
   baseline    clean links (sanity + latency floor)
   latency     one peer at ~10× RTT with jitter (tail-latency regime)
+  fail_slow   one node slow-but-UP (latency only: no resets, pings
+              succeed, breaker stays closed) — the comparative scorer
+              must flag it (`peer_fail_slow`) within a bounded number
+              of status exchanges, reads keep flowing with zero client
+              errors while ranking demotes it, and the flag clears
+              after heal (ISSUE 15 fleet-health acceptance)
   flaky       10% connection resets on one link
   oneway      one-way partition gateway→replica (requests vanish,
               replies flow)
@@ -72,8 +78,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-PHASES = ("baseline", "latency", "flaky", "oneway", "partition",
-          "blackhole", "disk")
+PHASES = ("baseline", "latency", "fail_slow", "flaky", "oneway",
+          "partition", "blackhole", "disk")
 # canonical run order: the drain REMOVES a zone from the layout, so it
 # must come last — a rolling zone restart after a drain would take out
 # 2 of 3 replicas on layouts that can no longer spread wider.  compound
@@ -100,6 +106,13 @@ QOS_PHASES = ("noisy_neighbor",)
 def _apply(inj, phase):
     if phase == "latency":
         inj.slow_peer(2, 0.02, jitter=0.005)
+    elif phase == "fail_slow":
+        # slow-but-up: latency well above the siblings' (the scorer's
+        # factor is 3x the cluster median) but no resets and far below
+        # the breaker's absolute RTT floor (breaker_rtt_min 1 s), so
+        # pings succeed and the breaker STAYS CLOSED — the gray-failure
+        # regime only comparative scoring catches
+        inj.slow_peer(2, 0.03, jitter=0.005)
     elif phase == "flaky":
         inj.flaky_link(0, 1, 0.10)
     elif phase == "oneway":
@@ -120,7 +133,11 @@ async def run(phases, secs):
     import numpy as np
 
     import bench
-    from garage_tpu.testing.faults import FAST_CHAOS_RPC, FaultInjector
+    from garage_tpu.testing.faults import (
+        FAST_CHAOS_HEALTH,
+        FAST_CHAOS_RPC,
+        FaultInjector,
+    )
 
     rng = random.Random(1031)
     nprng = np.random.default_rng(57)
@@ -131,7 +148,7 @@ async def run(phases, secs):
         garages, server, port, kid, secret = await bench._mk_cluster(
             Path(tmp), n=3, repl="3", db="memory",
             codec_cfg={"rs_data": 0, "rs_parity": 0, "backend": "cpu"},
-            rpc_cfg=FAST_CHAOS_RPC)
+            rpc_cfg=FAST_CHAOS_RPC, health_cfg=FAST_CHAOS_HEALTH)
         inj = FaultInjector(garages)
         await inj.add_network_faults(rng=random.Random(7))
         try:
@@ -180,6 +197,12 @@ async def run(phases, secs):
                         if i % 5 == 0:
                             for g in garages:
                                 await g.system.peering._tick()
+                            if phase == "fail_slow":
+                                # status-gossip rounds on the drill's
+                                # clock, not the 10 s daemon interval:
+                                # the flag bound below counts EXCHANGES
+                                for g in garages:
+                                    await g.system.advertise_status()
                         if phase == "disk":
                             from garage_tpu.block.health import \
                                 DISK_STATE_VALUES
@@ -187,6 +210,45 @@ async def run(phases, secs):
                             disk_worst = max(disk_worst, max(
                                 DISK_STATE_VALUES[s]
                                 for s in victim_health.states().values()))
+                    if phase == "fail_slow":
+                        # ISSUE-15 acceptance: the slow-but-up node is
+                        # flagged by the COMPARATIVE scorer within a
+                        # bounded number of status exchanges, while its
+                        # breaker stays closed (pings succeed — nothing
+                        # absolute is wrong with it)
+                        g0 = garages[0]
+                        n2 = garages[2].system.id
+                        exchanges = 0
+                        for _ in range(12):
+                            if g0.system.peer_fail_slow(n2):
+                                break
+                            exchanges += 1
+                            st, _b, _h = await s3.req(
+                                "GET", f"/chaos/{rng.choice(sorted(acked))}")
+                            if st != 200:
+                                stats["errors"] += 1
+                            for g in garages:
+                                await g.system.peering._tick()
+                                await g.system.advertise_status()
+                            await asyncio.sleep(0.15)
+                        stats["fail_slow_flagged"] = (
+                            g0.system.peer_fail_slow(n2))
+                        stats["flag_extra_exchanges"] = exchanges
+                        stats["health_score"] = (
+                            g0.system.peer_health_score(n2))
+                        stats["breaker_during"] = (
+                            g0.system.peering.breaker_state(n2))
+                        summary["ok"] &= stats["fail_slow_flagged"]
+                        summary["ok"] &= stats["breaker_during"] == "closed"
+                        # demoted in read/repair ranking: band 3 — after
+                        # breaker-open (4), before RTT within the band
+                        rank = g0.system.rpc.peer_rank(n2)
+                        stats["rank_band"] = rank[0]
+                        summary["ok"] &= rank[0] == 3
+                        # the metric families the dashboard map reads
+                        body = g0.system.metrics.render()
+                        summary["ok"] &= "peer_fail_slow" in body
+                        summary["ok"] &= "peer_health_score" in body
                     if phase == "blackhole":
                         # the breaker must have opened on the blackholed
                         # peer (fast-fail) — observable, not inferred
@@ -222,6 +284,37 @@ async def run(phases, secs):
                         summary["ok"] &= state == "ok"
                     inj.heal_network()
                     await inj.reconnect()
+                    if phase == "fail_slow":
+                        # …and the flag must CLEAR after heal: fresh
+                        # fast samples pull the peer's digests back
+                        # under clear_factor x the median, sustained
+                        # for the hysteresis window — organic recovery,
+                        # no operator reset
+                        g0 = garages[0]
+                        n2 = garages[2].system.id
+                        cleared = False
+                        recover = time.monotonic() + 25.0
+                        while time.monotonic() < recover:
+                            st, _b, _h = await s3.req(
+                                "PUT",
+                                f"/chaos/heal-{time.monotonic():.3f}",
+                                b"y" * 8192)
+                            if st != 200:
+                                stats["errors"] += 1
+                            probe = rng.choice(sorted(acked))
+                            st, _b, _h = await s3.req(
+                                "GET", f"/chaos/{probe}")
+                            if st != 200:
+                                stats["errors"] += 1
+                            for g in garages:
+                                await g.system.peering._tick()
+                                await g.system.advertise_status()
+                            if not g0.system.peer_fail_slow(n2):
+                                cleared = True
+                                break
+                        stats["fail_slow_after_heal"] = (
+                            g0.system.peer_fail_slow(n2))
+                        summary["ok"] &= cleared
                     if phase == "blackhole":
                         # …and recover: cooldown, then one probe call
                         await asyncio.sleep(FAST_CHAOS_RPC["breaker_open_secs"] + 0.2)

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Capture (or list) incident flight-recorder bundles on a running node.
+
+One call snapshots everything an incident post-mortem needs while the
+evidence still exists: metrics, retained waterfalls, the device
+timeline, breaker/disk/governor/peer state, recent gate events, SLO
+budgets (utils/flightrec.py).  The daemon also captures automatically —
+debounced — on fast-burn SLO breaches, fail-slow flag transitions and
+disk/cluster degradation; this script is the operator's manual trigger
+and the way to pull the listing.
+
+Usage:
+    scripts/dev_cluster.sh &            # or any running daemon
+    python scripts/incident_dump.py [-c CONFIG] [--reason WHY]
+    python scripts/incident_dump.py --list
+    python scripts/incident_dump.py -o bundle.json   # copy latest out
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASE = os.environ.get("GARAGE_TPU_DEV_DIR", "/tmp/garage_tpu_dev")
+
+
+async def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-c", "--config",
+                    default=f"{BASE}/node0/garage.toml")
+    ap.add_argument("--rpc-host", default=None)
+    ap.add_argument("--reason", default="operator")
+    ap.add_argument("--list", action="store_true",
+                    help="list retained bundles instead of capturing")
+    ap.add_argument("-o", "--out", default=None,
+                    help="copy the captured bundle to this path")
+    args = ap.parse_args()
+
+    from garage_tpu.cli import AdminClient
+
+    client = AdminClient(args.config, args.rpc_host)
+    if args.list:
+        bundles = await client.call({"cmd": "incident_list"})
+        for b in bundles:
+            print(f"{b.get('captured_at')}\t{b.get('trigger')}\t"
+                  f"{b.get('reason')}\t{b['path']}")
+        print(f"{len(bundles)} bundle(s) retained")
+        return 0
+    out = await client.call({"cmd": "incident_capture",
+                             "reason": args.reason})
+    path = out["path"]
+    with open(path) as f:
+        bundle = json.load(f)
+    sections = bundle.get("sections", {})
+    broken = [k for k, v in sections.items()
+              if isinstance(v, dict) and "error" in v]
+    print(f"bundle written: {path}")
+    print(f"sections: {', '.join(sorted(sections))}")
+    if broken:
+        print(f"collector errors: {broken}", file=sys.stderr)
+    if args.out:
+        shutil.copyfile(path, args.out)
+        print(f"copied to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

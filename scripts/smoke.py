@@ -232,6 +232,46 @@ async def main() -> None:
           f"{seg_sum * 1000:.1f}ms of {wf['seconds'] * 1000:.1f}ms, "
           f"{n_events} timeline events, docs lint clean on 3 nodes)")
 
+    # 9. fleet health & SLOs (ISSUE 15): the traffic above must have
+    # populated the gateway's SLO tracker — `slo status` shows a
+    # PutObject budget row with its budget intact — and a manual
+    # incident capture on ALL 3 live nodes must produce a
+    # schema-checked bundle whose core sections collected cleanly
+    slo = _json.loads(cli("slo", "status", "--json"))
+    eps = {r["endpoint"] for r in slo["rows"]}
+    assert "PutObject" in eps and "GetObject" in eps, eps
+    put_av = next(r for r in slo["rows"]
+                  if r["endpoint"] == "PutObject"
+                  and r["slo"] == "availability")
+    assert put_av["events"] > 0, put_av
+    assert put_av["budget_remaining"] > 0.5, \
+        f"smoke burned the PutObject budget: {put_av}"
+    rpc_hosts = (None, "127.0.0.1:3911", "127.0.0.1:3921")
+    core = {"metrics", "slo", "peers", "governor", "disk",
+            "waterfalls", "device_timeline", "cluster_health"}
+    for host in rpc_hosts:
+        host_args = () if host is None else ("--rpc-host", host)
+        out = cli(*host_args, "incident", "capture",
+                  "--reason", "smoke-step9")
+        path = out.split("bundle written:")[1].strip()
+        with open(path) as f:
+            bundle = _json.load(f)
+        assert bundle["schema"] == "garage_tpu.incident/1", bundle["schema"]
+        assert bundle["trigger"] == "manual" and bundle["reason"] == \
+            "smoke-step9", (bundle["trigger"], bundle["reason"])
+        missing = core - set(bundle["sections"])
+        assert not missing, f"bundle on {host or 'node0'} missing {missing}"
+        broken = {k for k in core
+                  if isinstance(bundle["sections"][k], dict)
+                  and "error" in bundle["sections"][k]}
+        assert not broken, f"collectors failed on {host or 'node0'}: " \
+            f"{ {k: bundle['sections'][k] for k in broken} }"
+    listing = cli("incident", "list")
+    assert "smoke-step9" in listing, listing
+    print(f"fleet-health smoke ok (slo rows={len(slo['rows'])}, "
+          f"PutObject budget {put_av['budget_remaining'] * 100:.1f}% left, "
+          f"incident bundles schema-clean on 3 nodes)")
+
     print("SMOKE OK")
 
 

@@ -32,6 +32,13 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/transport_smoke.py
 # recover — all with zero client errors
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
     --phases partition,disk
+# fail-slow (gray failure) smoke (ISSUE-15 acceptance): one node made
+# slow-but-up (latency only — pings succeed, breaker stays CLOSED) must
+# be flagged by the comparative scorer (`peer_fail_slow`) within a
+# bounded number of status exchanges, demoted in read/repair ranking,
+# and unflagged after heal — zero client-visible errors throughout
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases fail_slow
 # zone-scale smoke (small shape of the ISSUE-7 acceptance drive): one
 # zone blackholed, one zone drained under live load (rebalance mover
 # completes, acked objects bit-identical), one-zone-at-a-time rolling

@@ -1,0 +1,723 @@
+"""Fleet health plane (ISSUE 15): fail-slow detection, SLO burn rates,
+incident flight recorder.
+
+Unit half, all on fake clocks: the comparative scorer's sustained-window
+hysteresis and median robustness at <= 3 peers, burn-rate golden cases,
+flight-recorder schema/debounce/retention, gossip roundtrip of the
+score, and the (breaker, fail-slow, zone, pressure-bucket, RTT) rank
+key — including the ROADMAP's load-aware survivor regression: a
+pressured-but-reachable survivor is deprioritized in repair planning.
+
+Integration tail: one real node's /metrics carries every new family,
+promlint- and metricsdoc-clean.  The LIVE drill (slow-but-up node
+flagged, demoted, unflagged after heal with zero client errors) is
+scripts/chaos.py --phases fail_slow, wired into test_smoke.sh.
+"""
+
+import json
+import logging
+import os
+
+import pytest
+
+from garage_tpu.utils.flightrec import SCHEMA, FlightRecorder
+from garage_tpu.utils.health_score import FailSlowScorer, HealthTunables
+from garage_tpu.utils.slo import SloTracker, SloTunables
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+A, B, C, D = (b"\x0a" * 32, b"\x0b" * 32, b"\x0c" * 32, b"\x0d" * 32)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+def feed(scorer, peer, cls, seconds, n=8):
+    for _ in range(n):
+        scorer.note(peer, cls, seconds)
+
+
+# --- comparative scorer ------------------------------------------------------
+
+
+def test_scorer_sustained_window_and_hysteresis():
+    clk = FakeClock()
+    events = []
+    tun = HealthTunables(window_s=10.0, min_samples=4,
+                         min_baseline_peers=2)
+    sc = FailSlowScorer(tun, clock=clk,
+                        on_change=lambda p, f, s: events.append((p, f)))
+    feed(sc, A, "rpc", 0.001)
+    feed(sc, B, "rpc", 0.001)
+    feed(sc, C, "rpc", 0.010)  # 10x the median of {A, B}
+    sc.update()
+    # above the factor but NOT sustained yet: no flag
+    assert not sc.fail_slow(C) and events == []
+    assert sc.score(C) == pytest.approx(10.0, rel=0.05)
+    clk.tick(5.0)
+    sc.update()
+    assert not sc.fail_slow(C)
+    clk.tick(6.0)
+    sc.update()
+    # 11 s continuously above: flagged, transition emitted once
+    assert sc.fail_slow(C)
+    assert events == [(C.hex()[:16], True)]
+    assert not sc.fail_slow(A) and not sc.fail_slow(B)
+
+    # hysteresis band (clear 1.5 < score < factor 3): NOTHING happens,
+    # no matter how long it sits there
+    feed(sc, C, "rpc", 0.002, n=64)  # ewma -> ~2 ms, score ~2
+    clk.tick(100.0)
+    sc.update()
+    assert 1.5 < sc.score(C) < 3.0
+    assert sc.fail_slow(C) and len(events) == 1
+
+    # genuinely healthy again: clears only after the sustained window
+    feed(sc, C, "rpc", 0.001, n=64)
+    sc.update()
+    assert sc.fail_slow(C)  # below clear_factor, window not yet served
+    clk.tick(11.0)
+    sc.update()
+    assert not sc.fail_slow(C)
+    assert events == [(C.hex()[:16], True), (C.hex()[:16], False)]
+    assert sc.transitions == 2
+
+
+def test_scorer_median_robustness_small_clusters():
+    # 3 peers, one slow: the lower median anchors to the healthy pair,
+    # so the slow peer scores high and the healthy ones score ~1 even
+    # though the MEAN is dragged
+    clk = FakeClock()
+    tun = HealthTunables(window_s=0.0, min_samples=4,
+                         min_baseline_peers=1)
+    sc = FailSlowScorer(tun, clock=clk)
+    feed(sc, A, "rpc", 0.001)
+    feed(sc, B, "rpc", 0.001)
+    feed(sc, C, "rpc", 0.030)
+    sc.update()
+    assert sc.fail_slow(C)
+    assert sc.score(A) == pytest.approx(1.0, rel=0.1)
+    assert not sc.fail_slow(A) and not sc.fail_slow(B)
+
+    # 2 peers: the slow one is judged against the fast one's digest —
+    # flagged; the fast one scores << 1 against the slow baseline
+    sc2 = FailSlowScorer(tun, clock=clk)
+    feed(sc2, A, "rpc", 0.001)
+    feed(sc2, C, "rpc", 0.030)
+    sc2.update()
+    assert sc2.fail_slow(C) and not sc2.fail_slow(A)
+
+    # 1 peer: nobody to compare against — never judgeable, never flagged
+    sc3 = FailSlowScorer(tun, clock=clk)
+    feed(sc3, C, "rpc", 10.0)
+    sc3.update()
+    assert sc3.score(C) is None and not sc3.fail_slow(C)
+
+    # min_baseline_peers=2 withholds the verdict at one sibling
+    sc4 = FailSlowScorer(
+        HealthTunables(window_s=0.0, min_samples=4, min_baseline_peers=2),
+        clock=clk)
+    feed(sc4, A, "rpc", 0.001)
+    feed(sc4, C, "rpc", 0.030)
+    sc4.update()
+    assert sc4.score(C) is None
+
+
+def test_scorer_ttl_expires_stale_digests_and_flags():
+    clk = FakeClock()
+    tun = HealthTunables(window_s=0.0, min_samples=4,
+                         min_baseline_peers=1, sample_ttl_s=50.0)
+    events = []
+    sc = FailSlowScorer(tun, clock=clk,
+                        on_change=lambda p, f, s: events.append((p, f)))
+    feed(sc, A, "rpc", 0.001)
+    feed(sc, C, "rpc", 0.030)
+    sc.update()
+    assert sc.fail_slow(C)
+    # the cluster stops calling C entirely: its (and everyone's) digests
+    # age out and the stale flag clears — unreachable is the breaker's
+    # job, not the scorer's
+    clk.tick(60.0)
+    sc.update()
+    assert not sc.fail_slow(C)
+    assert (C.hex()[:16], False) in events
+
+
+def test_scorer_forget_drops_history():
+    clk = FakeClock()
+    sc = FailSlowScorer(HealthTunables(window_s=0.0, min_samples=4,
+                                       min_baseline_peers=1), clock=clk)
+    feed(sc, A, "rpc", 0.001)
+    feed(sc, C, "rpc", 0.030)
+    sc.update()
+    assert sc.fail_slow(C)
+    sc.forget(C)
+    assert not sc.fail_slow(C) and sc.score(C) is None
+
+
+# --- rank key: (breaker, fail-slow, zone, pressure-bucket, RTT) -------------
+
+
+def _mini_helper():
+    from garage_tpu.net.resilience import ResilienceTunables
+    from garage_tpu.rpc.rpc_helper import RpcHelper
+    from garage_tpu.utils.data import FixedBytes32
+
+    class _Peering:
+        tunables = ResilienceTunables()
+
+        def __init__(self):
+            self.lat = {}
+            self.states = {}
+
+        def breaker_state(self, n):
+            return self.states.get(bytes(n), "closed")
+
+        def latency(self, n):
+            return self.lat.get(bytes(n))
+
+    class _Netapp:
+        id = FixedBytes32(b"\x00" * 32)
+
+    peering = _Peering()
+    return RpcHelper(_Netapp(), peering), peering
+
+
+def test_peer_rank_pressure_bucket_and_fail_slow_bands():
+    from garage_tpu.utils.data import FixedBytes32
+
+    helper, peering = _mini_helper()
+    a, b, c, d = (FixedBytes32(x) for x in (A, B, C, D))
+    peering.lat = {A: 0.001, B: 0.005, C: 0.0005, D: 0.0005}
+    pressures = {A: 1.2}       # fast but saturated
+    flagged = {C}              # fastest RTT but fail-slow
+    peering.states[D] = "open"  # breaker open
+    helper.pressure_of = lambda n: pressures.get(bytes(n), 0.0)
+    helper.fail_slow_of = lambda n: bytes(n) in flagged
+    order = helper.request_order([a, b, c, d])
+    # idle B beats pressured-but-faster A (load-aware half of the
+    # degraded-reads paper); fail-slow C demotes after every healthy
+    # peer but before breaker-open D
+    assert [bytes(n) for n in order] == [B, A, C, D]
+    assert helper.peer_rank(c)[0] == 3
+    assert helper.peer_rank(d)[0] == 4
+    # with no health source wired the ordering is pure (zone, RTT)
+    helper2, peering2 = _mini_helper()
+    peering2.lat = {A: 0.001, B: 0.005, C: 0.0005}
+    order2 = helper2.request_order([a, b, c])
+    assert [bytes(n) for n in order2] == [C, A, B]
+
+
+def test_repair_planner_deprioritizes_pressured_survivor():
+    """ROADMAP regression (load-aware survivor scheduling): two
+    reachable holders of equivalent pieces — the planner fetches from
+    the idle one first, the pressured-but-reachable one is the
+    replacement, not the plan."""
+    from garage_tpu.block.repair_plan import RepairPlanner, _Piece
+    from garage_tpu.utils.data import FixedBytes32
+
+    helper, peering = _mini_helper()
+    peering.lat = {A: 0.001, B: 0.001}
+    pressures = {A: 1.5}
+    helper.pressure_of = lambda n: pressures.get(bytes(n), 0.0)
+
+    class _Sys:
+        rpc = helper
+        id = b"\x00" * 32
+
+        def peer_version(self, nid):
+            return None
+
+    class _Repl:
+        def __init__(self, holders):
+            self.holders = holders
+
+        def read_nodes(self, h):
+            return [FixedBytes32(n) for n in self.holders[bytes(h)]]
+
+    class _Mgr:
+        system = _Sys()
+        codec = object()
+        feeder = None
+        hash_algo = "blake2s"
+        block_rpc_timeout = 1.0
+
+        def __init__(self, holders):
+            self.replication = _Repl(holders)
+
+    holders = {b"P" * 32: [A], b"Q" * 32: [B]}
+    pieces = [_Piece(0, b"P" * 32, "data"), _Piece(1, b"Q" * 32, "data")]
+    ranked = RepairPlanner(_Mgr(holders)).rank_pieces(pieces)
+    # equal RTT, equal zone: the idle holder's piece ranks first
+    assert [p.index for p in ranked] == [1, 0]
+
+
+# --- SLO burn-rate golden cases ---------------------------------------------
+
+
+def _slo(clk, **kw):
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    kw.setdefault("bucket_s", 10.0)
+    kw.setdefault("default_availability", 0.99)
+    kw.setdefault("default_latency_ms", 100.0)
+    return SloTracker(SloTunables(**kw), clock=clk)
+
+
+def test_burn_rate_golden_availability():
+    clk = FakeClock()
+    t = _slo(clk)
+    for _ in range(90):
+        t.note("PutObject", 0.01, ok=True)
+    for _ in range(10):
+        t.note("PutObject", 0.01, ok=False)
+    # 10% errors against a 1% budget: burn 10x in both windows
+    assert t.burn_rate("PutObject", "availability", 60.0) == \
+        pytest.approx(10.0)
+    assert t.burn_rate("PutObject", "availability", 600.0) == \
+        pytest.approx(10.0)
+    # budget over the slow window: 100 events allow 1 bad, saw 10
+    assert t.budget_remaining("PutObject", "availability") == \
+        pytest.approx(-9.0)
+    # latency SLO untouched: failures never double-count as slow
+    assert t.burn_rate("PutObject", "latency", 600.0) == 0.0
+
+
+def test_burn_rate_golden_latency_and_windows():
+    clk = FakeClock()
+    t = _slo(clk)
+    for _ in range(50):
+        t.note("GetObject", 0.010, ok=True)   # under the 100 ms bound
+    for _ in range(50):
+        t.note("GetObject", 0.500, ok=True)   # over it
+    assert t.burn_rate("GetObject", "latency", 60.0) == pytest.approx(50.0)
+    assert t.budget_remaining("GetObject", "latency") == pytest.approx(-49.0)
+    assert t.burn_rate("GetObject", "availability", 60.0) == 0.0
+    # window expiry: 2 minutes later the fast window is empty, the slow
+    # window still remembers
+    clk.tick(120.0)
+    assert t.burn_rate("GetObject", "latency", 60.0) == 0.0
+    assert t.burn_rate("GetObject", "latency", 600.0) == pytest.approx(50.0)
+    # ...and after the slow window, the budget is whole again
+    clk.tick(600.0)
+    assert t.budget_remaining("GetObject", "latency") == 1.0
+    # no traffic at all: budget intact, burn zero
+    assert t.burn_rate("Idle", "availability", 60.0) == 0.0
+    assert t.budget_remaining("Idle", "availability") == 1.0
+
+
+def test_per_endpoint_objective_overrides_and_status():
+    clk = FakeClock()
+    t = SloTracker(SloTunables(
+        fast_window_s=60.0, slow_window_s=600.0, bucket_s=10.0,
+        default_availability=0.99, default_latency_ms=100.0,
+        objectives=[{"endpoint": "PutObject", "availability": 0.9,
+                     "latency_ms": 1000.0}]), clock=clk)
+    assert t.objective("PutObject") == {
+        "availability": 0.9, "latency_s": 1.0}
+    assert t.objective("GetObject") == {
+        "availability": 0.99, "latency_s": 0.1}
+    for _ in range(9):
+        t.note("PutObject", 0.5, ok=True)
+    t.note("PutObject", 0.5, ok=False)
+    # 10% errors against the RELAXED 10% budget: burn exactly 1.0
+    assert t.burn_rate("PutObject", "availability", 60.0) == \
+        pytest.approx(1.0)
+    rows = t.status()
+    put_av = next(r for r in rows if r["endpoint"] == "PutObject"
+                  and r["slo"] == "availability")
+    assert put_av["events"] == 10 and put_av["bad"] == 1
+    assert put_av["burn_fast"] == pytest.approx(1.0)
+    assert put_av["budget_remaining"] == pytest.approx(0.0)
+
+
+def test_fast_burn_breach_fires_once_until_rearmed():
+    clk = FakeClock()
+    hits = []
+    t = SloTracker(
+        SloTunables(fast_window_s=60.0, slow_window_s=600.0,
+                    bucket_s=10.0, default_availability=0.99,
+                    fast_burn_threshold=10.0, min_events=10),
+        clock=clk,
+        on_fast_burn=lambda ep, slo, burn: hits.append((ep, slo, burn)))
+    for _ in range(20):
+        t.note("PutObject", 0.01, ok=False)
+        clk.tick(1.0)
+    assert len(hits) == 1, hits
+    ep, slo, burn = hits[0]
+    assert (ep, slo) == ("PutObject", "availability") and burn >= 10.0
+    # still burning in later buckets: no re-fire
+    for _ in range(30):
+        t.note("PutObject", 0.01, ok=False)
+        clk.tick(1.0)
+    assert len(hits) == 1
+    # burn subsides (only successes for > the fast window) -> re-arms
+    for _ in range(80):
+        t.note("PutObject", 0.01, ok=True)
+        clk.tick(1.0)
+    for _ in range(30):
+        t.note("PutObject", 0.01, ok=False)
+        clk.tick(1.0)
+    assert len(hits) == 2
+
+
+# --- flight recorder ---------------------------------------------------------
+
+
+def test_flightrec_bundle_schema_and_collector_errors(tmp_path):
+    wall, mono = FakeClock(1700000000.0), FakeClock(0.0)
+    fr = FlightRecorder(str(tmp_path / "inc"), node_id="abcd",
+                        clock=wall, mono=mono)
+    fr.add_collector("good", lambda: {"k": 1, "blob": b"\x01\x02"})
+    fr.add_collector("bad", lambda: 1 / 0)
+    path = fr.capture("unit-test", detail={"why": "schema"})
+    b = json.load(open(path))
+    assert b["schema"] == SCHEMA
+    assert b["node_id"] == "abcd" and b["trigger"] == "manual"
+    assert b["reason"] == "unit-test" and b["detail"] == {"why": "schema"}
+    assert b["captured_at"] == pytest.approx(1700000000.0)
+    assert b["sections"]["good"]["k"] == 1
+    # non-JSON values survive as hex/repr, never a crash
+    assert b["sections"]["good"]["blob"] == "0102"
+    assert "ZeroDivisionError" in b["sections"]["bad"]["error"]
+
+
+def test_flightrec_debounce_and_manual_bypass(tmp_path):
+    wall, mono = FakeClock(1700000000.0), FakeClock(0.0)
+    fr = FlightRecorder(str(tmp_path / "inc"), debounce_s=60.0,
+                        clock=wall, mono=mono)
+    assert fr.trigger("slo_fast_burn") is not None
+    wall.tick(1.0)
+    mono.tick(1.0)
+    # a second auto trigger inside the window — same storm, ONE bundle
+    assert fr.trigger("fail_slow_set") is None
+    assert fr.captures == 1 and fr.suppressed == 1
+    # manual capture always lands
+    wall.tick(1.0)
+    assert fr.capture("operator") is not None
+    assert fr.captures == 2
+    # past the window, auto fires again
+    mono.tick(61.0)
+    wall.tick(61.0)
+    assert fr.trigger("disk_degraded") is not None
+    assert fr.captures == 3
+
+
+def test_flightrec_retention_bound(tmp_path):
+    wall, mono = FakeClock(1700000000.0), FakeClock(0.0)
+    fr = FlightRecorder(str(tmp_path / "inc"), max_bundles=3,
+                        debounce_s=0.0, clock=wall, mono=mono)
+    paths = []
+    for i in range(5):
+        wall.tick(1.0)
+        mono.tick(1.0)
+        paths.append(fr.capture(f"r{i}"))
+    kept = fr.bundles()
+    assert len(kept) == 3
+    # oldest deleted first; the newest three survive
+    assert [b["reason"] for b in kept] == ["r2", "r3", "r4"]
+    assert not os.path.exists(paths[0]) and not os.path.exists(paths[1])
+    assert all(b["sections"] is not None for b in kept)
+
+
+def test_slo_breach_captures_exactly_one_debounced_bundle(tmp_path):
+    """ISSUE-15 acceptance shape, unit-sized: an induced fast-burn
+    breach auto-captures exactly ONE bundle while the storm lasts."""
+    wall, mono = FakeClock(1700000000.0), FakeClock(0.0)
+    fr = FlightRecorder(str(tmp_path / "inc"), debounce_s=300.0,
+                        clock=wall, mono=mono)
+    fr.add_collector("marker", lambda: "evidence")
+    clk = FakeClock()
+    t = SloTracker(
+        SloTunables(fast_window_s=60.0, slow_window_s=600.0,
+                    bucket_s=10.0, default_availability=0.99,
+                    fast_burn_threshold=10.0, min_events=10),
+        clock=clk,
+        on_fast_burn=lambda ep, slo, burn: fr.trigger(
+            "slo_fast_burn", {"endpoint": ep, "slo": slo}))
+    for _ in range(120):  # a sustained error storm across many buckets
+        t.note("PutObject", 0.01, ok=False)
+        clk.tick(1.0)
+        mono.tick(1.0)
+        wall.tick(1.0)
+    assert fr.captures == 1
+    b = json.load(open(fr.bundles()[0]["path"]))
+    assert b["reason"] == "slo_fast_burn"
+    assert b["detail"]["endpoint"] == "PutObject"
+    assert b["sections"]["marker"] == "evidence"
+
+
+def test_fast_burn_fires_within_a_single_bucket():
+    """An error burst confined to ONE time bucket — then silence — must
+    still breach: bad events re-evaluate immediately while un-breached,
+    not only on the next bucket's first note."""
+    clk = FakeClock()  # never ticked: everything lands in one bucket
+    fired = []
+    t = SloTracker(
+        SloTunables(fast_window_s=60.0, slow_window_s=600.0,
+                    bucket_s=10.0, default_availability=0.99,
+                    fast_burn_threshold=10.0, min_events=10),
+        clock=clk,
+        on_fast_burn=lambda ep, slo, burn: fired.append((ep, slo)))
+    for _ in range(20):
+        t.note("PutObject", 0.01, ok=False)
+    assert fired == [("PutObject", "availability")]  # fired ONCE, latched
+
+
+def test_flightrec_no_nested_capture_from_collector():
+    """A collector observing a fresh transition mid-capture (e.g. the
+    metrics render's health sweep flips a flag) must not assemble a
+    second bundle inside the first — the in-progress capture documents
+    that same storm."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        wall, mono = FakeClock(1700000000.0), FakeClock(0.0)
+        fr = FlightRecorder(d, debounce_s=0.0, clock=wall, mono=mono)
+        fr.add_collector("reentrant", lambda: fr.trigger("fail_slow_set"))
+        path = fr.capture("outer")
+        assert fr.captures == 1 and fr.suppressed == 1
+        b = json.load(open(path))
+        assert b["sections"]["reentrant"] is None  # suppressed, not nested
+        assert len(fr.bundles()) == 1
+
+
+def test_flightrec_same_millisecond_captures_do_not_clobber(tmp_path):
+    """Two captures in one wall-clock ms (concurrent manual requests)
+    must land as two files — the filename seq disambiguates."""
+    wall, mono = FakeClock(1700000000.0), FakeClock(0.0)
+    fr = FlightRecorder(str(tmp_path / "inc"), debounce_s=0.0,
+                        clock=wall, mono=mono)
+    p1 = fr.capture("manual")
+    p2 = fr.capture("manual")
+    assert p1 != p2
+    assert len(fr.bundles()) == 2 and fr.captures == 2
+
+
+def test_slo_latency_anchor_includes_queue_wait():
+    """The latency SLO judges what the CLIENT observes minus only the
+    client's own pacing: admission queue wait stays in (intake anchor)
+    unless the token carries a body-completion anchor (uploads), and
+    excluded/paced requests never mark slow."""
+    import time as _t
+
+    from garage_tpu.api.common import slo_service_latency
+
+    class Tok:
+        def __init__(self, sl, anchored):
+            self._sl, self._a = sl, anchored
+
+        def service_latency(self):
+            return self._sl
+
+        def body_anchored(self):
+            return self._a
+
+    intake = _t.time_ns() - int(0.5e9)  # intake 500 ms ago
+    lat, paced = slo_service_latency({}, Tok(0.02, False), intake)
+    assert not paced and lat >= 0.5  # WDRR queue wait burns
+    lat, paced = slo_service_latency({}, Tok(0.02, True), intake)
+    assert not paced and lat == 0.02  # upload: post-body service time
+    _lat, paced = slo_service_latency({}, Tok(None, False), intake)
+    assert paced  # CoDel sojourn exclusion
+    _lat, paced = slo_service_latency({"slo_client_paced": True},
+                                      None, intake)
+    assert paced  # request flag covers gate-disabled
+
+
+def test_slo_client_paced_never_burns_latency():
+    """Long-polls and streamed transfers (the CoDel exclusion) count
+    toward availability but must never mark slow: a healthy big-object
+    or long-poll workload cannot burn the latency budget."""
+    clk = FakeClock()
+    t = SloTracker(SloTunables(default_latency_ms=100.0), clock=clk)
+    for _ in range(20):  # 300 s "polls", far past the 100 ms target
+        t.note("K2V:GET", 300.0, ok=True, client_paced=True)
+        clk.tick(1.0)
+    assert t.burn_rate("K2V:GET", "latency", 300.0) == 0.0
+    assert t.budget_remaining("K2V:GET", "latency") == 1.0
+    # the same requests still feed availability (a failed poll burns)
+    t.note("K2V:GET", 300.0, ok=False, client_paced=True)
+    assert t.burn_rate("K2V:GET", "availability", 300.0) > 0.0
+    # and a genuinely slow NON-paced success does mark slow
+    t.note("K2V:GET", 0.5, ok=True)
+    assert t.burn_rate("K2V:GET", "latency", 300.0) > 0.0
+
+
+def test_flightrec_listing_parses_bounded_prefix(tmp_path):
+    """`incident list` must stay cheap: the listing reads a bounded
+    prefix (capture writes every header scalar + section_list before
+    the large sections payload), and a bundle whose header defeats the
+    prefix cut falls back to a full parse instead of vanishing."""
+    wall, mono = FakeClock(1700000000.0), FakeClock(0.0)
+    fr = FlightRecorder(str(tmp_path / "inc"), debounce_s=0.0,
+                        clock=wall, mono=mono)
+    fr.add_collector("metrics", lambda: "x" * 1_000_000)  # a large one
+    fr.add_collector("slo", lambda: [])
+    fr.capture("big-bundle")
+    wall.tick(1.0)
+    # a reason containing the cut marker must not corrupt the listing
+    fr.capture('evil "sections" reason')
+    rows = fr.bundles()
+    assert [r["reason"] for r in rows] == [
+        "big-bundle", 'evil "sections" reason']
+    assert rows[0]["sections"] == ["metrics", "slo"]
+    assert rows[0]["trigger"] == "manual"
+    assert rows[0]["captured_at"] == pytest.approx(1700000000.0)
+
+
+async def test_flightrec_auto_capture_deferred_off_event_loop(tmp_path):
+    """Under a running event loop an AUTO trigger (fired from request
+    hot paths) collects INLINE — the caller is the loop, so collectors
+    read loop-owned state race-free — but defers the expensive
+    serialize + disk write to a worker thread; the bundle still lands,
+    debounced."""
+    import asyncio
+    import threading
+
+    fr = FlightRecorder(str(tmp_path / "inc"), debounce_s=300.0)
+    collect_thread, write_thread = [], []
+    fr.add_collector(
+        "who", lambda: collect_thread.append(
+            threading.current_thread().name) or "x")
+    real_write = fr.write
+
+    def spying_write(bundle):
+        write_thread.append(threading.current_thread().name)
+        return real_write(bundle)
+
+    fr.write = spying_write
+    assert fr.trigger("slo_fast_burn") is None  # deferred, not suppressed
+    # the collector already ran, synchronously, on THIS (loop) thread
+    assert collect_thread == [threading.current_thread().name]
+    for _ in range(100):
+        if fr.captures:
+            break
+        await asyncio.sleep(0.02)
+    assert fr.captures == 1 and fr.suppressed == 0
+    assert write_thread == ["incident-write"]
+    assert fr.trigger("fail_slow_set") is None
+    await asyncio.sleep(0.05)
+    assert fr.captures == 1 and fr.suppressed == 1  # debounce held
+
+
+# --- gossip roundtrip --------------------------------------------------------
+
+
+def test_node_status_health_gossip_roundtrip():
+    from garage_tpu.rpc.system import NodeStatus
+
+    st = NodeStatus(hostname="n1", governor_pressure=0.5,
+                    health_scores={"aabbccdd00112233": 4.25,
+                                   "ffee000000000000": 0.9},
+                    fail_slow=["aabbccdd00112233"])
+    got = NodeStatus.unpack(st.pack())
+    assert got.health_scores == st.health_scores
+    assert got.fail_slow == ["aabbccdd00112233"]
+    # an OLD peer's status (no health fields) unpacks to None — the
+    # merged view treats it as "this reporter has no opinion"
+    old = NodeStatus.unpack({"hostname": "old"})
+    assert old.health_scores is None and old.fail_slow is None
+
+
+# --- log <-> trace correlation (satellite 4) --------------------------------
+
+
+def test_log_records_carry_trace_ids(caplog):
+    from garage_tpu.utils.tracing import Tracer, install_log_trace_ids
+
+    install_log_trace_ids()
+    install_log_trace_ids()  # idempotent: no double-wrapping
+    log = logging.getLogger("garage_tpu.test_fleet_health")
+    tracer = Tracer("test", None)
+    with caplog.at_level(logging.WARNING,
+                         logger="garage_tpu.test_fleet_health"):
+        with tracer.new_trace("S3 PUT", api="s3") as span:
+            log.warning("inside request scope")
+        log.warning("outside request scope")
+    recs = [r for r in caplog.records
+            if r.name == "garage_tpu.test_fleet_health"]
+    assert recs[0].trace_id == span.trace_id
+    assert recs[1].trace_id == "-"
+    # the formatter cli.main installs renders it without raising
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname).1s %(name)s [%(trace_id)s]: %(message)s")
+    assert span.trace_id in fmt.format(recs[0])
+
+
+# --- config parsing ----------------------------------------------------------
+
+
+def test_health_and_slo_config_sections():
+    from garage_tpu.utils.config import ConfigError, config_from_dict
+
+    cfg = config_from_dict({
+        "metadata_dir": "/tmp/x",
+        "health": {"fail_slow_factor": 4.0, "window_s": 5.0},
+        "slo": {"default_availability": 0.995,
+                "objective": [{"endpoint": "PutObject",
+                               "latency_ms": 500.0}]},
+        "incident": {"max_bundles": 4, "debounce_secs": 10.0},
+    })
+    assert cfg.health.fail_slow_factor == 4.0
+    assert cfg.slo.objectives == [{"endpoint": "PutObject",
+                                   "latency_ms": 500.0}]
+    assert cfg.incident_max_bundles == 4
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/x",
+                          "health": {"bogus_knob": 1}})
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/x",
+                          "slo": {"default_availability": 1.5}})
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/x",
+                          "slo": {"objective": [{"latency_ms": 5}]}})
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/x",
+                          "health": {"clear_factor": 9.0}})
+
+
+# --- live node: every new family rendered, promlint + metricsdoc clean ------
+
+
+@pytest.mark.asyncio
+async def test_new_families_promlint_and_docs_clean(tmp_path):
+    from garage_tpu.api.admin_server import metrics_body
+    from garage_tpu.model import Garage
+    from garage_tpu.utils.config import config_from_dict
+    from garage_tpu.utils.metricsdoc import undocumented_families
+    from garage_tpu.utils.promlint import lint_exposition
+
+    g = Garage(config_from_dict({
+        "metadata_dir": str(tmp_path / "meta"),
+        "data_dir": str(tmp_path / "data"),
+        "replication_mode": "none",
+        "db_engine": "memory",
+        "rpc_secret": "test",
+        "codec": {"rs_data": 0, "rs_parity": 0, "backend": "cpu"},
+    }))
+    try:
+        g.slo.note("PutObject", 0.01, ok=True)
+        g.slo.note("PutObject", 9.0, ok=False)
+        g.system.health_scorer.note(A, "rpc", 0.001)
+        g.flightrec.capture("unit")
+        body = metrics_body(g)
+        for fam in ("peer_health_score", "peer_fail_slow",
+                    "slo_error_budget_remaining", "slo_burn_rate",
+                    "incident_capture_total", "incident_suppressed_total",
+                    "incident_bundles_retained"):
+            assert fam in body, f"family {fam} missing from /metrics"
+        assert lint_exposition(body) == [], lint_exposition(body)
+        doc = open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read()
+        missing = undocumented_families(body, doc)
+        assert missing == [], f"undocumented families: {missing}"
+    finally:
+        await g.shutdown()
