@@ -471,8 +471,14 @@ def codec_attribution(codec) -> dict:
     """The BENCH JSON attribution block: the same stage histograms /
     byte counters / gate-event ring a daemon exposes via /metrics and
     `codec events`, embedded so driver-captured runs self-attribute."""
+    prof = getattr(codec.obs, "link_profiler", None)
     return {
         "stages": codec.obs.stage_stats(),
+        # exact-sum host<->device link attribution (ops/link_profiler.py):
+        # per-stage {count, seconds, bytes, gibs} for
+        # stage_copy/adopt/compile/dispatch/compute/collect, recorded by
+        # the DeviceTransport; None until a transport armed this run
+        "link_stages": prof.summary() if prof is not None else None,
         "bytes_by_side": dict(codec.obs.bytes_total),
         "tpu_frac_cumulative": round(codec.obs.tpu_frac(), 4),
         "gate_events": codec.obs.events_list(16),
@@ -2679,6 +2685,75 @@ def _best_prior_headline() -> tuple:
     return best, src
 
 
+def _best_prior_link_stages() -> tuple:
+    """Per-stage best-prior link throughput ledger: {stage: (gibs, src)}
+    across the committed BENCH_r*.json rounds' `attribution.link_stages`
+    blocks.  Rounds captured before the link profiler existed simply
+    contribute nothing; the ledger is empty until one round embeds it."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = {}
+    for p in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        attr = d.get("attribution")
+        if not isinstance(attr, dict):
+            attr = None
+            for line in reversed(str(d.get("tail", "")).splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        attr = json.loads(line).get("attribution")
+                    except ValueError:
+                        attr = None
+                    break
+        stages = (attr or {}).get("link_stages") if isinstance(attr, dict) \
+            else None
+        if not isinstance(stages, dict):
+            continue
+        for stage, rec in stages.items():
+            if stage == "by_kind" or not isinstance(rec, dict):
+                continue
+            g = rec.get("gibs")
+            if isinstance(g, (int, float)) and float(g) > \
+                    best.get(stage, (0.0, None))[0]:
+                best[stage] = (float(g), os.path.basename(p))
+    return best
+
+
+def _stage_ledger(out: dict) -> list:
+    """Compare THIS run's per-stage link throughput against the best
+    prior rounds, stage by stage.  Records `stage_best_prior` and
+    `stage_regressions` in the output JSON and returns the regressed
+    stages (current gibs < HEADLINE_REGRESSION_FRAC x best prior) so the
+    headline guard can name WHICH stage of the host<->device round-trip
+    moved, not just that the headline did."""
+    best = _best_prior_link_stages()
+    out["stage_best_prior"] = {
+        s: {"gibs": round(g, 4), "src": src} for s, (g, src) in
+        sorted(best.items())
+    } or None
+    cur = ((out.get("attribution") or {}).get("link_stages") or {})
+    regressions = []
+    for stage, (best_g, src) in sorted(best.items()):
+        rec = cur.get(stage)
+        if not isinstance(rec, dict) or best_g <= 0.0:
+            continue
+        g = float(rec.get("gibs") or 0.0)
+        # only meaningful when the stage actually moved bytes this run
+        if rec.get("bytes", 0) and g < HEADLINE_REGRESSION_FRAC * best_g:
+            regressions.append({
+                "stage": stage, "gibs": round(g, 4),
+                "best_prior_gibs": round(best_g, 4), "src": src,
+            })
+    out["stage_regressions"] = regressions or None
+    return regressions
+
+
 def _dominant_stage(out: dict) -> str:
     """Name the stage/segment that owns the headline's wall clock: the
     largest-seconds entry of the codec attribution block (e.g.
@@ -2728,8 +2803,23 @@ def _headline_guard(out: dict) -> int:
     dominant = _dominant_stage(out)
     out["headline_dominant_segment"] = dominant
     out["headline_burning_slo"] = _burning_slo(out)
+    stage_regs = _stage_ledger(out)
     value = float(out.get("value") or 0.0)
     if best > 0.0 and value < HEADLINE_REGRESSION_FRAC * best:
+        if stage_regs:
+            worst = min(stage_regs,
+                        key=lambda r: r["gibs"] / r["best_prior_gibs"])
+            stage_msg = (
+                f"Regressed link stage: {worst['stage']} at "
+                f"{worst['gibs']} GiB/s vs best prior "
+                f"{worst['best_prior_gibs']} GiB/s ({worst['src']})"
+                + (f" (+{len(stage_regs) - 1} more, see "
+                   f"stage_regressions)" if len(stage_regs) > 1 else "")
+                + ". ")
+        else:
+            stage_msg = ("No per-stage link regression vs prior rounds "
+                         "(the slowdown is outside the device link, or "
+                         "no prior round embedded link_stages). ")
         put_cp = out.get("put_critical_path") or {}
         put_dom = ", ".join(
             f"{ep}→{d.get('dominant')}" for ep, d in put_cp.items())
@@ -2737,6 +2827,7 @@ def _headline_guard(out: dict) -> int:
             f"# HEADLINE REGRESSION: value {value:.3f} GiB/s is more than "
             f"{round((1 - HEADLINE_REGRESSION_FRAC) * 100)}% below the best "
             f"prior round ({best:.3f} GiB/s in {src}) — failing the run. "
+            f"{stage_msg}"
             f"Dominant critical-path segment: {dominant}; burning SLO: "
             f"{out['headline_burning_slo']}"
             + (f" (API phases: {put_dom})" if put_dom else "") + ". "

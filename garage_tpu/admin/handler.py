@@ -9,6 +9,7 @@ msgpack dicts {"cmd": ..., ...} on the "garage/admin" endpoint.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from typing import Any, Dict, List, Optional
@@ -703,6 +704,31 @@ class AdminRpcHandler:
         limit = msg.get("limit")
         return self.garage.block_manager.codec.obs.events_list(
             int(limit) if limit else None
+        )
+
+    async def _cmd_codec_profile(self, msg) -> Dict:
+        """Controlled link sweep on the live DeviceTransport: sizes x
+        batch shapes x kinds, each cell decomposed into the exact-sum
+        stage breakdown (ops/link_profiler.py run_sweep).  Serial and
+        synchronous by design — bounded cells, run off-loop."""
+        from ..ops.link_profiler import run_sweep
+
+        codec = self.garage.block_manager.codec
+        tr = getattr(codec, "transport", None)
+        if tr is None or not tr.alive:
+            raise GarageError("no live device transport to profile")
+        sizes = msg.get("sizes_mib") or (1.0, 4.0, 16.0)
+        shapes = msg.get("shapes") or (1, 16)
+        kinds = msg.get("kinds") or ("hash", "encode", "decode")
+        rounds = int(msg.get("rounds") or 1)
+        if len(sizes) * len(shapes) * len(kinds) * rounds > 256:
+            raise GarageError("sweep too large (>256 cells)")
+        return await asyncio.to_thread(
+            run_sweep, tr,
+            sizes_mib=tuple(float(s) for s in sizes),
+            shapes=tuple(int(s) for s in shapes),
+            kinds=tuple(kinds),
+            rounds=rounds,
         )
 
     async def _cmd_slow_ops(self, msg) -> List[Dict]:

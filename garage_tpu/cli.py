@@ -199,6 +199,24 @@ def _build_parser() -> argparse.ArgumentParser:
         help="the gate-decision event ring: why the device side did or "
              "did not take work (probe rates, gate holds, demotions)")
     cev.add_argument("-n", "--limit", type=int, default=50)
+    cpr = cxs.add_parser(
+        "profile",
+        help="controlled link sweep on the LIVE transport: sizes x "
+             "batch shapes x kinds, each cell decomposed into "
+             "stage_copy/adopt/compile/dispatch/compute/collect "
+             "(exact-sum attribution; ops/link_profiler.py)")
+    cpr.add_argument("--sizes-mib", type=float, nargs="+",
+                     default=[1.0, 4.0, 16.0],
+                     help="payload sizes per cell (MiB)")
+    cpr.add_argument("--shapes", type=int, nargs="+", default=[1, 16],
+                     help="blocks per batch (the batch shapes axis)")
+    cpr.add_argument("--kinds", nargs="+",
+                     default=["hash", "encode", "decode"],
+                     choices=["hash", "encode", "decode", "scrub"])
+    cpr.add_argument("--rounds", type=int, default=1)
+    cpr.add_argument("--json", action="store_true",
+                     help="emit the machine-readable block instead of "
+                          "the table")
 
     pso = sub.add_parser(
         "slow-ops",
@@ -697,6 +715,19 @@ async def _amain(args) -> None:
                 rows.append(f"{e['seq']}\t{e['kind']}\t"
                             f"{e.get('reason') or '-'}\t{detail or '-'}")
             print(format_table(rows))
+        elif args.codec_cmd == "profile":
+            block = await client.call({
+                "cmd": "codec_profile",
+                "sizes_mib": args.sizes_mib,
+                "shapes": args.shapes,
+                "kinds": args.kinds,
+                "rounds": args.rounds,
+            })
+            if args.json:
+                print(json.dumps(block, indent=2))
+            else:
+                from garage_tpu.ops.link_profiler import format_sweep
+                print(format_sweep(block))
         return
 
     if args.command == "slow-ops":

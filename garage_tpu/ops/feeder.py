@@ -69,7 +69,7 @@ class FeederClosed(RuntimeError):
 class _Item:
     __slots__ = ("kind", "payload", "blocks", "nbytes", "future", "ts",
                  "peers", "deadline", "cls", "want_parity", "tctx",
-                 "span_id", "t_ns", "t_dispatch_ns")
+                 "span_id", "t_ns", "t_mono_ns", "t_dispatch_ns")
 
     def __init__(self, kind, payload, blocks, nbytes, peers=None,
                  cls="fg", want_parity=True):
@@ -105,6 +105,10 @@ class _Item:
         else:
             self.span_id = None
             self.t_ns = 0
+        # always-on monotonic submit stamp (t_ns is wall and traced-only):
+        # the transport's enqueue timeline event diffs it to show feeder
+        # wait next to the LinkProfiler's in-transport stages
+        self.t_mono_ns = time.monotonic_ns()
         self.t_dispatch_ns = 0
         # how many concurrent submitters the CALLER can see (e.g. the
         # S3 layer's in-flight put count).  Three regimes: an explicit

@@ -167,6 +167,11 @@ class HybridCodec(BlockCodec):
         # attributable (VERDICT r4 #2)
         self.last_link_gibs: Optional[float] = None
         self.last_gate: Optional[str] = None
+        # per-stage breakdown of the last successful probe ({stage:
+        # seconds}, ISSUE 16): attached to every probe/gate event so a
+        # verdict — including a gate-shut one — names WHERE the
+        # round-trip went, not just how slow it was
+        self._link_stages: Optional[dict] = None
         self._stats_lock = threading.Lock()
         # NOTE: the codec-level gauges (codec_device_attached,
         # codec_link_gibs, codec_tpu_frac) are registered by
@@ -236,6 +241,8 @@ class HybridCodec(BlockCodec):
                                    if self.tpu is not None else None),
                 "gate": self.last_gate,
                 "link_gibs": self.last_link_gibs,
+                "link_stages": (dict(self._link_stages)
+                                if self._link_stages else None),
                 "group_blocks": self.group_blocks,
                 "device_batch_blocks": self.device_batch_blocks,
                 "window": self.window,
@@ -361,9 +368,11 @@ class HybridCodec(BlockCodec):
         _probe_once path — a full 16 MiB round-trip over a possibly
         metered link — keeps the below-threshold backoff ladder."""
         hook = getattr(self.tpu, "probe_link", None)
+        hook_owner = self.tpu if hook is not None else None
         tr = self.transport
         if hook is None and tr is not None and tr.alive:
             hook = tr.probe_link
+            hook_owner = tr
         legacy = hook is None
         if legacy and not hasattr(self.tpu, "warm_scrub"):
             return float("inf")
@@ -377,9 +386,14 @@ class HybridCodec(BlockCodec):
             if hook is not None:
                 try:
                     rate, failed = float(hook(self._LINK_PROBE_BYTES)), False
+                    stages = getattr(hook_owner, "last_probe_stages",
+                                     None)
+                    if stages:
+                        self._link_stages = dict(stages)
                 except Exception:
                     logger.warning("probe_link hook failed", exc_info=True)
                     rate, failed = 0.0, True
+                    self._link_stages = None
             else:
                 rate, failed = self._probe_once()
                 if failed:
@@ -399,6 +413,25 @@ class HybridCodec(BlockCodec):
             self._link_failed = failed
             self._link_rate, self._link_ts = rate, now
             return rate
+
+    def probe_stages(self) -> Optional[dict]:
+        """{stage: seconds} of the last successful probe (None when no
+        decomposed probe has run — the legacy serialize+copy probe and
+        scripted fakes don't stamp stages).  A CACHED verdict reuses the
+        breakdown of the measurement that produced it."""
+        with self._probe_lock:
+            return dict(self._link_stages) if self._link_stages else None
+
+    @staticmethod
+    def _stage_detail(stages: Optional[dict]) -> dict:
+        """Event-detail kwargs for a probe breakdown: the {stage:
+        seconds} map plus its dominant stage (empty when unknown)."""
+        if not stages:
+            return {}
+        from .link_profiler import dominant_stage
+
+        return {"stages": {k: round(v, 6) for k, v in stages.items()},
+                "dominant_stage": dominant_stage(stages)}
 
     def _ramp_widths(self) -> List[int]:
         """Device submission widths the feeder ramps through: start small
@@ -517,6 +550,7 @@ class HybridCodec(BlockCodec):
                 # first real collect can take tens of seconds).
                 with self.obs.stage("probe", "tpu"):
                     rate = self._probe_link()
+                stage_detail = self._stage_detail(self.probe_stages())
                 with self._stats_lock:
                     self.last_link_gibs = (
                         None if rate == float("inf") else round(rate, 4))
@@ -524,23 +558,28 @@ class HybridCodec(BlockCodec):
                     "probe",
                     reason="unmetered" if rate == float("inf") else "ok",
                     gibs=None if rate == float("inf") else round(rate, 4),
-                    threshold=self.params.hybrid_min_link_gibs)
+                    threshold=self.params.hybrid_min_link_gibs,
+                    **stage_detail)
                 if rate < self.params.hybrid_min_link_gibs:
                     with self._stats_lock:
                         self.last_gate = "hold"
                     self.obs.event(
                         "gate", reason="hold", gibs=round(rate, 4),
-                        threshold=self.params.hybrid_min_link_gibs)
+                        threshold=self.params.hybrid_min_link_gibs,
+                        **stage_detail)
                     logger.info(
                         "hybrid feeder: link probe %.3f GiB/s below "
-                        "threshold %.3f — CPU-only this pass",
-                        rate, self.params.hybrid_min_link_gibs)
+                        "threshold %.3f — CPU-only this pass "
+                        "(dominant stage: %s)",
+                        rate, self.params.hybrid_min_link_gibs,
+                        stage_detail.get("dominant_stage", "unknown"))
                     return
                 with self._stats_lock:
                     self.last_gate = "open"
                 self.obs.event(
                     "gate", reason="open",
-                    gibs=None if rate == float("inf") else round(rate, 4))
+                    gibs=None if rate == float("inf") else round(rate, 4),
+                    **stage_detail)
                 while True:
                     # width ramp: early submissions are small (cheap for
                     # the tail hedge to redo if the link turns out slow);

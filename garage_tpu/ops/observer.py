@@ -88,6 +88,12 @@ class CodecObserver:
         # `device_timeline`, scripts/device_timeline.py) — the staging
         # overlap is a picture, not an inference
         self.timeline = Timeline()
+        # stage-level host<->device attribution (ops/link_profiler.py):
+        # the DeviceTransport that shares this observer installs its
+        # LinkProfiler here so bench attribution and admin views reach
+        # the per-stage breakdown without holding a transport reference
+        # across re-arms; None until a transport arms
+        self.link_profiler = None
         self.events: deque = deque(maxlen=ring_size)
         self._lock = threading.Lock()
         self._seq = 0

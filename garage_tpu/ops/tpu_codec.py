@@ -23,6 +23,7 @@ Bit-identical to CpuCodec (tests/test_codec_equivalence.py).
 from __future__ import annotations
 
 import functools
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -214,6 +215,18 @@ class TpuCodec(BlockCodec):
         # back into note_sync_{success,failure} so sync-time failures
         # (surfacing only at np.asarray) demote the right latch
         self.last_submit_variant = "xla"
+        # LinkProfiler boundary stamps (ops/link_profiler.py): the
+        # transport clears these before each submit/collect and reads
+        # them after, so adopt (dlpack/device_put) time and the compile
+        # vs steady-state dispatch split are attributable without the
+        # transport reaching into JAX.  last_submit_compiled is a
+        # first-call-per-(kind, shape) proxy for "this dispatch paid an
+        # XLA compile" — jit caches by shape+dtype, so a fresh shape on
+        # a warm function is the compile case worth splitting out.
+        self.last_adopt_ns = 0
+        self.last_ready_ns = 0
+        self.last_submit_compiled = False
+        self._dispatched_shapes = set()
         self.mesh = None
         if params.shard_mesh > 1:
             devs = (devices or jax.devices())[: params.shard_mesh]
@@ -299,6 +312,25 @@ class TpuCodec(BlockCodec):
         except Exception:  # noqa: BLE001 — any dlpack refusal → plain put
             return jnp.asarray(arr)
 
+    def _mark_adopt(self, kind: str, shape) -> None:
+        """Stamp the adoption boundary + the compile-vs-dispatch verdict
+        for the submission being built (LinkProfiler contract)."""
+        self.last_adopt_ns = time.monotonic_ns()
+        key = (kind, tuple(shape))
+        self.last_submit_compiled = key not in self._dispatched_shapes
+        self._dispatched_shapes.add(key)
+
+    def _mark_ready(self, handle) -> None:
+        """Block until the device results exist, then stamp the ready
+        boundary — everything after this in a collect is pure D2H
+        materialization + reassembly (`collect`), everything before it
+        since submit-return is device busy (`compute`)."""
+        try:
+            jax.block_until_ready(handle)
+        except Exception:  # noqa: BLE001 — non-jax handles sync at asarray
+            pass
+        self.last_ready_ns = time.monotonic_ns()
+
     def probe_submit(self, arr: np.ndarray):
         """The transport's link probe op: upload a staged buffer and
         return a device scalar that DEPENDS on it (the only sync some
@@ -309,9 +341,12 @@ class TpuCodec(BlockCodec):
         if not hasattr(self, "_probe_sum_jit"):
             self._probe_sum_jit = jax.jit(
                 lambda x: jnp.sum(x, dtype=jnp.uint32))
-        return self._probe_sum_jit(self._to_device(arr))
+        da = self._to_device(arr)
+        self._mark_adopt("probe", arr.shape)
+        return self._probe_sum_jit(da)
 
     def probe_collect(self, handle) -> int:
+        self._mark_ready(handle)
         return int(np.asarray(handle))
 
     def hash_submit(self, arr: np.ndarray, lengths: np.ndarray):
@@ -320,10 +355,12 @@ class TpuCodec(BlockCodec):
         with self.obs.stage("h2d_transfer", "tpu"):
             da = self._to_device(arr)
             dl = jnp.asarray(lengths)
+        self._mark_adopt("hash", arr.shape)
         with self.obs.stage("kernel_dispatch", "tpu"):
             return self._hash_jit(da, dl)
 
     def hash_collect(self, handle, n: int) -> List[Hash]:
+        self._mark_ready(handle)
         h = np.asarray(handle)[:n]
         return [Hash(d) for d in digests_to_bytes(h)]
 
@@ -332,6 +369,7 @@ class TpuCodec(BlockCodec):
         bool array, parity full array | None) — per-entry trimming is
         the transport's job (it knows the lane spans)."""
         _h, ok, _bad, parity = out
+        self._mark_ready((ok, parity) if fetch_parity else ok)
         ok = np.asarray(ok)
         parity_np = np.asarray(parity) if fetch_parity else None
         return ok, parity_np
@@ -376,11 +414,13 @@ class TpuCodec(BlockCodec):
         with self.obs.stage("h2d_transfer", "tpu"):
             u32 = bytes_view_u32(self._to_device(
                 groups.reshape(-1, groups.shape[-2], groups.shape[-1])))
+        self._mark_adopt("encode", groups.shape)
         with self.obs.stage("kernel_dispatch", "tpu"):
             return u32_view_bytes(self._gf_submit(u32, self._K_enc,
                                                   self._enc_mat))
 
     def encode_collect(self, handle) -> np.ndarray:
+        self._mark_ready(handle)
         return np.asarray(handle)
 
     def decode_submit(self, shards: np.ndarray, present: Sequence[int],
@@ -406,8 +446,16 @@ class TpuCodec(BlockCodec):
         with self.obs.stage("h2d_transfer", "tpu"):
             u32 = bytes_view_u32(self._to_device(
                 np.ascontiguousarray(sub)))
+        self._mark_adopt("decode", (*sub.shape, *key[0]))
         with self.obs.stage("kernel_dispatch", "tpu"):
             return u32_view_bytes(self._gf_submit(u32, K, dec_mat))[..., :s]
+
+    def decode_collect(self, handle) -> np.ndarray:
+        """Ready-stamped decode materialization (the transport prefers
+        this over a bare np.asarray so `compute` vs `collect` split
+        holds for decode batches too)."""
+        self._mark_ready(handle)
+        return np.asarray(handle)
 
     # --- hashing ---
     @staticmethod
@@ -768,6 +816,7 @@ class TpuCodec(BlockCodec):
             da = jnp.asarray(arr)
             dl = jnp.asarray(lengths)
             de = jnp.asarray(expected)
+        self._mark_adopt("scrub", arr.shape)
         if self._use_pallas_scrub(arr.shape[0]):
             try:
                 with self.obs.stage("kernel_dispatch", "tpu"):

@@ -203,7 +203,8 @@ class _Batch:
 
     __slots__ = ("kind", "parts", "nbytes", "blocks", "eff_deadline",
                  "cls", "want_parity", "ts", "staged_est",
-                 "t_enq", "t_pop", "t_stage0", "t_stage1", "t_submit1")
+                 "t_enq", "t_pop", "t_stage0", "t_stage1", "t_adopt1",
+                 "t_submit1", "t_ready", "compiled")
 
     def __init__(self, kind: str, cls: str):
         self.kind = kind
@@ -216,12 +217,20 @@ class _Batch:
         self.ts = 0.0
         self.staged_est = 0    # bucketed staging-buffer bytes (admission)
         # monotonic_ns stage boundary stamps feeding the device timeline
-        # (obs.timeline) and the per-request transport/device spans
+        # (obs.timeline), the per-request transport/device spans and the
+        # LinkProfiler's exact-sum stage breakdown: t_stage0 ≤ t_stage1
+        # (stage_copy) ≤ t_adopt1 (adopt) ≤ t_submit1 (dispatch/compile)
+        # ≤ t_ready (compute) ≤ collect end.  t_adopt1/t_ready come from
+        # the device codec's own stamps, clamped into the enclosing
+        # transport interval
         self.t_enq = 0
         self.t_pop = 0
         self.t_stage0 = 0
         self.t_stage1 = 0
+        self.t_adopt1 = 0
         self.t_submit1 = 0
+        self.t_ready = 0
+        self.compiled = False  # did this dispatch trigger an XLA compile
 
 
 class DeviceTransport:
@@ -310,6 +319,17 @@ class DeviceTransport:
         # wall↔monotonic offset for converting timeline stamps into the
         # wall-clock span records the waterfall stores
         self._mono_off = time.time_ns() - time.monotonic_ns()
+
+        # stage-level link attribution (ISSUE 16): every batch and every
+        # probe round trip decomposed into stage_copy/adopt/compile/
+        # dispatch/compute/collect with an exact-sum guarantee.  Hung off
+        # the observer too so bench/admin reach it without holding a
+        # transport reference across re-arms.
+        from .link_profiler import LinkProfiler
+
+        self.profiler = LinkProfiler(metrics=metrics)
+        self.obs.link_profiler = self.profiler
+        self.last_probe_stages: Optional[dict] = None
 
         if metrics is not None:
             self.m_staged = metrics.counter(
@@ -435,10 +455,19 @@ class DeviceTransport:
                 self._thread.start()
             self._cond.notify_all()
         tl = self.obs.timeline
+        # host-side latency BEFORE the transport ever saw the work: the
+        # oldest contributing item's feeder submit stamp → this enqueue
+        # (pairs with the LinkProfiler's in-transport stages so the full
+        # host journey is attributable from one trace)
+        oldest = min((getattr(p.item, "t_mono_ns", 0)
+                      for b in batches for p in b.parts
+                      if getattr(p.item, "t_mono_ns", 0)), default=0)
         tl.event(f"enqueue {kind}", "edf", t_ns, cat="transport",
                  cls=batches[0].cls if batches else "fg",
                  batches=len(batches),
-                 nbytes=sum(b.nbytes for b in batches))
+                 nbytes=sum(b.nbytes for b in batches),
+                 feeder_ms=round((t_ns - oldest) / 1e6, 3) if oldest
+                 else None)
         tl.counter("transport_queue", t_ns,
                    fg=self._depth.get("fg", 0), bg=self._depth.get("bg", 0))
 
@@ -640,6 +669,29 @@ class DeviceTransport:
                     continue  # double-buffer: stage N+1 while N computes
             self._collect_oldest()
 
+    def _clear_device_stamps(self) -> None:
+        """Reset the device codec's per-submit profiler stamps (adopt
+        boundary, ready boundary, compile flag) so a device that stamps
+        only some paths never leaks a stale boundary into the next
+        batch's attribution.  Devices without the attributes (scripted
+        fakes) are left untouched — their time folds into the enclosing
+        stage."""
+        dev = self.device
+        for name, v in (("last_adopt_ns", 0), ("last_ready_ns", 0),
+                        ("last_submit_compiled", False)):
+            if hasattr(dev, name):
+                try:
+                    setattr(dev, name, v)
+                except Exception:  # noqa: BLE001 — read-only fakes
+                    pass
+
+    @staticmethod
+    def _clamp_stamp(raw: int, lo: int, hi: int) -> int:
+        """Device-provided boundary stamp forced into its enclosing
+        transport interval (0/garbage → the interval's start, so the
+        whole span attributes to the outer stage)."""
+        return min(max(raw or lo, lo), hi)
+
     def _stage_and_submit(self, batch: _Batch, slot: int) -> None:
         staged = None
         try:
@@ -647,9 +699,16 @@ class DeviceTransport:
             with self.obs.stage("host_staging", "tpu"):
                 staged = self._stage(batch, slot)
             batch.t_stage1 = time.monotonic_ns()
+            self._clear_device_stamps()
             with self.obs.stage("device_submit", "tpu"):
                 handle = self._submit(batch, staged)
             batch.t_submit1 = time.monotonic_ns()
+            dev = self.device
+            batch.t_adopt1 = self._clamp_stamp(
+                getattr(dev, "last_adopt_ns", 0),
+                batch.t_stage1, batch.t_submit1)
+            batch.compiled = bool(getattr(dev, "last_submit_compiled",
+                                          False))
             self.link_busy_seconds += (batch.t_submit1
                                        - batch.t_stage0) / 1e9
             tl = self.obs.timeline
@@ -657,8 +716,11 @@ class DeviceTransport:
             tl.event(f"stage {batch.kind}", track, batch.t_stage0,
                      batch.t_stage1, cat="transport", cls=batch.cls,
                      blocks=batch.blocks, staged_est=batch.staged_est)
-            tl.event(f"submit {batch.kind}", track, batch.t_stage1,
-                     batch.t_submit1, cat="transport")
+            tl.event(f"adopt {batch.kind}", track, batch.t_stage1,
+                     batch.t_adopt1, cat="transport")
+            tl.event(f"submit {batch.kind}", track, batch.t_adopt1,
+                     batch.t_submit1, cat="transport",
+                     compiled=batch.compiled)
             variant = getattr(self.device, "last_submit_variant", None)
             with self._cond:
                 if not self._inflight and self._busy_since is None:
@@ -705,6 +767,9 @@ class DeviceTransport:
             self._absorb_on_cpu(batch, e)
             return
         t_c1 = time.monotonic_ns()
+        batch.t_ready = self._clamp_stamp(
+            getattr(self.device, "last_ready_ns", 0),
+            max(t_c0, batch.t_submit1 or t_c0), t_c1)
         self.link_busy_seconds += (t_c1 - t_c0) / 1e9
         self._release(batch, slot)
         self._device_fails = 0
@@ -718,11 +783,23 @@ class DeviceTransport:
         self.obs.add_bytes("tpu", batch.nbytes)
         tl = self.obs.timeline
         track = f"slot{slot}"
-        if batch.t_submit1 and t_c0 > batch.t_submit1:
+        if batch.t_submit1 and batch.t_ready > batch.t_submit1:
+            # device-busy window: dispatch return → results ready (the
+            # block_until_ready delta, observed inside _collect)
             tl.event(f"compute {batch.kind}", track, batch.t_submit1,
-                     t_c0, cat="transport")
-        tl.event(f"collect {batch.kind}", track, t_c0, t_c1,
-                 cat="transport", blocks=batch.blocks)
+                     batch.t_ready, cat="transport")
+        tl.event(f"collect {batch.kind}", track, batch.t_ready or t_c0,
+                 t_c1, cat="transport", blocks=batch.blocks)
+        if batch.t_stage0:
+            self.profiler.record(
+                batch.kind, batch.nbytes, batch.t_stage0,
+                [("stage_copy", batch.t_stage1),
+                 ("adopt", batch.t_adopt1),
+                 ("compile" if batch.compiled else "dispatch",
+                  batch.t_submit1),
+                 ("compute", batch.t_ready),
+                 ("collect", t_c1)],
+                want_breakdown=False)
         self._emit_request_spans(batch, t_c1)
         for part, res in zip(batch.parts, results):
             part.sink.deliver(part.index, res)
@@ -998,8 +1075,9 @@ class DeviceTransport:
             return results
         # decode
         results: List = [None] * len(batch.parts)
+        dcoll = getattr(dev, "decode_collect", None)
         for out, spans in handle:
-            dec = np.asarray(out)
+            dec = np.asarray(dcoll(out) if dcoll is not None else out)
             for pi, off, nrows, s in spans:
                 results[pi] = np.ascontiguousarray(
                     dec[off:off + nrows, ..., :s])
@@ -1111,22 +1189,51 @@ class DeviceTransport:
                 self._probe_staging = np.empty((nbytes,), dtype=np.uint8)
             staging = self._probe_staging[:nbytes]
 
-            def roundtrip() -> float:
-                t0 = time.monotonic()
+            def roundtrip():
+                """One probed round trip, decomposed with the same
+                stage taxonomy (and exact-sum guarantee) as a real
+                batch: the staging-buffer refill is priced — and now
+                VISIBLE — as stage_copy bytes instead of folding into
+                adopt time (ISSUE 16 satellite)."""
+                self._clear_device_stamps()
+                t0 = time.monotonic_ns()
                 staging[:] = src          # the one host copy, priced in
+                t_copy = time.monotonic_ns()
                 handle = dev.probe_submit(staging)
+                t_sub = time.monotonic_ns()
                 collect = getattr(dev, "probe_collect",
                                   lambda h: int(np.asarray(h)))
                 collect(handle)
-                return time.monotonic() - t0
+                t_end = time.monotonic_ns()
+                t_adopt = self._clamp_stamp(
+                    getattr(dev, "last_adopt_ns", 0), t_copy, t_sub)
+                t_ready = self._clamp_stamp(
+                    getattr(dev, "last_ready_ns", 0), t_sub, t_end)
+                compiled = bool(getattr(dev, "last_submit_compiled",
+                                        False))
+                stages = self.profiler.record(
+                    "probe", nbytes, t0,
+                    [("stage_copy", t_copy), ("adopt", t_adopt),
+                     ("compile" if compiled else "dispatch", t_sub),
+                     ("compute", t_ready), ("collect", t_end)])
+                return (t_end - t0) / 1e9, stages
 
             if not self._probe_warmed:
+                # warm round: the probe executable's compile lands here,
+                # recorded (as `compile`) but excluded from the rate
                 roundtrip()
                 self._probe_warmed = True
-            dt = roundtrip()
+            dt, stages = roundtrip()
             rate = nbytes / dt / 2**30 if dt > 0 else 0.0
+            self.last_probe_stages = {k: round(v, 6)
+                                      for k, v in stages.items()}
+            from .link_profiler import dominant_stage
+
             self.obs.event("transport_probe", reason="ok",
-                           gibs=round(rate, 4))
+                           gibs=round(rate, 4),
+                           dominant_stage=dominant_stage(stages),
+                           stage_copy_bytes=nbytes,
+                           stages=self.last_probe_stages)
             return rate
 
     # --- lifecycle / introspection ------------------------------------------
@@ -1158,6 +1265,8 @@ class DeviceTransport:
                 "queue_saturation": round(
                     (self._queued_est + self._inflight_bytes)
                     / self.budget_bytes, 6),
+                "stages": self.profiler.summary(),
+                "probe_stages": self.last_probe_stages,
             }
 
     def shutdown(self, timeout: float = 15.0) -> None:

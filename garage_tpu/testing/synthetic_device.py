@@ -66,12 +66,26 @@ class SyntheticLinkCodec:
     def __init__(self, params: CodecParams, link_gibs: float,
                  device_gibs: float = float("inf"),
                  fixed_latency_s: float = 0.0,
-                 compute_real: bool = False):
+                 compute_real: bool = False,
+                 compile_s: float = 0.0):
         self.params = params
         self.link_gibs = link_gibs
         self.device_gibs = device_gibs
         self.fixed_latency_s = fixed_latency_s
         self.compute_real = compute_real
+        # modeled XLA compile: the FIRST array-level submission of each
+        # (kind, shape) sleeps this long between adoption and dispatch
+        # return, so the LinkProfiler's cold-call `compile` vs
+        # steady-state `dispatch` split is deterministically testable
+        self.compile_s = compile_s
+        # LinkProfiler boundary stamps — the same contract TpuCodec
+        # publishes (ops/link_profiler.py): the transport clears these
+        # before submit/collect and reads them after
+        self.last_adopt_ns = 0
+        self.last_ready_ns = 0
+        self.last_submit_compiled = False
+        self._dispatched_shapes = set()
+        self.last_probe_stages = None
         self.cpu: Optional[CpuCodec] = (
             CpuCodec(params) if compute_real else None)
         self.submissions = 0
@@ -106,8 +120,17 @@ class SyntheticLinkCodec:
 
     def probe_link(self, nbytes: int) -> float:
         """The hybrid probe hook: the measured link rate, with the
-        probe's own transfer time modeled."""
+        probe's own transfer time modeled.  Publishes a per-stage
+        breakdown (`last_probe_stages`) summing to the measured probe
+        wall exactly — the modeled transfer is all device-busy time, so
+        it lands in `compute` — which HybridCodec attaches to its gate
+        probe events (ISSUE 16)."""
+        t0 = time.monotonic()
         time.sleep(min(nbytes / (self.link_gibs * 2**30), 0.05))
+        dt = time.monotonic() - t0
+        self.last_probe_stages = {
+            "stage_copy": 0.0, "adopt": 0.0, "dispatch": 0.0,
+            "compute": round(dt, 9), "collect": 0.0}
         return self.link_gibs
 
     def warm_scrub(self, nblocks: int, nbytes: int) -> None:
@@ -201,29 +224,52 @@ class SyntheticLinkCodec:
     def _rows_bytes(self, arr: np.ndarray, lengths: np.ndarray):
         return [arr[i, :n].tobytes() for i, n in enumerate(lengths)]
 
+    def _mark_adopt(self, kind: str, shape) -> None:
+        """LinkProfiler stamp: adoption boundary + compile-vs-dispatch
+        verdict, with the modeled compile (cold (kind, shape)) slept
+        AFTER the adopt stamp so it attributes to `compile`."""
+        self.last_adopt_ns = time.monotonic_ns()
+        key = (kind, tuple(shape))
+        self.last_submit_compiled = key not in self._dispatched_shapes
+        self._dispatched_shapes.add(key)
+        if self.last_submit_compiled and self.compile_s > 0:
+            time.sleep(self.compile_s)
+
+    def _mark_ready(self, ready: float) -> None:
+        _wait_until(ready)
+        self.last_ready_ns = time.monotonic_ns()
+
     def probe_submit(self, arr: np.ndarray):
-        time.sleep(min(arr.nbytes / (self.link_gibs * 2**30), 0.05))
-        return int(arr.sum(dtype=np.uint32))
+        # async like the real device: the modeled transfer elapses
+        # between submit-return and collect, so the transport probe's
+        # stage breakdown attributes it to `compute`, not `dispatch`
+        self._mark_adopt("probe", arr.shape)
+        dt = min(arr.nbytes / (self.link_gibs * 2**30), 0.05)
+        return _Lazy(int(arr.sum(dtype=np.uint32)),
+                     time.monotonic() + dt)
 
     def probe_collect(self, handle) -> int:
-        return int(handle)
+        self._mark_ready(handle.ready)
+        return int(np.asarray(handle))
 
     def hash_submit(self, arr: np.ndarray, lengths: np.ndarray):
         self.array_submissions += 1
         self.bytes_submitted += int(lengths.sum())
+        self._mark_adopt("hash", arr.shape)
         ready = self._link_ready_at(int(lengths.sum()))
         return ready, self._codec().batch_hash(
             self._rows_bytes(arr, lengths))
 
     def hash_collect(self, handle, n: int):
         ready, digs = handle
-        _wait_until(ready)
+        self._mark_ready(ready)
         return digs[:n]
 
     def scrub_encode_submit(self, arr: np.ndarray, lengths: np.ndarray,
                             expected: np.ndarray):
         self.array_submissions += 1
         self.bytes_submitted += int(lengths.sum())
+        self._mark_adopt("scrub", arr.shape)
         ready = self._link_ready_at(int(lengths.sum()))
         codec = self._codec()
         digs = codec.batch_hash(self._rows_bytes(arr, lengths))
@@ -241,23 +287,31 @@ class SyntheticLinkCodec:
 
     def scrub_collect(self, out, fetch_parity: bool):
         _h, ok, _bad, parity = out
+        self._mark_ready(ok.ready)
         return np.asarray(ok), (np.asarray(parity) if fetch_parity
                                 and parity is not None else None)
 
     def encode_submit(self, groups: np.ndarray):
         self.array_submissions += 1
         self.bytes_submitted += int(groups.nbytes)
+        self._mark_adopt("encode", groups.shape)
         ready = self._link_ready_at(int(groups.nbytes))
         return _Lazy(self._codec().rs_encode(
             np.ascontiguousarray(groups)), ready)
 
     def encode_collect(self, handle) -> np.ndarray:
+        self._mark_ready(handle.ready)
         return np.asarray(handle)
 
     def decode_submit(self, shards: np.ndarray, present,
                       rows=None):
         self.array_submissions += 1
         self.bytes_submitted += int(shards.nbytes)
+        self._mark_adopt("decode", shards.shape)
         ready = self._link_ready_at(int(shards.nbytes))
         return _Lazy(self._codec().rs_reconstruct(shards, present, rows),
                      ready)
+
+    def decode_collect(self, handle) -> np.ndarray:
+        self._mark_ready(handle.ready)
+        return np.asarray(handle)

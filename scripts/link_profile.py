@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Link microprofiler sweep (ISSUE 16 CI satellite): run the controlled
+sizes × batch-shapes × kinds sweep against the synthetic in-process
+device backend and assert the acceptance invariants cheaply enough for
+every smoke run:
+
+  - the machine-readable attribution block is well-formed (every cell
+    carries kind/size/blocks/wall/stages/dominant);
+  - the exact-sum invariant holds LIVE in every cell (per-stage
+    breakdown equals the profiler-measured wall, bounded by the
+    caller-observed outer wall — `sum_ok`);
+  - stage names stay inside the published taxonomy and every cell
+    names a dominant stage;
+  - the probe verdict carries a per-stage breakdown and prices its
+    staging-buffer refill as stage_copy bytes.
+
+Also prints the human attribution table, so a CI log answers "the link
+is slow — which stage" directly.  Pass --json to emit the block.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from garage_tpu.ops.codec import CodecParams  # noqa: E402
+from garage_tpu.ops.cpu_codec import CpuCodec  # noqa: E402
+from garage_tpu.ops.link_profiler import (STAGES, format_sweep,  # noqa: E402
+                                          run_sweep)
+from garage_tpu.ops.transport import DeviceTransport  # noqa: E402
+from garage_tpu.testing.synthetic_device import SyntheticLinkCodec  # noqa: E402
+
+K, M = 4, 2
+
+
+def main() -> None:
+    params = CodecParams(rs_data=K, rs_parity=M, block_size=1 << 16)
+    dev = SyntheticLinkCodec(params, link_gibs=50.0, compute_real=True,
+                             compile_s=0.002)
+    tr = DeviceTransport(dev, params, fallback=CpuCodec(params))
+    try:
+        tr.probe_link(1 << 20)
+        assert tr.last_probe_stages, "probe carried no stage breakdown"
+        assert set(tr.last_probe_stages) <= set(STAGES)
+
+        block = run_sweep(tr, sizes_mib=(0.25, 1, 4), shapes=(1, 16),
+                          kinds=("hash", "encode", "decode"), rounds=1)
+
+        # well-formedness of the machine-readable block
+        assert block["cells"], "sweep produced no cells"
+        for c in block["cells"]:
+            for key in ("kind", "size_mib", "blocks", "nbytes", "wall_s",
+                        "outer_s", "gibs", "stages", "dominant",
+                        "sum_ok"):
+                assert key in c, f"cell missing {key}: {c}"
+            assert set(c["stages"]) <= set(STAGES), c
+            assert c["dominant"] in STAGES, c
+            assert c["sum_ok"], f"exact-sum invariant violated: {c}"
+        assert block["sum_ok"]
+
+        # the probe's staging refill is visible as stage_copy bytes
+        summary = block["summary"]
+        assert summary["stage_copy"]["bytes"] > 0
+
+        if "--json" in sys.argv:
+            print(json.dumps(block, indent=2))
+        else:
+            print(format_sweep(block))
+        print(f"link profile ok ({len(block['cells'])} cells, "
+              f"sum_ok={block['sum_ok']}, "
+              f"overhead={block['overhead_seconds']}s)")
+    finally:
+        tr.shutdown()
+
+
+if __name__ == "__main__":
+    main()

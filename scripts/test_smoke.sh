@@ -26,6 +26,11 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/metadata_smoke.py
 # scrub and foreground verifies must share one feeder queue, and the
 # live transport_* metric families must pass the strict lint
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/transport_smoke.py
+# link microprofiler smoke (ISSUE 16): the controlled sweep on the
+# synthetic backend must emit a well-formed attribution block whose
+# per-cell stage breakdowns hold the exact-sum invariant LIVE, and the
+# probe verdict must carry a per-stage breakdown with stage_copy bytes
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/link_profile.py
 # degraded-mode smoke: one hard partition between the two replicas of an
 # in-process 3-node cluster must stay client-invisible (quorum 2/3), and
 # one flaky-disk + ENOSPC node must go read-only (typed StorageFull) and
