@@ -2448,6 +2448,46 @@ _PHASES = {
 }
 
 
+# --- per-phase CPU profiling (ISSUE 17) ------------------------------------
+#
+# Every phase runs under the continuous sampling profiler
+# (garage_tpu/utils/cpuprof.py) and embeds its top-K folded stacks with
+# sample shares into the phase's JSON block (`<phase>_cpu_profile`), so
+# each BENCH_r*.json names the FUNCTIONS burning the CPU, per phase —
+# the per-function ledger below then regression-guards those shares
+# against the best prior rounds.  Defaults ON; `--profile-phase=off`
+# disables it (e.g. to rule the sampler out of a perf A/B).
+
+PROFILE_PHASE = "--profile-phase=off" not in sys.argv
+CPU_PROFILE_TOP_K = 20
+
+
+def _phase_profiler():
+    if not PROFILE_PHASE:
+        return None
+    from garage_tpu.utils.cpuprof import CpuProfiler
+
+    # 97 Hz: higher resolution than the daemon's 29 Hz default (phases
+    # are minutes, not days, so the trie stays small), still co-prime
+    # with common periodic work
+    return CpuProfiler(hz=97.0, max_nodes=16384).start()
+
+
+def _phase_cpu_block(prof, top_k: int = CPU_PROFILE_TOP_K):
+    """Stop `prof` and fold everything it saw (cumulative, not the
+    bounded history window) into the embeddable block."""
+    if prof is None:
+        return None
+    try:
+        return prof.profile(seconds=None, top_k=top_k)
+    finally:
+        prof.stop()
+
+
+def _phase_cpu_key(flag: str) -> str:
+    return flag.lstrip("-").replace("-", "_") + "_cpu_profile"
+
+
 def run_phase_subprocess(flag: str, timeout: float = 600) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -2754,6 +2794,102 @@ def _stage_ledger(out: dict) -> list:
     return regressions
 
 
+# CPU ledger thresholds: a function regresses when its sample share
+# grew BOTH 1.5x over the best prior round AND by ≥ 5 points absolute
+# (the frac alone would flag 0.1% → 0.2% noise; the abs alone would
+# miss a hot function doubling from 8% → 16%... it catches both)
+CPU_SHARE_REGRESSION_FRAC = 1.5
+CPU_SHARE_REGRESSION_ABS = 0.05
+
+
+def _cpu_function_shares(out: dict) -> dict:
+    """Aggregate per-function (leaf frame) sample shares across every
+    embedded `*_cpu_profile` block of one round: {func: share}.  The
+    leaf frame is where the sample actually landed — the function
+    burning the CPU, not its callers."""
+    counts: dict = {}
+    total = 0
+    for k, v in out.items():
+        if not str(k).endswith("_cpu_profile") or not isinstance(v, dict):
+            continue
+        for rec in v.get("top") or []:
+            leaf, n = rec.get("leaf"), rec.get("count")
+            if not leaf or not isinstance(n, (int, float)):
+                continue
+            counts[leaf] = counts.get(leaf, 0) + int(n)
+            total += int(n)
+    if not total:
+        return {}
+    shares = {f: round(n / total, 4) for f, n in counts.items()}
+    return dict(sorted(shares.items(),
+                       key=lambda kv: -kv[1])[:CPU_PROFILE_TOP_K * 2])
+
+
+def _best_prior_cpu_functions() -> dict:
+    """Per-function BEST (lowest) prior sample share across committed
+    rounds' `cpu_functions` blocks: {func: (share, src)}.  Rounds
+    captured before the CPU profiler existed contribute nothing."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = {}
+    for p in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        funcs = d.get("cpu_functions")
+        if not isinstance(funcs, dict):
+            funcs = None
+            for line in reversed(str(d.get("tail", "")).splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        funcs = json.loads(line).get("cpu_functions")
+                    except ValueError:
+                        funcs = None
+                    break
+        if not isinstance(funcs, dict):
+            continue
+        for func, share in funcs.items():
+            if not isinstance(share, (int, float)):
+                continue
+            if func not in best or float(share) < best[func][0]:
+                best[func] = (float(share), os.path.basename(p))
+    return best
+
+
+def _cpu_ledger(out: dict) -> list:
+    """Compare THIS run's per-function CPU sample shares against the
+    best prior rounds.  Records `cpu_functions` (this round's shares —
+    what future rounds ledger against), `cpu_func_best_prior` and
+    `cpu_func_regressions`, and returns the regressed functions so the
+    headline guard can name the hottest regressed FRAME, not just the
+    regressed stage."""
+    shares = _cpu_function_shares(out)
+    out["cpu_functions"] = shares or None
+    best = _best_prior_cpu_functions()
+    out["cpu_func_best_prior"] = {
+        f: {"share": round(s, 4), "src": src}
+        for f, (s, src) in sorted(best.items())
+    } or None
+    regressions = []
+    for func, (best_s, src) in sorted(best.items()):
+        cur = shares.get(func)
+        if cur is None:
+            continue
+        if (cur > best_s * CPU_SHARE_REGRESSION_FRAC
+                and cur - best_s > CPU_SHARE_REGRESSION_ABS):
+            regressions.append({
+                "func": func, "share": round(cur, 4),
+                "best_prior_share": round(best_s, 4), "src": src,
+            })
+    regressions.sort(key=lambda r: -r["share"])
+    out["cpu_func_regressions"] = regressions or None
+    return regressions
+
+
 def _dominant_stage(out: dict) -> str:
     """Name the stage/segment that owns the headline's wall clock: the
     largest-seconds entry of the codec attribution block (e.g.
@@ -2804,6 +2940,7 @@ def _headline_guard(out: dict) -> int:
     out["headline_dominant_segment"] = dominant
     out["headline_burning_slo"] = _burning_slo(out)
     stage_regs = _stage_ledger(out)
+    cpu_regs = _cpu_ledger(out)
     value = float(out.get("value") or 0.0)
     if best > 0.0 and value < HEADLINE_REGRESSION_FRAC * best:
         if stage_regs:
@@ -2820,6 +2957,16 @@ def _headline_guard(out: dict) -> int:
             stage_msg = ("No per-stage link regression vs prior rounds "
                          "(the slowdown is outside the device link, or "
                          "no prior round embedded link_stages). ")
+        if cpu_regs:
+            hot = cpu_regs[0]  # sorted hottest-first by current share
+            stage_msg += (
+                f"Hottest regressed frame: {hot['func']} at "
+                f"{hot['share'] * 100:.1f}% of CPU samples vs "
+                f"{hot['best_prior_share'] * 100:.1f}% best prior "
+                f"({hot['src']})"
+                + (f" (+{len(cpu_regs) - 1} more, see "
+                   f"cpu_func_regressions)" if len(cpu_regs) > 1 else "")
+                + ". ")
         put_cp = out.get("put_critical_path") or {}
         put_dom = ", ".join(
             f"{ep}→{d.get('dominant')}" for ep, d in put_cp.items())
@@ -2850,7 +2997,12 @@ def main() -> None:
         return
     for flag, phase in _PHASES.items():
         if flag in sys.argv:
-            print(json.dumps(asyncio.run(phase())))
+            prof = _phase_profiler()
+            res = asyncio.run(phase())
+            blk = _phase_cpu_block(prof)
+            if blk is not None and isinstance(res, dict):
+                res[_phase_cpu_key(flag)] = blk
+            print(json.dumps(res))
             return
 
     os.makedirs(JAX_CACHE_DIR, exist_ok=True)
@@ -2953,6 +3105,7 @@ def main() -> None:
     if not attach.up:
         print("# tpu not attached by hybrid phase; CPU floor runs, async "
               "attach continues", file=sys.stderr)
+    hybrid_prof = _phase_profiler()  # headline phase runs in-process
     try:
         hybrid, tpu_frac, dev_stats, codec = bench_hybrid(
             batches, attach.up)
@@ -2987,6 +3140,11 @@ def main() -> None:
             out["attribution"] = codec_attribution(codec)
     except Exception:
         traceback.print_exc()
+    # the headline's own CPU profile: covers the hybrid + crossover +
+    # sustained passes — the window the scrub GiB/s value comes from
+    blk = _phase_cpu_block(hybrid_prof)
+    if blk is not None:
+        out["hybrid_phase_cpu_profile"] = blk
     emit()
 
     # Opportunistic late capture (VERDICT r3 #1): if the tunnel answered

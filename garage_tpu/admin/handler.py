@@ -731,6 +731,24 @@ class AdminRpcHandler:
             rounds=rounds,
         )
 
+    async def _cmd_cpu_profile(self, msg) -> Dict:
+        """The continuous CPU profiler's readout (utils/cpuprof.py):
+        folded stacks covering roughly the last `seconds`, joined to
+        thread roles and span segments, plus the windowed busy ratios
+        and the sampler's measured self-cost.  Served from the always-on
+        sampler's history — no re-sampling wait."""
+        prof = getattr(self.garage, "cpuprof", None)
+        if prof is None or not prof.running:
+            raise GarageError("cpu profiler is not running on this node")
+        seconds = float(msg.get("seconds") or 10.0)
+        if not 0.0 < seconds <= 3600.0:
+            raise GarageError("seconds must be in (0, 3600]")
+        top = msg.get("top")
+        top_k = int(top) if top else 40
+        if not 0 < top_k <= 512:
+            raise GarageError("top must be in (0, 512]")
+        return prof.profile(seconds=seconds, top_k=top_k)
+
     async def _cmd_slow_ops(self, msg) -> List[Dict]:
         """Top-N slowest spans retained by the always-on slow-op log
         (works with no trace_sink configured), slowest first."""

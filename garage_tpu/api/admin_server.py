@@ -11,6 +11,7 @@ from __future__ import annotations
 import hmac
 import json
 import logging
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -37,13 +38,36 @@ def metrics_body(garage, openmetrics: bool = False) -> str:
     gauge("cluster_known_nodes", h.known_nodes)
     # refresh scrape-time observed gauges (per-table backlogs, the
     # per-worker status registry, per-peer health), then render the
-    # registry that the rpc/table/block/api layers record into
-    for t in garage.tables:
-        t.observe_gauges()
-    garage.bg.observe_gauges(garage.system.metrics)
-    garage.system.peering.observe_gauges()
-    return ("\n".join(lines) + "\n"
-            + garage.system.metrics.render(openmetrics=openmetrics))
+    # registry that the rpc/table/block/api layers record into.
+    # Each subsystem's sweep is timed (metrics_gauge_sweep_seconds):
+    # the ROADMAP 128-node wall is exactly these sweeps growing with
+    # the fleet, so the scrape's self-cost must be a datapoint.
+    reg = garage.system.metrics
+    sweep_g = reg.gauge(
+        "metrics_gauge_sweep_seconds",
+        "Scrape-time gauge sweep cost per subsystem (last scrape)")
+    render_g = reg.gauge(
+        "metrics_render_seconds",
+        "Wall time of the previous /metrics registry render")
+
+    def timed_sweep(subsystem, fn):
+        t0 = time.perf_counter()
+        try:
+            fn()
+        finally:
+            sweep_g.set(time.perf_counter() - t0, subsystem=subsystem)
+
+    timed_sweep("tables", lambda: [t.observe_gauges()
+                                   for t in garage.tables])
+    timed_sweep("workers", lambda: garage.bg.observe_gauges(reg))
+    timed_sweep("peering",
+                lambda: garage.system.peering.observe_gauges())
+    # the render gauge necessarily reports the PREVIOUS scrape's render
+    # cost: its own value must land inside the body it measures
+    t0 = time.perf_counter()
+    body = reg.render(openmetrics=openmetrics)
+    render_g.set(time.perf_counter() - t0)
+    return "\n".join(lines) + "\n" + body
 
 
 class AdminApiServer:

@@ -218,6 +218,27 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="emit the machine-readable block instead of "
                           "the table")
 
+    pcpu = sub.add_parser(
+        "cpu",
+        help="continuous CPU profiler (always-on thread-stack sampling "
+             "joined to roles + waterfall segments; utils/cpuprof.py)")
+    cus = pcpu.add_subparsers(dest="cpu_cmd", required=True)
+    cup = cus.add_parser(
+        "profile",
+        help="folded stacks from roughly the last --seconds, hottest "
+             "first, with per-role busy ratios and the sampler's "
+             "measured self-cost (<2%% budget)")
+    cup.add_argument("--seconds", type=float, default=10.0,
+                     help="history window to fold (served instantly "
+                          "from the always-on sampler)")
+    cup.add_argument("--top", type=int, default=40,
+                     help="top-K stacks to return")
+    cup.add_argument("--fold", action="store_true",
+                     help="emit raw collapsed-stack lines "
+                          "(flamegraph.pl-compatible)")
+    cup.add_argument("--json", action="store_true",
+                     help="emit the machine-readable profile block")
+
     pso = sub.add_parser(
         "slow-ops",
         help="top-N slowest operations retained by the always-on "
@@ -728,6 +749,38 @@ async def _amain(args) -> None:
             else:
                 from garage_tpu.ops.link_profiler import format_sweep
                 print(format_sweep(block))
+        return
+
+    if args.command == "cpu":
+        prof = await client.call({
+            "cmd": "cpu_profile",
+            "seconds": args.seconds,
+            "top": args.top,
+        })
+        if args.json:
+            print(json.dumps(prof, indent=2))
+        elif args.fold:
+            # raw collapsed-stack lines — pipe straight into
+            # flamegraph.pl / speedscope
+            for rec in prof["top"]:
+                print(f"{rec['stack']} {rec['count']}")
+        else:
+            busy = " ".join(f"{r}={v:.0%}" for r, v in
+                            prof.get("busy_ratio", {}).items())
+            print(f"==== CPU profile (last ~{prof['seconds']}s, "
+                  f"{prof['samples']} samples @ {prof['hz']}Hz, "
+                  f"sampler overhead "
+                  f"{prof['overhead_ratio'] * 100:.2f}%) ====")
+            if busy:
+                print(f"busy: {busy}")
+            rows = ["SHARE\tROLE\tSEGMENT\tHOTTEST FRAME\tSTACK"]
+            for rec in prof["top"]:
+                stack = rec["stack"].split(";", 2)[-1]
+                if len(stack) > 72:
+                    stack = "…" + stack[-71:]
+                rows.append(f"{rec['share'] * 100:5.1f}%\t{rec['role']}"
+                            f"\t{rec['segment']}\t{rec['leaf']}\t{stack}")
+            print(format_table(rows))
         return
 
     if args.command == "slow-ops":

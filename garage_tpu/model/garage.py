@@ -11,8 +11,10 @@ background workers (garage.rs:358-379).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 from ..block.manager import BlockManager
@@ -332,6 +334,20 @@ class Garage:
         )
         self._wire_flight_recorder()
 
+        # --- continuous CPU profiler (docs/OBSERVABILITY.md "CPU
+        # attribution"): always-on thread-stack sampler joined to the
+        # waterfall segment taxonomy.  Constructed here so its metric
+        # families live on this node's registry; started alongside the
+        # workers (spawn_workers) and stopped in shutdown() ---
+        from ..utils.cpuprof import CpuProfiler
+
+        self.cpuprof = CpuProfiler(
+            metrics=self.system.metrics,
+            hz=float(getattr(config, "cpuprof_hz", 29.0)))
+        self.flightrec.add_collector(
+            "cpu_profile",
+            lambda: self.cpuprof.flight_recorder_section())
+
         self.bg = BackgroundRunner()
         # background workers duty-cycle against foreground pressure
         self.bg.governor = self.governor
@@ -437,6 +453,28 @@ class Garage:
     # --- workers (ref garage.rs:358-379, block/manager.rs:192-227) ---
 
     def spawn_workers(self) -> None:
+        # the node is going live: start the always-on CPU sampler and
+        # register this (event-loop) thread so its samples join to the
+        # running task's span segment
+        from ..utils import cpuprof as _cpuprof
+
+        try:
+            _cpuprof.register_loop()
+        except RuntimeError:
+            pass  # no running loop (sync harnesses): worker roles still join
+        else:
+            # the to_thread pool (stream digests, zstd, direct-io
+            # writes, sqlite scans) is long-lived once spawned: give it
+            # a named, role-registered executor so its samples don't
+            # fold under other;other.  First Garage on the loop wins;
+            # asyncio.run's shutdown_default_executor reaps it.
+            loop = asyncio.get_running_loop()
+            if getattr(loop, "_default_executor", None) is None:
+                loop.set_default_executor(ThreadPoolExecutor(
+                    thread_name_prefix="aio-worker",
+                    initializer=lambda:
+                        _cpuprof.register_thread("aio-worker")))
+        self.cpuprof.start()
         for t in self.tables:
             # batched Merkle hashing rides the codec feeder's ragged
             # mhash path (class bg) — the trie drain shares the data
@@ -622,6 +660,7 @@ class Garage:
         # for anything spawned in between)
         await self.system.rpc.shutdown(timeout=5.0)
         await self.bg.shutdown()
+        self.cpuprof.stop()
         tracer = getattr(self.system, "tracer", None)
         if tracer is not None:
             await tracer.stop()  # final span flush before the node exits

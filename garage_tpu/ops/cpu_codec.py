@@ -40,9 +40,11 @@ def _hash_pool() -> concurrent.futures.ThreadPoolExecutor:
     global _SHARED_POOL
     with _SHARED_POOL_LOCK:
         if _SHARED_POOL is None:
+            from ..utils.cpuprof import register_thread
             _SHARED_POOL = concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(32, os.cpu_count() or 4),
                 thread_name_prefix="codec-hash",
+                initializer=lambda: register_thread("codec-hash"),
             )
         return _SHARED_POOL
 

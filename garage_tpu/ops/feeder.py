@@ -49,6 +49,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..utils.cpuprof import register_thread, unregister_thread
+
 logger = logging.getLogger("garage_tpu.ops.feeder")
 
 KINDS = ("hash", "encode", "decode", "scrub", "mhash")
@@ -395,6 +397,13 @@ class CodecFeeder:
         return batch
 
     def _run(self) -> None:
+        register_thread("feeder-dispatch")
+        try:
+            self._run_inner()
+        finally:
+            unregister_thread()
+
+    def _run_inner(self) -> None:
         while True:
             with self._cond:
                 while not self._pending and not self._closed:
@@ -614,14 +623,18 @@ class CodecFeeder:
                         it.future.set_exception(err)
 
     def _scrub_worker(self) -> None:
-        while True:
-            with self._scrub_cond:
-                while not self._scrub_q:
-                    self._scrub_cond.wait()
-                job = self._scrub_q.popleft()
-            if job is None:
-                return
-            self._dispatch_scrub_inline(*job)
+        register_thread("feeder-scrub")
+        try:
+            while True:
+                with self._scrub_cond:
+                    while not self._scrub_q:
+                        self._scrub_cond.wait()
+                    job = self._scrub_q.popleft()
+                if job is None:
+                    return
+                self._dispatch_scrub_inline(*job)
+        finally:
+            unregister_thread()
 
     def _dispatch_scrub_inline(self, batch: List[_Item],
                                side: str) -> None:

@@ -181,8 +181,8 @@ class HybridCodec(BlockCodec):
         if self.tpu is None and build_device:
             if build_device == "async":
                 threading.Thread(
-                    target=self._build_device, name="codec-hybrid-devinit",
-                    daemon=True,
+                    target=self._build_device_thread,
+                    name="codec-hybrid-devinit", daemon=True,
                 ).start()
             else:
                 self._build_device()
@@ -214,6 +214,17 @@ class HybridCodec(BlockCodec):
         self._governor_ratio = ratio_fn
         if self.transport is not None:
             self.transport.governor_ratio = ratio_fn
+
+    def _build_device_thread(self) -> None:
+        """Async-attach path: the dedicated devinit thread registers
+        with the CPU profiler for its lifetime.  The SYNC path calls
+        _build_device directly and keeps its caller's role."""
+        from ..utils.cpuprof import register_thread, unregister_thread
+        register_thread("device-init")
+        try:
+            self._build_device()
+        finally:
+            unregister_thread()
 
     def _build_device(self) -> None:
         try:
@@ -711,9 +722,17 @@ class HybridCodec(BlockCodec):
                         dq.append(carry)
                 gate_hold.set()
 
+        def feeder_thread():
+            from ..utils.cpuprof import register_thread, unregister_thread
+            register_thread("hybrid-feeder")
+            try:
+                feeder()
+            finally:
+                unregister_thread()
+
         if use_device:
-            t = threading.Thread(target=feeder, name="codec-hybrid-feeder",
-                                 daemon=True)
+            t = threading.Thread(target=feeder_thread,
+                                 name="codec-hybrid-feeder", daemon=True)
             _LIVE_FEEDERS.append(t)
             while len(_LIVE_FEEDERS) > 8:  # drop long-finished entries
                 old = _LIVE_FEEDERS.popleft()

@@ -66,6 +66,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..utils.cpuprof import register_thread, unregister_thread
 from ..utils.data import Hash
 
 logger = logging.getLogger("garage_tpu.ops.transport")
@@ -626,6 +627,13 @@ class DeviceTransport:
                 <= self.budget_bytes)
 
     def _run(self) -> None:
+        register_thread("transport-stage")
+        try:
+            self._run_inner()
+        finally:
+            unregister_thread()
+
+    def _run_inner(self) -> None:
         while True:
             batch = None
             with self._cond:

@@ -66,11 +66,16 @@ class AsyncHasher:
         self._finished = False
 
     def _run(self) -> None:
-        while True:
-            blk = self._q.get()
-            if blk is None:
-                return
-            self._h.update(blk)
+        from .cpuprof import register_thread, unregister_thread
+        register_thread("merkle")
+        try:
+            while True:
+                blk = self._q.get()
+                if blk is None:
+                    return
+                self._h.update(blk)
+        finally:
+            unregister_thread()
 
     async def update(self, data: bytes) -> None:
         if self._finished:

@@ -323,6 +323,10 @@ class Config:
     # disk (oldest deleted first) and the auto-trigger debounce window
     incident_max_bundles: int = 16
     incident_debounce_secs: float = 60.0
+    # [cpu] sample_hz — continuous thread-stack sampler rate
+    # (utils/cpuprof.py); default is co-prime with the 10/25/50/100 ms
+    # periodic workers so sampling can't phase-lock onto them
+    cpuprof_hz: float = 29.0
     consul_discovery: Optional[ConsulDiscoveryConfig] = None
     kubernetes_discovery: Optional[KubernetesDiscoveryConfig] = None
     # raw parsed TOML for anything not modeled
@@ -563,6 +567,16 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         if v < 0:
             raise ConfigError("incident.debounce_secs must be >= 0")
         cfg.incident_debounce_secs = v
+
+    cpu = raw.get("cpu", {})
+    bad = set(cpu) - {"sample_hz"}
+    if bad:
+        raise ConfigError(f"unknown [cpu] keys: {sorted(bad)}")
+    if "sample_hz" in cpu:
+        v = float(cpu["sample_hz"])
+        if not 0.0 < v <= 1000.0:
+            raise ConfigError("cpu.sample_hz must be in (0, 1000]")
+        cfg.cpuprof_hz = v
 
     table = raw.get("table", {})
     known = {f.name for f in dataclasses.fields(TableTunables)}

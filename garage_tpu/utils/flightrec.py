@@ -155,6 +155,8 @@ class FlightRecorder:
         return None
 
     def _write_logged(self, bundle: dict, stamp: float) -> None:
+        from .cpuprof import register_thread, unregister_thread
+        register_thread("incident-write")
         try:
             self.write(bundle)
         except Exception:  # noqa: BLE001 — an unwritable dir, already logged
@@ -164,6 +166,8 @@ class FlightRecorder:
                 self._last_auto_at = None
             logger.exception("deferred incident write failed (%s)",
                              bundle.get("reason"))
+        finally:
+            unregister_thread()
 
     def capture(self, reason: str, detail: Optional[dict] = None,
                 trigger: str = "manual") -> str:

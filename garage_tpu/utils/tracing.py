@@ -38,6 +38,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from .cpuprof import note_span_enter, note_span_exit  # noqa: E402
+
 logger = logging.getLogger("garage_tpu.tracing")
 
 FLUSH_INTERVAL = 3.0      # seconds between export batches
@@ -365,12 +367,18 @@ class Span:
 
     def __enter__(self) -> "Span":
         self._token = _current_span.set(self)
+        # CPU-profiler join: record this span's segment on the current
+        # task's stack so the sampler thread can tag event-loop samples
+        # with what was actually running (no-op unless a profiler is
+        # installed — see utils/cpuprof.enable_span_join)
+        note_span_enter(self.name)
         return self
 
     def __exit__(self, exc_type, exc, _tb) -> bool:
         self.end_ns = time.time_ns()
         if exc is not None:
             self.error = f"{exc_type.__name__}: {exc}"
+        note_span_exit()
         _current_span.reset(self._token)
         self._tracer._record(self)
         self._tracer.slow.note(

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Continuous-CPU-profiler smoke (ISSUE 17 CI satellite): capture a
+profile from a REAL daemon under PUT load and assert the acceptance
+invariants cheaply enough for every smoke run:
+
+  - the CLI (`cpu profile`) serves a non-empty collapsed-stack profile
+    from the always-on sampler, instantly (history-served, no
+    re-sampling wait);
+  - the folded stacks name at least the event-loop role, joined to a
+    waterfall-taxonomy segment;
+  - the sampler's MEASURED self-cost stays under the 2% budget;
+  - the `--fold` output is flamegraph.pl-compatible (`stack count`);
+  - the cpu_* and scrape-self-cost families render on the live node
+    and pass the strict exposition lint.
+
+Usage: scripts/dev_cluster.sh + dev_configure.sh first (test_smoke.sh
+runs this in sequence after smoke.py).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+BASE = os.environ.get("GARAGE_TPU_DEV_DIR", "/tmp/garage_tpu_dev")
+CFG = f"{BASE}/node0/garage.toml"
+S3_PORTS = (3900, 3910, 3920)
+ADMIN_PORTS = (3903, 3913, 3923)
+
+CPU_FAMILIES = (
+    "cpu_profile_samples_total",
+    "cpu_busy_ratio",
+    "cpu_profiler_overhead_ratio",
+    "cpu_profile_trie_nodes",
+    "metrics_render_seconds",
+    "metrics_gauge_sweep_seconds",
+)
+
+
+def cli(*args):
+    r = subprocess.run(
+        [sys.executable, "-m", "garage_tpu", "-c", CFG, *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if r.returncode != 0:
+        raise RuntimeError(f"cli {args}: {r.stdout}\n{r.stderr}")
+    return r.stdout
+
+
+async def put_load(rounds: int = 32, concurrency: int = 8) -> None:
+    """Drive 1 MiB PUTs through the node0 gateway so the sampler has a
+    busy window to fold (hash/EC work releases the GIL, so samples land
+    on the real call sites)."""
+    from test_s3_api import S3Client
+
+    out = cli("key", "create", "cpuprof-key")
+    kid = [ln for ln in out.splitlines() if "Key ID" in ln][0].split()[-1]
+    sec = [ln for ln in out.splitlines() if "Secret" in ln][0].split()[-1]
+    try:
+        cli("bucket", "create", "cpuprof")
+    except RuntimeError:
+        pass  # bucket survives from a prior run of this script
+    cli("bucket", "allow", "cpuprof", "--key", kid,
+        "--read", "--write", "--owner")
+    c = S3Client(S3_PORTS[0], kid, sec)
+    payloads = [os.urandom(1 << 20) for _ in range(rounds)]
+    sem = asyncio.Semaphore(concurrency)
+    errors = 0
+
+    async def one(i):
+        nonlocal errors
+        async with sem:
+            st, _, _ = await c.req("PUT", f"/cpuprof/blk-{i}",
+                                   body=payloads[i])
+            if st != 200:
+                errors += 1
+
+    await asyncio.gather(*[one(i) for i in range(rounds)])
+    assert errors == 0, f"{errors} client errors during profile load"
+
+
+async def main() -> None:
+    import aiohttp
+
+    from garage_tpu.utils.promlint import lint_exposition
+
+    await put_load()
+
+    prof = json.loads(cli("cpu", "profile", "--json", "--seconds", "60"))
+    assert prof["top"], "live profile served no folded stacks"
+    assert prof["samples"] > 0, prof
+    roles = {rec["role"] for rec in prof["top"]}
+    assert "event-loop" in roles, \
+        f"no event-loop samples in the live profile (roles: {roles})"
+    from garage_tpu.utils.waterfall import SEGMENTS
+    for rec in prof["top"]:
+        assert rec["segment"] in SEGMENTS, rec
+        assert rec["stack"].startswith(f"{rec['role']};{rec['segment']}"), \
+            rec
+    overhead = prof["overhead_ratio"]
+    assert overhead < 0.02, \
+        f"sampler overhead {overhead:.4f} breaks the 2% budget"
+
+    # flamegraph.pl-compatible collapsed output: `frame;frame;... N`
+    folded = cli("cpu", "profile", "--fold", "--seconds", "60")
+    lines = [ln for ln in folded.splitlines() if ln.strip()]
+    assert lines, "--fold emitted nothing"
+    for ln in lines:
+        stack, count = ln.rsplit(" ", 1)
+        assert int(count) > 0 and ";" in stack, ln
+
+    # the cpu_* + scrape-self-cost families render on the live gateway
+    # and the whole body stays lint-clean
+    async with aiohttp.ClientSession() as s:
+        async with s.get(
+                f"http://127.0.0.1:{ADMIN_PORTS[0]}/metrics") as r:
+            assert r.status == 200
+            body = await r.text()
+    problems = lint_exposition(body)
+    assert not problems, f"live /metrics fails lint: {problems}"
+    for fam in CPU_FAMILIES:
+        assert fam in body, f"family {fam} missing on live gateway"
+    sweeps = [ln for ln in body.splitlines()
+              if ln.startswith("metrics_gauge_sweep_seconds{")]
+    assert len(sweeps) >= 3, \
+        f"expected per-subsystem sweep gauges, got: {sweeps}"
+
+    busy = " ".join(f"{r}={v:.0%}" for r, v in
+                    sorted(prof["busy_ratio"].items()))
+    print(f"cpu profile smoke ok ({prof['samples']} samples, "
+          f"{len(prof['top'])} stacks, roles={sorted(roles)}, "
+          f"overhead={overhead * 100:.2f}%, busy: {busy})")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -31,6 +31,13 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/transport_smoke.py
 # per-cell stage breakdowns hold the exact-sum invariant LIVE, and the
 # probe verdict must carry a per-stage breakdown with stage_copy bytes
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/link_profile.py
+# continuous CPU profiler smoke (ISSUE 17): the always-on thread-stack
+# sampler on a REAL daemon must serve a non-empty collapsed-stack
+# profile via `cpu profile` under PUT load — folded stacks joined to
+# the role/segment taxonomy (at least the event-loop role present),
+# measured sampler overhead under the 2% budget, and the cpu_* +
+# scrape-self-cost families lint-clean on the live gateway
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/cpu_profile.py
 # degraded-mode smoke: one hard partition between the two replicas of an
 # in-process 3-node cluster must stay client-invisible (quorum 2/3), and
 # one flaky-disk + ENOSPC node must go read-only (typed StorageFull) and

@@ -1,0 +1,452 @@
+"""Continuous CPU profiler tests (ISSUE 17): always-on thread-stack
+sampling joined to the waterfall segment taxonomy.
+
+Covers the acceptance contract:
+
+  - bounded stack-trie fold/eviction with COUNT CONSERVATION (an
+    evicted stack becomes a truncated stack, never a lost sample) —
+    driven deterministically with synthetic paths and a fake clock;
+  - role/segment join: a registered worker thread folds under its
+    role's taxonomy segment, an event-loop sample under the segment of
+    the span the running task was actually inside (the tracing hook),
+    and unregistered threads stay visible under ``other;other``;
+  - idle classification: parked waiters feed the busy-ratio
+    denominator but never pollute the flamegraph — including the
+    GIL-handoff nuance that ``select(timeout=0)`` on a busy loop is
+    loop overhead, not idleness;
+  - collapsed-stack output is flamegraph.pl-shaped
+    (``role;segment;mod.fn;… count``);
+  - the history ring serves ``recent_folded`` windows instantly and
+    trims to ``history_s``;
+  - measured sampler overhead stays under the 2% budget on a REAL busy
+    window (hash work that releases the GIL, so the sweep pays real
+    contention);
+  - incident bundles carry a ``cpu_profile`` section;
+  - the new metric families render promlint-clean and are documented
+    (metricsdoc contract), and ``[cpu] sample_hz`` parses + validates.
+"""
+
+import asyncio
+import hashlib
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+import pytest
+
+from garage_tpu.utils import cpuprof
+from garage_tpu.utils.config import ConfigError, config_from_dict
+from garage_tpu.utils.cpuprof import (CpuProfiler, StackTrie, _frame_label,
+                                      _is_idle_leaf, enable_span_join,
+                                      register_loop, register_thread,
+                                      thread_role, unregister_thread)
+from garage_tpu.utils.flightrec import FlightRecorder
+from garage_tpu.utils.metrics import MetricsRegistry
+from garage_tpu.utils.metricsdoc import undocumented_families
+from garage_tpu.utils.promlint import lint_exposition
+from garage_tpu.utils.tracing import Tracer
+from garage_tpu.utils.waterfall import SEGMENTS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as _f:
+    DOC = _f.read()
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Thread-role registry and span join are process-global: restore
+    them around every test."""
+    with cpuprof._reg_lock:
+        roles = dict(cpuprof._thread_roles)
+        loops = dict(cpuprof._loops)
+    yield
+    with cpuprof._reg_lock:
+        cpuprof._thread_roles.clear()
+        cpuprof._thread_roles.update(roles)
+        cpuprof._loops.clear()
+        cpuprof._loops.update(loops)
+    enable_span_join(False)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _busy_frame():
+    """A real frame whose leaf is this module's ``inner``."""
+    def inner():
+        return sys._getframe()  # noqa: SLF001
+
+    def outer():
+        return inner()
+
+    return outer()
+
+
+def _parked_thread():
+    """A live thread parked in ``threading.Event.wait`` + its frame."""
+    ev = threading.Event()
+    t = threading.Thread(target=ev.wait, daemon=True)
+    t.start()
+    for _ in range(200):
+        frame = sys._current_frames().get(t.ident)  # noqa: SLF001
+        if frame is not None and frame.f_code.co_name in (
+                "wait", "_wait_for_tstate_lock"):
+            return ev, t, frame
+        time.sleep(0.005)
+    raise AssertionError("thread never parked")
+
+
+# --- stack trie ------------------------------------------------------------
+
+
+def test_trie_fold_counts():
+    trie = StackTrie(max_nodes=64)
+    for _ in range(3):
+        trie.add(["r", "s", "a", "b"])
+    trie.add(["r", "s", "a"], n=2)
+    assert trie.folded() == {"r;s;a;b": 3, "r;s;a": 2}
+    assert trie.total == 5
+    assert sum(trie.folded().values()) == trie.total
+
+
+def test_trie_eviction_bounded_and_conserving():
+    trie = StackTrie(max_nodes=64)
+    for i in range(500):
+        trie.add(["r", "s", f"f{i % 40}", f"g{i}", f"h{i}"])
+    # bounded (depth 0-1 role/segment nodes may ride above the budget,
+    # but they are a tiny fixed population — here exactly 2)
+    assert trie.nodes <= 64 + 2
+    assert trie.evicted_nodes > 0
+    folded = trie.folded()
+    # CONSERVATION: every one of the 500 samples is still counted —
+    # eviction folds a leaf's count into its parent (shorter stack),
+    # truncation counts at the deepest live prefix
+    assert sum(folded.values()) == trie.total == 500
+    # role/segment nodes are never evicted: everything stays attributed
+    assert all(key.startswith("r;s") for key in folded)
+
+
+def test_trie_role_segment_nodes_bypass_budget():
+    trie = StackTrie(max_nodes=16)
+    for i in range(40):
+        trie.add([f"role{i}", "other", "leaf"])
+    folded = trie.folded()
+    assert sum(folded.values()) == 40
+    # all 40 roles survive even though 40 > max_nodes
+    assert len({k.split(";")[0] for k in folded}) == 40
+
+
+# --- frame labelling + idle classification ---------------------------------
+
+
+def test_frame_label_module_function():
+    frame = _busy_frame()
+    assert _frame_label(frame.f_code) == "test_cpuprof.inner"
+    assert _frame_label(frame.f_back.f_code) == "test_cpuprof.outer"
+    # memoized
+    assert _frame_label(frame.f_code) is _frame_label(frame.f_code)
+
+
+def test_idle_leaf_classification():
+    ev, t, frame = _parked_thread()
+    try:
+        assert _is_idle_leaf(frame)
+        assert not _is_idle_leaf(_busy_frame())
+    finally:
+        ev.set()
+        t.join(timeout=2)
+
+
+def test_select_timeout_zero_counts_busy():
+    # GIL-handoff nuance: a busy event loop voluntarily releases inside
+    # selector.select(timeout=0) every iteration, so zero-timeout polls
+    # must classify BUSY or a saturated loop reads as parked
+    import selectors
+
+    sel = selectors.DefaultSelector()
+    holder = {}
+
+    def probe(timeout):
+        holder["frame"] = sys._getframe()  # noqa: SLF001
+        return timeout
+
+    probe.__code__ = probe.__code__.replace(
+        co_filename=selectors.__file__, co_name="select")
+    probe(0)
+    assert not _is_idle_leaf(holder["frame"])
+    probe(None)
+    assert _is_idle_leaf(holder["frame"])
+
+
+# --- sampling + role/segment join (fake clock, synthetic frames) -----------
+
+
+def test_sample_once_worker_role_join():
+    clock = FakeClock()
+    prof = CpuProfiler(hz=10, clock=clock)
+    busy_ident, idle_ident = 999001, 999002
+    register_thread("transport-stage", ident=busy_ident)
+    register_thread("feeder-dispatch", ident=idle_ident)
+    ev, t, parked = _parked_thread()
+    try:
+        frames = {busy_ident: _busy_frame(), idle_ident: parked}
+        for _ in range(5):
+            prof.sample_once(frames=frames)
+            clock.t += 0.1
+    finally:
+        ev.set()
+        t.join(timeout=2)
+        unregister_thread(ident=busy_ident)
+        unregister_thread(ident=idle_ident)
+    folded = prof.folded_counter()
+    assert sum(folded.values()) == 5
+    # the busy worker folds under its role's taxonomy segment…
+    assert all(k.startswith("transport-stage;transport;") for k in folded)
+    assert any(k.endswith(";test_cpuprof.inner") for k in folded)
+    # …the parked one feeds the denominator only
+    ratios = prof.busy_ratio()
+    assert ratios["transport-stage"] == 1.0
+    assert ratios["feeder-dispatch"] == 0.0
+
+
+def test_sample_once_unregistered_thread_is_other():
+    prof = CpuProfiler(hz=10, clock=FakeClock())
+    prof.sample_once(frames={424242: _busy_frame()})
+    assert all(k.startswith("other;other;")
+               for k in prof.folded_counter())
+
+
+def test_sampler_never_samples_itself():
+    prof = CpuProfiler(hz=10, clock=FakeClock())
+    prof.sample_once(frames={threading.get_ident(): _busy_frame()})
+    assert prof.samples == 0
+    assert not prof.folded_counter()
+
+
+def test_history_ring_recent_folded_and_trim():
+    clock = FakeClock(t=1000.0)
+    prof = CpuProfiler(hz=10, clock=clock, flush_s=1.0, history_s=10.0)
+    frames = {999001: _busy_frame()}
+    register_thread("merkle", ident=999001)
+    try:
+        prof.sample_once(frames=frames)          # t=1000, live delta
+        clock.t = 1002.0
+        prof.sample_once(frames=frames)          # flushes both samples
+        assert len(prof._history) == 1
+        # instantly served, no re-sampling wait
+        assert prof.recent_folded(seconds=60.0)
+        total = sum(int(ln.rsplit(" ", 1)[1])
+                    for ln in prof.recent_folded(seconds=60.0))
+        assert total == 2
+        # outside the window: nothing (flushed delta too old, no live)
+        clock.t = 1050.0
+        assert prof.recent_folded(seconds=5.0) == []
+        # a fresh sample shows up as the live (unflushed) delta AND the
+        # t=1002 history entry is trimmed past history_s
+        prof.sample_once(frames=frames)
+        recent = prof.recent_folded(seconds=5.0)
+        assert sum(int(ln.rsplit(" ", 1)[1]) for ln in recent) == 1
+        assert all(t >= 1050.0 - prof.history_s for t, _ in prof._history)
+    finally:
+        unregister_thread(ident=999001)
+
+
+def test_collapsed_stack_golden_shape():
+    prof = CpuProfiler(hz=10, clock=FakeClock())
+    register_thread("merkle", ident=999001)
+    try:
+        for _ in range(3):
+            prof.sample_once(frames={999001: _busy_frame()})
+    finally:
+        unregister_thread(ident=999001)
+    lines = prof.folded()
+    assert lines
+    shape = re.compile(r"^[\w<>.:-]+(;[\w<>.:-]+)+ \d+$")
+    for ln in lines:
+        assert shape.match(ln), ln
+        stack, count = ln.rsplit(" ", 1)
+        parts = stack.split(";")
+        assert parts[0] == "merkle" and parts[1] in SEGMENTS
+        assert int(count) > 0
+    block = prof.profile(seconds=None, top_k=5)
+    assert block["samples"] == 3
+    assert abs(sum(rec["share"] for rec in block["top"]) - 1.0) < 0.01
+    for rec in block["top"]:
+        assert rec["stack"].startswith(f"{rec['role']};{rec['segment']};")
+        assert rec["leaf"] == rec["stack"].rsplit(";", 1)[1]
+
+
+# --- live: event-loop span join + overhead budget --------------------------
+
+
+def test_event_loop_span_join_live():
+    """An event-loop sample taken DURING a span folds under the span's
+    segment (the explicit tracing hook), not the loop's static default.
+    The busy work releases the GIL (blake2s on a 1 MiB buffer) so the
+    foreign sampler reliably observes the loop mid-hash."""
+    prof = CpuProfiler(hz=200)
+    loop_ident = threading.get_ident()
+
+    async def main():
+        register_loop()
+        enable_span_join(True)
+        ready, stop = threading.Event(), threading.Event()
+
+        def sampler():
+            ready.wait(2.0)
+            while not stop.is_set():
+                prof.sample_once()
+                time.sleep(0.004)
+
+        st = threading.Thread(target=sampler, daemon=True)
+        st.start()
+        buf = os.urandom(1 << 20)
+        tr = Tracer("cpuprof-test")
+        with tr.span("RPC push"):
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                hashlib.blake2s(buf).digest()
+                ready.set()
+        stop.set()
+        st.join(timeout=2.0)
+
+    try:
+        asyncio.run(main())
+    finally:
+        unregister_thread(ident=loop_ident)
+    folded = prof.folded_counter()
+    joined = {k: v for k, v in folded.items()
+              if k.startswith("event-loop;rpc;")}
+    assert joined, f"no span-joined loop samples: {dict(folded)}"
+    # the GIL-releasing hash attributes to its Python call site
+    assert any("test_cpuprof" in k for k in joined), joined
+    assert prof.busy_ratio().get("event-loop", 0.0) > 0.2
+
+
+def test_overhead_under_budget_live():
+    """The <2% budget is MEASURED: run the real daemon at the default
+    rate against genuinely busy threads for a few seconds."""
+    prof = CpuProfiler(hz=29)
+    stop = threading.Event()
+
+    def burn():
+        register_thread("merkle")
+        buf = os.urandom(1 << 20)
+        try:
+            while not stop.is_set():
+                hashlib.blake2s(buf).digest()
+        finally:
+            unregister_thread()
+
+    threads = [threading.Thread(target=burn, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    prof.start()
+    try:
+        time.sleep(3.0)
+    finally:
+        prof.stop()
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+    assert prof.samples > 0
+    assert prof.overhead_ratio() < 0.02, prof.overhead_ratio()
+    assert any(k.startswith("merkle;codec;") for k in prof.folded_counter())
+
+
+@pytest.mark.slow
+def test_overhead_under_budget_ten_second_window():
+    """The acceptance wording verbatim: < 2% of a busy 10 s window."""
+    prof = CpuProfiler(hz=29)
+    stop = threading.Event()
+
+    def burn():
+        buf = os.urandom(1 << 20)
+        while not stop.is_set():
+            hashlib.blake2s(buf).digest()
+
+    t = threading.Thread(target=burn, daemon=True)
+    t.start()
+    prof.start()
+    try:
+        time.sleep(10.0)
+    finally:
+        prof.stop()
+        stop.set()
+        t.join(timeout=2)
+    assert prof.overhead_ratio() < 0.02, prof.overhead_ratio()
+
+
+# --- incident bundles, metrics, docs, config -------------------------------
+
+
+def test_flight_recorder_cpu_profile_section(tmp_path):
+    prof = CpuProfiler(hz=10, clock=FakeClock())
+    register_thread("incident-write", ident=999001)
+    try:
+        prof.sample_once(frames={999001: _busy_frame()})
+    finally:
+        unregister_thread(ident=999001)
+    fr = FlightRecorder(str(tmp_path), node_id="t")
+    fr.add_collector("cpu_profile",
+                     lambda: prof.flight_recorder_section())
+    path = fr.capture("unit-test")
+    with open(path) as f:
+        bundle = json.load(f)
+    section = bundle["sections"]["cpu_profile"]
+    assert "error" not in section
+    assert section["top"] and section["samples"] == 1
+    assert section["top"][0]["role"] == "incident-write"
+    assert section["top"][0]["segment"] == "disk"
+
+
+def test_metrics_render_lint_and_docs():
+    reg = MetricsRegistry()
+    prof = CpuProfiler(metrics=reg, hz=10, clock=FakeClock())
+    register_thread("merkle", ident=999001)
+    try:
+        prof.sample_once(frames={999001: _busy_frame()})
+    finally:
+        unregister_thread(ident=999001)
+    # the scrape self-cost gauges the admin server maintains
+    reg.gauge("metrics_render_seconds",
+              "Wall time of the previous /metrics registry render"
+              ).set(0.001)
+    reg.gauge("metrics_gauge_sweep_seconds",
+              "Scrape-time gauge sweep cost per subsystem (last scrape)"
+              ).set(0.0005, subsystem="tables")
+    body = reg.render()
+    assert lint_exposition(body) == []
+    for fam in ("cpu_profile_samples_total", "cpu_busy_ratio",
+                "cpu_profiler_overhead_ratio", "cpu_profile_trie_nodes",
+                "cpu_profile_truncated_samples_total",
+                "metrics_render_seconds", "metrics_gauge_sweep_seconds"):
+        assert f"# TYPE {fam} " in body, fam
+    assert 'cpu_profile_samples_total{role="merkle",segment="codec"} 1' \
+        in body
+    # metricsdoc contract: every new family has an OBSERVABILITY.md row
+    assert undocumented_families(body, DOC) == []
+
+
+def test_config_cpu_sample_hz():
+    cfg = config_from_dict({"metadata_dir": "/tmp/m",
+                            "data_dir": "/tmp/d",
+                            "cpu": {"sample_hz": 53.0}})
+    assert cfg.cpuprof_hz == 53.0
+    assert config_from_dict({"metadata_dir": "/tmp/m",
+                             "data_dir": "/tmp/d"}).cpuprof_hz == 29.0
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/m", "data_dir": "/tmp/d",
+                          "cpu": {"sample_hz": 0}})
+    with pytest.raises(ConfigError):
+        config_from_dict({"metadata_dir": "/tmp/m", "data_dir": "/tmp/d",
+                          "cpu": {"bogus": 1}})
