@@ -2026,6 +2026,99 @@ async def _transport_phase_async() -> dict:
     return out
 
 
+async def _pool_phase_async() -> dict:
+    """Warm/cold scrub A/B for the device-resident block pool (ISSUE
+    18): the SAME working set scrubbed through the feeder+transport on
+    the synthetic backend, once with the pool DISABLED (pool_mib=0 —
+    every window re-pays the link, the PR 11-17 status quo) and once
+    with the pool armed (after one untimed adoption pass every window
+    is a pure hit).  Windows alternate cold/warm to cancel host drift
+    (the put_batched discipline).  Reports sustained GiB/s both ways,
+    the LINK BYTES each side moved (warm must be ~0 — the
+    transport_staged_bytes_total flatness claim as a number), the
+    hit/miss byte attribution identity, and the warm rig's per-stage
+    link ledger.  Acceptance: warm ≥ 2× cold."""
+    from garage_tpu.ops.codec import CodecParams
+    from garage_tpu.ops.feeder import CodecFeeder
+    from garage_tpu.ops.hybrid_codec import HybridCodec
+    from garage_tpu.testing.synthetic_device import SyntheticLinkCodec
+    from garage_tpu.utils.data import Hash
+
+    blk = 1 << 20
+    n_scrub, scrub_blocks = 4, 2 * K
+    rng = np.random.default_rng(18)
+    base = rng.integers(0, 256, (scrub_blocks, blk), dtype=np.uint8)
+    blocks = [base[i].tobytes() for i in range(scrub_blocks)]
+    hashes = [Hash(hashlib.blake2s(b, digest_size=32).digest())
+              for b in blocks]
+
+    def mk_rig(pool_mib: int):
+        params = CodecParams(rs_data=K, rs_parity=M, block_size=blk,
+                             pool_mib=pool_mib, pool_page_kib=256)
+        # slower link than --transport-phase: this A/B isolates LINK
+        # bytes saved, so the cold side must be link-bound for the
+        # speedup to measure the pool rather than the RS kernel
+        dev = SyntheticLinkCodec(params, link_gibs=0.1, compute_real=True)
+        hy = HybridCodec(params, device_codec=dev)
+        hy._probe_link()            # cache the open-gate verdict
+        feeder = CodecFeeder(hy, slo_ms=1.0, max_batch_blocks=512)
+        return dev, hy, feeder
+
+    def window(feeder) -> float:
+        t0 = time.perf_counter()
+        futs = [feeder.submit_scrub(blocks, hashes, want_parity=True)
+                for _ in range(n_scrub)]
+        for f in futs:
+            ok, _par = f.result(timeout=300)
+            assert ok.all(), "corruption reported in clean batch"
+        return time.perf_counter() - t0
+
+    rigs = {"cold": mk_rig(0), "warm": mk_rig(64)}
+    assert rigs["cold"][1].pool is None
+    assert rigs["warm"][1].pool is not None, "pool not armed"
+    for tag in ("cold", "warm"):      # warm-up: compile pools, caches —
+        window(rigs[tag][2])          # and the pool's adoption pass
+    staged0 = {tag: rigs[tag][1].transport.staged_bytes
+               for tag in ("cold", "warm")}
+    times = {"cold": 0.0, "warm": 0.0}
+    rounds = 3
+    for _ in range(rounds):           # paired windows cancel host drift
+        for tag in ("cold", "warm"):
+            times[tag] += window(rigs[tag][2])
+    total_bytes = rounds * n_scrub * scrub_blocks * blk
+    link_bytes = {tag: rigs[tag][1].transport.staged_bytes - staged0[tag]
+                  for tag in ("cold", "warm")}
+    hy_warm = rigs["warm"][1]
+    pstats = hy_warm.pool.stats()
+    prof = hy_warm.obs.link_profiler
+    out = {
+        "pool_cold_gibs": round(total_bytes / times["cold"] / 2**30, 4),
+        "pool_warm_gibs": round(total_bytes / times["warm"] / 2**30, 4),
+        "pool_warm_speedup": round(times["cold"] / times["warm"], 3),
+        "pool_cold_link_bytes": link_bytes["cold"],
+        "pool_warm_link_bytes": link_bytes["warm"],
+        "pool_hit_bytes": pstats["hit_bytes"],
+        "pool_miss_bytes": pstats["miss_bytes"],
+        "pool_stats": pstats,
+        "pool_link_stages": prof.summary() if prof is not None else None,
+    }
+    # the acceptance claims, asserted where the numbers are made:
+    # a warm re-scrub moves (near-)zero link bytes and wins ≥ 2×
+    assert link_bytes["warm"] == 0, \
+        f"warm windows moved {link_bytes['warm']} link bytes"
+    assert link_bytes["cold"] >= total_bytes, \
+        "cold rig did not re-pay the link every window"
+    assert pstats["hit_bytes"] + pstats["miss_bytes"] == \
+        (rounds + 1) * n_scrub * scrub_blocks * blk, \
+        "hit+miss does not attribute every scrubbed byte"
+    assert out["pool_warm_speedup"] >= 2.0, \
+        f"warm scrub only {out['pool_warm_speedup']}x cold (want >= 2x)"
+    for tag in ("cold", "warm"):
+        rigs[tag][2].shutdown()
+        rigs[tag][1].close()
+    return out
+
+
 # --- metadata plane at millions of objects (ISSUE 14) ----------------------
 #
 # Drives the CRDT table engine itself at production cardinality: 1M
@@ -2444,6 +2537,7 @@ _PHASES = {
     "--overload-phase": _overload_phase_async,
     "--tenants-phase": _tenants_phase_async,
     "--transport-phase": _transport_phase_async,
+    "--pool-phase": _pool_phase_async,
     "--metadata-phase": _metadata_phase_async,
 }
 
@@ -3088,6 +3182,8 @@ def main() -> None:
     out.update(run_phase_subprocess("--tenants-phase"))
     emit()
     out.update(run_phase_subprocess("--transport-phase"))
+    emit()
+    out.update(run_phase_subprocess("--pool-phase"))
     emit()
     out.update(run_phase_subprocess("--wan-phase"))
     emit()

@@ -23,6 +23,7 @@ from ...model.s3.object_table import (
     ObjectVersionMeta,
 )
 from ...model.s3.version_table import Version
+from ...ops.codec import mhash_stream
 from ...utils.crdt import now_msec
 from ...utils.data import Uuid, gen_uuid
 from ..common import (
@@ -125,12 +126,19 @@ async def handle_upload_part(ctx) -> web.Response:
 
     md5 = hashlib.md5()
     sha256 = hashlib.sha256()
+    # incremental BLAKE2b-256 over THIS part's bytes, advanced in the
+    # same off-loop digest hop as md5/sha256 (put.py
+    # update_stream_digests): a 1 GiB part is hashed exactly once, while
+    # it streams — completing the upload never rereads or rehashes the
+    # assembled object
+    mhash = mhash_stream()
     # on error the part is left unfinished; abort/lifecycle reaps it
     with request_scope(garage):
         chunker = Chunker(ctx.body_stream(), garage.config.block_size)
         first = await chunker.next() or b""
         total_size, _fh = await read_and_put_blocks(
-            ctx, version, part_number, first, chunker, md5, sha256
+            ctx, version, part_number, first, chunker, md5, sha256,
+            mhash=mhash,
         )
     etag = md5.hexdigest()
     content_sha256 = ctx.verified.content_sha256
@@ -140,7 +148,10 @@ async def handle_upload_part(ctx) -> web.Response:
 
     mpu.parts[(part_number, ts)] = MpuPart.new(bytes(part_version_uuid), etag, total_size)
     await garage.mpu_table.insert(mpu)
-    return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+    return web.Response(status=200, headers={
+        "ETag": f'"{etag}"',
+        "x-garage-part-blake2b": mhash.hexdigest(),
+    })
 
 
 def _parse_complete_body(body: bytes):

@@ -22,6 +22,7 @@ from typing import AsyncIterator, Dict, Optional, Tuple
 from aiohttp import web
 
 from ...block.manager import INLINE_THRESHOLD
+from ...ops.codec import mhash_stream
 from ...model.s3.object_table import (
     Object,
     ObjectVersion,
@@ -119,8 +120,15 @@ async def save_stream(
     key: str,
     content_md5: Optional[str] = None,
     content_sha256: Optional[str] = None,
+    mhash=None,
 ) -> Tuple[str, int]:
-    """Store a full object body; returns (etag, size) (ref put.rs:66-199)."""
+    """Store a full object body; returns (etag, size) (ref put.rs:66-199).
+
+    `mhash` is an optional IncrementalHash (ops/codec.py mhash_stream):
+    when provided it advances over the body bytes IN THE SAME off-loop
+    hop as md5/sha256, so the whole-object BLAKE2b-256 digest exists at
+    stream end without ever rehashing the assembled object — O(1) extra
+    state per request, zero extra passes over the data."""
     garage = ctx.garage
     bucket_id = ctx.bucket_id
     chunker = Chunker(stream, garage.config.block_size)
@@ -133,6 +141,8 @@ async def save_stream(
     if len(first) < INLINE_THRESHOLD and chunker.eof and not chunker.buf:
         md5.update(first)
         sha256.update(first)
+        if mhash is not None:
+            mhash.update(first)
         etag = md5.hexdigest()
         _check_digests(etag, sha256.hexdigest(), content_md5, content_sha256)
         await check_quotas(ctx, len(first), key)
@@ -168,7 +178,7 @@ async def save_stream(
 
     try:
         total_size, first_hash = await read_and_put_blocks(
-            ctx, version, 0, first, chunker, md5, sha256
+            ctx, version, 0, first, chunker, md5, sha256, mhash=mhash
         )
         etag = md5.hexdigest()
         _check_digests(etag, sha256.hexdigest(), content_md5, content_sha256)
@@ -208,7 +218,7 @@ META_BATCH = 8
 
 async def read_and_put_blocks(
     ctx, version: Version, part_number: int, first_block: bytes,
-    chunker: Chunker, md5, sha256,
+    chunker: Chunker, md5, sha256, mhash=None,
 ) -> Tuple[int, Hash]:
     """Windowed streaming loop (ref put.rs:286-360 is strictly per-block):
     read up to HASH_WINDOW blocks ahead, hash the window in one worker
@@ -253,9 +263,15 @@ async def read_and_put_blocks(
             await garage.block_manager.rpc_put_block(h, data)
 
     def update_stream_digests(window):
+        # one sequential pass shared by every stream digest: md5/sha256
+        # for S3 semantics, plus the optional incremental BLAKE2b state
+        # (satellite channel — the content digest is finished the moment
+        # the last body byte arrives, no second pass over a 1 GiB body)
         for b in window:
             md5.update(b)
             sha256.update(b)
+            if mhash is not None:
+                mhash.update(b)
 
     def hash_window(window):
         update_stream_digests(window)
@@ -381,8 +397,17 @@ async def handle_put_object(ctx) -> web.Response:
     content_sha256 = ctx.verified.content_sha256
     if content_sha256 in (None, "STREAMING"):
         content_sha256 = None
+    # incremental whole-object BLAKE2b-256 (utils.data.blake2sum family,
+    # the metadata/merkle digest): advanced alongside md5/sha256 during
+    # streaming so the digest is free at stream end — surfaced to the
+    # client as a response header (schema-safe: no table changes)
+    mhash = mhash_stream()
     with request_scope(ctx.garage):
         etag, _size = await save_stream(
-            ctx, ctx.body_stream(), headers, key, content_md5, content_sha256
+            ctx, ctx.body_stream(), headers, key, content_md5, content_sha256,
+            mhash=mhash,
         )
-    return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+    return web.Response(status=200, headers={
+        "ETag": f'"{etag}"',
+        "x-garage-content-blake2b": mhash.hexdigest(),
+    })

@@ -387,6 +387,24 @@ class BlockManager:
                 best = r
         return best or os.path.dirname(path)
 
+    def pool_invalidate(self, h: Hash, reason: str) -> None:
+        """Strict device-pool invalidation (ops/device_pool.py): evict
+        `h`'s device-resident pages SYNCHRONOUSLY, before the calling
+        operation acks — block delete, quarantine, rebalance-drop and
+        overwrite all come through here, so the pool can never serve a
+        page for a block the store no longer holds.  Thread-safe and
+        cheap (a dict op under the pool's lock), callable from worker
+        threads and the event loop alike; a pool-less codec is a
+        no-op."""
+        pool = getattr(self.codec, "pool", None)
+        if pool is None:
+            return
+        try:
+            pool.invalidate(bytes(h), reason=reason)
+        except Exception:  # noqa: BLE001 — invalidation must not fail the op
+            logger.warning("device pool invalidation failed",
+                           exc_info=True)
+
     def quarantine_path(self, path: str) -> None:
         """Move a bad copy aside as `.corrupted` for later forensics.
         A failing rename is NOT swallowed (the old `_move_corrupted`
@@ -602,6 +620,10 @@ class BlockManager:
             except OSError:
                 pass
         self.bytes_written += len(data.inner)
+        # overwrite: the on-disk form changed (fresh copy / compressed
+        # upgrade) — drop any device pages so the pool re-adopts from
+        # the new copy rather than trusting a page for a superseded one
+        self.pool_invalidate(h, "overwrite")
         return True
 
     async def read_block(self, h: Hash) -> DataBlock:
@@ -654,6 +676,7 @@ class BlockManager:
             self._note_disk_error(h)
             logger.error("disk read error on block %s at %s "
                          "(errno %s: %s)", hb.hex()[:16], path, e.errno, e)
+            self.pool_invalidate(h, "quarantine")
             await asyncio.to_thread(self.quarantine_path, path)
             if self.resync is not None:
                 self.resync.put_to_resync(h, 0.0, source="disk_error")
@@ -665,6 +688,7 @@ class BlockManager:
         except CorruptData:
             self.corruptions += 1
             logger.error("corrupted block %s at %s", hb.hex()[:16], path)
+            self.pool_invalidate(h, "quarantine")
             await asyncio.to_thread(self.quarantine_path, path)
             if self.resync is not None:
                 self.resync.put_to_resync(h, 0.0, source="corrupt_read")
@@ -704,6 +728,9 @@ class BlockManager:
         async with self._lock_for(h):
             if not self.rc.get(h).is_deletable():
                 return
+            # strict pool invalidation BEFORE the copy disappears: a
+            # deleted block must not survive as a servable device page
+            self.pool_invalidate(h, "delete")
             while True:
                 found = self.find_block(h)
                 if found is None:
@@ -1196,6 +1223,9 @@ class BlockManager:
         async with self._lock_for(h):
             if self.rc.get(h).is_needed() or self.is_assigned(h):
                 return
+            # rebalance-drop: evict the device pages before the copy
+            # goes (strict pool invalidation, synchronous pre-ack)
+            self.pool_invalidate(h, "rebalance")
             while True:
                 found = self.find_block(h)
                 if found is None:

@@ -233,6 +233,13 @@ class ScrubWorker(Worker):
                 # survive until the NEXT pass refreshes it)
                 self._prev_pass_start = st.time_last_start / 1000.0
                 st.time_last_start = now_msec()
+                # one full scrub pass == one device-pool clock tick: the
+                # pool's LRU ages in scrub CYCLES, not wall time, so an
+                # idle cluster never evicts its warm working set while
+                # nothing else competes for pages (ops/device_pool.py)
+                pool = getattr(self.manager.codec, "pool", None)
+                if pool is not None:
+                    pool.tick()
         elif cmd == "pause":
             st.paused = True
         elif cmd == "resume":
@@ -327,6 +334,21 @@ class ScrubWorker(Worker):
             *[asyncio.to_thread(_try_read, self.manager, path)
               for _h, path, _c in batch]
         )
+        # hint the device pool about the upcoming prefix: the transport
+        # stages these blocks as background-class work WHILE the current
+        # batch computes (riding the PR 11 double buffer), so the next
+        # batch's H2D cost hides under compute and its scrub becomes a
+        # pool hit.  Plain blocks only — compressed copies are verified
+        # on their decompressed content, which we don't have yet.
+        feeder = self.manager.feeder
+        if feeder is not None:
+            p_blocks, p_hashes = [], []
+            for (h, _path, compressed), raw in zip(batch, reads):
+                if not compressed and isinstance(raw, bytes):
+                    p_blocks.append(raw)
+                    p_hashes.append(h)
+            if p_blocks:
+                feeder.prefetch_scrub(p_blocks, p_hashes)
         return batch, list(reads), it.position
 
     async def scrub_batch(self, batch: List[Tuple[Hash, str, bool]],
@@ -488,6 +510,7 @@ class ScrubWorker(Worker):
         # manager.quarantine_path: counted (block_quarantine_total), and
         # a failing rename deletes the bad copy instead of silently
         # leaving it servable (the old _move_aside swallowed OSError)
+        self.manager.pool_invalidate(h, "quarantine")
         await asyncio.to_thread(self.manager.quarantine_path, path)
         # first line of defense: rebuild locally from the RS parity
         # sidecar — with every replica down this is the ONLY repair;

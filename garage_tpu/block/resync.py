@@ -315,6 +315,10 @@ class BlockResyncManager:
                 # valid where the ring still assigns us the block).
                 self.put_to_resync(h, 30.0, source="migration_retry")
             elif rc.is_deletable():
+                # both drop paths invalidate the device pool BEFORE the
+                # file goes (manager.pool_invalidate inside each helper):
+                # a rebalance-dropped block must not keep serving scrub
+                # hits from device pages after its local copy is gone
                 await mgr.delete_if_unneeded(h)
             else:
                 # unassigned, every owner confirmed, timer still running:

@@ -378,6 +378,16 @@ class Garage:
             lambda: mgr.codec.obs.timeline.chrome_trace(2048))
         fr.add_collector(
             "gate_events", lambda: mgr.codec.obs.events_list(128))
+
+        def _pool_stats():
+            # device-resident block pool (ops/device_pool.py): residency,
+            # hit/miss byte split and eviction counters — an incident on
+            # a device-armed node needs to show whether the warm path
+            # was actually warm when things went sideways
+            pool = getattr(mgr.codec, "pool", None)
+            return pool.stats() if pool is not None else None
+
+        fr.add_collector("device_pool", _pool_stats)
         fr.add_collector("slow_ops", lambda: sys_.tracer.slow.snapshot(32))
 
         fr.add_collector("peers", lambda: [

@@ -109,6 +109,85 @@ class CodecParams:
     # probe forces a real transfer round-trip, so it is immune to the
     # enqueue-time "completion" some remote backends report.
     hybrid_min_link_gibs: float = 0.07
+    # --- DevicePool (ops/device_pool.py): bounded device-resident block
+    # pages under the transport.  Budgeted SEPARATELY from
+    # max_device_staging_mib: the staging budget bounds bytes in
+    # FLIGHT (slot buffers, reclaimed at collect), the pool budget
+    # bounds bytes at REST (pages that survive across scrub passes so
+    # a warm re-scrub moves zero link bytes).  0 disables the pool —
+    # staging then behaves byte-identically to the pre-pool transport.
+    pool_mib: int = 256
+    # Fixed device page size (KiB).  A block spans ceil(len/page)
+    # pages with the tail page partially filled (ragged occupancy), so
+    # smaller pages waste less tail but cost more per-page handles;
+    # 256 KiB ≈ 4 pages per default 1 MiB block keeps handle counts
+    # trivial while bounding tail waste at < 25% for blocks ≥ 768 KiB.
+    pool_page_kib: int = 256
+    # Next-range prefetch: the scrub worker hints the upcoming hash
+    # range and the transport stages the non-resident blocks as
+    # background-class work while the current batch computes (riding
+    # the staging double buffer under the governor).
+    pool_prefetch: bool = True
+
+
+class IncrementalHash:
+    """O(1) running hash state — the update/finalize form of the
+    codec's one-shot digests (the portable O(1) autoregressive-caching
+    shape from PAPERS.md applied to streamed writes).
+
+    A streamed PUT or multipart part arrives window by window; hashing
+    the assembled object at the end would re-read every byte.  This
+    state absorbs each window as it passes (``update``) and emits the
+    digest at the end (``digest``) — BIT-IDENTICAL to the one-shot
+    hash of the concatenation, for ANY chunk boundaries, because
+    BLAKE2 is a sequential sponge: state after absorbing b1+b2 equals
+    state after absorbing b1 then b2.  tests/test_device_pool.py
+    proves the identity against blake2sum / blake2s_sum.
+
+    The state is O(1) in stream length (one BLAKE2 block buffer plus
+    the chaining value), so a 1 GiB multipart costs one pass of
+    hashing total and constant memory per in-flight part."""
+
+    __slots__ = ("_h", "nbytes")
+
+    def __init__(self, h):
+        self._h = h
+        self.nbytes = 0
+
+    def update(self, buf) -> "IncrementalHash":
+        self._h.update(buf)
+        self.nbytes += len(buf)
+        return self
+
+    def digest(self) -> Hash:
+        """Finalize (non-destructively: hashlib copies internally) —
+        the digest of everything absorbed so far."""
+        return Hash(self._h.digest())
+
+    def hexdigest(self) -> str:
+        return self._h.hexdigest()
+
+    def copy(self) -> "IncrementalHash":
+        c = IncrementalHash(self._h.copy())
+        c.nbytes = self.nbytes
+        return c
+
+
+def hash_stream() -> IncrementalHash:
+    """Incremental form of the block content hash (BLAKE2s-256,
+    utils.data.blake2s_sum)."""
+    import hashlib
+
+    return IncrementalHash(hashlib.blake2s(digest_size=32))
+
+
+def mhash_stream() -> IncrementalHash:
+    """Incremental form of the metadata hash (BLAKE2b-256,
+    utils.data.blake2sum) — the streamed-PUT/multipart content digest
+    (api/s3/put.py, api/s3/multipart.py)."""
+    import hashlib
+
+    return IncrementalHash(hashlib.blake2b(digest_size=32))
 
 
 class BlockCodec:
@@ -234,6 +313,19 @@ class BlockCodec:
         from ..utils.data import blake2sum
 
         return [blake2sum(b) for b in bufs]
+
+    def hash_stream(self) -> IncrementalHash:
+        """Update/finalize form of the block content hash: absorb
+        windows as they stream, finalize bit-identical to
+        batch_hash([concatenation])[0]."""
+        return hash_stream()
+
+    def mhash_stream(self) -> IncrementalHash:
+        """Update/finalize form of the metadata hash (blake2sum) — the
+        streamed-write content digest carried per part by the S3 PUT
+        and multipart handlers so a 1 GiB upload is hashed in one pass
+        total, never by rehashing the assembled object."""
+        return mhash_stream()
 
     def mhash_ragged(self, groups: Sequence[Sequence[bytes]]
                      ) -> List[List[Hash]]:

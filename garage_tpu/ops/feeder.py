@@ -321,6 +321,24 @@ class CodecFeeder:
             sum(len(b) for b in blocks), cls=cls,
             want_parity=want_parity))
 
+    def prefetch_scrub(self, blocks: Sequence[bytes],
+                       hashes: Sequence) -> int:
+        """Hint the upcoming scrub range to the device pool
+        (DevicePool prefetch): non-resident blocks stage as
+        background-class transport work while the current batch
+        computes, so the next scrub batch pool-hits.  A no-op (0)
+        without an armed transport+pool — the hint is advisory and
+        never an error."""
+        tr = getattr(self.codec, "transport", None)
+        pf = getattr(tr, "prefetch", None)
+        if tr is None or pf is None or not tr.alive:
+            return 0
+        try:
+            return int(pf(list(blocks), list(hashes)))
+        except Exception:  # noqa: BLE001 — a lost hint is not an error
+            logger.warning("pool prefetch hint failed", exc_info=True)
+            return 0
+
     # sync conveniences with a closed-feeder fallback: shutdown races
     # degrade to the inline (pre-feeder) codec call, never to an error
     def hash_or_direct(self, blocks: Sequence[bytes]):
@@ -513,10 +531,23 @@ class CodecFeeder:
             # route for THIS batch.  A batch carrying any foreground
             # item never pays it: the probe can cost a full link
             # round-trip and this is the lone dispatcher thread.
-            refresh = getattr(self.codec, "refresh_gate", None)
-            if refresh is not None:
-                refresh()
-                side = self.codec.ragged_side()
+            # ...unless the device pool would serve the whole batch:
+            # a fully-resident scrub batch moves ZERO link bytes, so
+            # probing the link first is a pure 16 MiB cold-probe tax —
+            # route it to the device regardless of gate state instead
+            tr = getattr(self.codec, "transport", None)
+            covers = getattr(tr, "pool_covers", None)
+            scrubs = [it for it in all_items if it.kind != "mhash"]
+            if (tr is not None and tr.alive and tr.supports("scrub")
+                    and covers is not None and covers(scrubs)):
+                side = "tpu"
+                self.obs.event("feeder_route", reason="pool_resident",
+                               blocks=sum(it.blocks for it in scrubs))
+            else:
+                refresh = getattr(self.codec, "refresh_gate", None)
+                if refresh is not None:
+                    refresh()
+                    side = self.codec.ragged_side()
         if side != self._last_side:
             # route changes are gate decisions: they land in the same
             # event ring as the scrub feeder's probe/gate events

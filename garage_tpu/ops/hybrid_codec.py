@@ -157,6 +157,10 @@ class HybridCodec(BlockCodec):
         # the gate probe measures IT instead of the retired
         # serialize+copy path
         self.transport = None
+        # the device-resident block pool behind the transport (built by
+        # _arm_transport when budgeted); BlockManager's invalidation
+        # hooks and the scrub worker's cycle tick reach it here
+        self.pool = None
         self._metrics = metrics
         self._governor_ratio = None
         # accounting (read by bench.py and the admin worker registry)
@@ -200,12 +204,32 @@ class HybridCodec(BlockCodec):
 
         if not DeviceTransport.supports_device(self.tpu):
             return
+        # device-resident block pool (ops/device_pool.py): armed when
+        # budgeted and the device speaks the pool API; pool_mib=0 or a
+        # pool-less device keeps staging byte-identical to the legacy
+        # transport
+        from .device_pool import DevicePool
+
+        pool = None
+        pool_mib = int(getattr(self.params, "pool_mib", 0))
+        if pool_mib > 0 and DevicePool.supports_device(self.tpu):
+            pool = DevicePool(
+                self.tpu,
+                pool_bytes=pool_mib << 20,
+                page_bytes=int(getattr(self.params, "pool_page_kib",
+                                       256)) << 10,
+                prefetch=bool(getattr(self.params, "pool_prefetch",
+                                      True)),
+                metrics=self._metrics, observer=self.obs)
+        self.pool = pool
         tr = DeviceTransport(self.tpu, self.params, fallback=self.cpu,
-                             observer=self.obs, metrics=self._metrics)
+                             observer=self.obs, metrics=self._metrics,
+                             pool=pool)
         tr.governor_ratio = self._governor_ratio
         self.transport = tr  # atomic attach (feeder reads it racily)
         self.obs.event("transport_up", reason=type(self.tpu).__name__,
-                       slots=tr.slots)
+                       slots=tr.slots,
+                       pool_mib=pool_mib if pool is not None else 0)
 
     def set_governor(self, ratio_fn) -> None:
         """Wire the load governor's background_throttle_ratio into the
@@ -260,12 +284,16 @@ class HybridCodec(BlockCodec):
             })
         if self.transport is not None:
             d["transport"] = self.transport.stats()
+        if self.pool is not None:
+            d["pool"] = self.pool.stats()
         return d
 
     def close(self) -> None:
         """Drain the device transport (shutdown path; idempotent)."""
         if self.transport is not None:
             self.transport.shutdown()
+        if self.pool is not None:
+            self.pool.clear()
 
     def pop_stats(self) -> Tuple[int, int]:
         with self._stats_lock:

@@ -834,6 +834,78 @@ class TpuCodec(BlockCodec):
         self.last_submit_variant = "xla"
         return out
 
+    # --- the DevicePool API (ops/device_pool.py) ---
+    #
+    # Pool-aware scrub: only MISS lanes cross the link (one compact
+    # H2D upload + a device-side scatter); resident lanes are composed
+    # from pool pages — device-resident jnp arrays — entirely on
+    # device.  The composed batch runs the SAME fused kernel as the
+    # plain path, so pool-served lanes are re-verified against their
+    # expected digests on every read.
+
+    def scrub_encode_submit_resident(self, miss_arr: np.ndarray,
+                                     miss_rows, lengths: np.ndarray,
+                                     expected: np.ndarray, resident):
+        """Returns (scrub handle, composed device input) — the input
+        ref is what pool_adopt slices verified miss lanes out of."""
+        lanes = int(lengths.shape[0])
+        cols = int(miss_arr.shape[1])
+        assert lanes % self.params.rs_data == 0
+        assert cols % 4 == 0
+        with self.obs.stage("h2d_transfer", "tpu"):
+            full = jnp.zeros((lanes, cols), dtype=jnp.uint8)
+            if len(miss_rows):
+                dm = self._to_device(
+                    np.ascontiguousarray(miss_arr[:len(miss_rows)]))
+                idx = jnp.asarray(np.asarray(miss_rows, dtype=np.int32))
+                full = full.at[idx].set(dm)
+            dl = jnp.asarray(lengths)
+            de = jnp.asarray(expected)
+        # device-side composition of pool-resident lanes: no host
+        # bytes move here — pages are already device arrays
+        for r, pages, length in resident:
+            row = jnp.concatenate(list(pages))
+            if int(row.shape[0]) < cols:
+                row = jnp.pad(row, (0, cols - int(row.shape[0])))
+            full = full.at[int(r)].set(row[:cols])
+        self._mark_adopt("scrub", (lanes, cols))
+        if self._use_pallas_scrub(lanes):
+            try:
+                with self.obs.stage("kernel_dispatch", "tpu"):
+                    out = self._scrub_pallas()(
+                        full, dl, de, self._K_enc, self.params.rs_data,
+                    )
+                self.last_submit_variant = "pallas"
+                return out, full
+            except Exception as e:
+                self._note_fused_failure(e)
+        with self.obs.stage("kernel_dispatch", "tpu"):
+            out = self._scrub_jit(
+                full, dl, de, self._K_enc, self.params.rs_data,
+            )
+        self.last_submit_variant = "xla"
+        return out, full
+
+    def pool_adopt(self, input_ref, lane: int, length: int,
+                   page_bytes: int):
+        """Slice one verified lane of a resident-submitted batch into
+        fixed-size device pages (tail zero-padded past the ragged
+        length) — device-side slicing of an already-resident array,
+        ZERO link bytes."""
+        npages = max(1, -(-int(length) // int(page_bytes)))
+        total = npages * int(page_bytes)
+        row = input_ref[int(lane)]
+        if int(row.shape[0]) < total:
+            row = jnp.pad(row, (0, total - int(row.shape[0])))
+        pages = row[:total].reshape(npages, int(page_bytes))
+        return [pages[i] for i in range(npages)]
+
+    def pool_read(self, pages, length: int) -> bytes:
+        """D2H readback of a pooled block (tests/debug only), trimmed
+        to the ragged tail."""
+        return np.concatenate(
+            [np.asarray(p) for p in pages])[:int(length)].tobytes()
+
     def scrub_encode_batch(self, blocks: Sequence[bytes], hashes: Sequence[Hash],
                            fetch_parity: bool = True):
         """Synchronous fused verify+encode.  Contract shared with

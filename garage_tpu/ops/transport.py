@@ -42,6 +42,13 @@ One DeviceTransport per device codec owns ALL host↔device movement:
     through the full stage→submit→collect path, so the hybrid gate
     decides on the rate this transport actually delivers instead of
     the retired serialize+copy path's.
+  - **Device-resident pool.**  When a DevicePool is attached
+    (ops/device_pool.py), scrub staging consults it first: resident
+    blocks ship as device page references and move ZERO link bytes
+    (``pool_hit_bytes_total``), misses stage through the slot path and
+    their verified lanes are adopted into the pool at collect
+    (``pool_miss_bytes_total``); ``prefetch`` stages the scrub
+    worker's next range ahead of need as background-class work.
 
 Failure containment: a device failure never fails the caller — the
 affected batch is recomputed inline on the CPU fallback codec
@@ -100,17 +107,27 @@ class TransportClosed(RuntimeError):
     to the inline (CPU) dispatch path."""
 
 
+def _swallow_result(future: Future) -> None:
+    """Prefetch futures exist only to drive adoption; a failed
+    prefetch is a lost optimization, not an error (the real scrub
+    batch will stage the blocks itself)."""
+    try:
+        future.exception()
+    except Exception:  # noqa: BLE001 — cancelled futures included
+        pass
+
+
 class TransportItem:
     """One submission as the transport sees it — the CodecFeeder's _Item
     satisfies this protocol (payload/blocks/nbytes/future/cls/deadline);
     direct users (tests, the probe) build TransportItems."""
 
     __slots__ = ("kind", "payload", "blocks", "nbytes", "future", "cls",
-                 "deadline", "want_parity")
+                 "deadline", "want_parity", "prefetch")
 
     def __init__(self, kind: str, payload, blocks: int, nbytes: int,
                  cls: str = "fg", deadline: Optional[float] = None,
-                 want_parity: bool = True):
+                 want_parity: bool = True, prefetch: bool = False):
         self.kind = kind
         self.payload = payload
         self.blocks = blocks
@@ -118,6 +135,9 @@ class TransportItem:
         self.cls = cls
         self.deadline = deadline
         self.want_parity = want_parity
+        # pool warm-up submission (DevicePool prefetch): results are
+        # discarded, staging is attributed to pool_prefetch_bytes_total
+        self.prefetch = prefetch
         self.future: Future = Future()
 
 
@@ -205,7 +225,9 @@ class _Batch:
     __slots__ = ("kind", "parts", "nbytes", "blocks", "eff_deadline",
                  "cls", "want_parity", "ts", "staged_est",
                  "t_enq", "t_pop", "t_stage0", "t_stage1", "t_adopt1",
-                 "t_submit1", "t_ready", "compiled")
+                 "t_submit1", "t_ready", "compiled",
+                 "pool_resident", "pool_adopt", "staged_payload",
+                 "prefetch")
 
     def __init__(self, kind: str, cls: str):
         self.kind = kind
@@ -232,6 +254,15 @@ class _Batch:
         self.t_submit1 = 0
         self.t_ready = 0
         self.compiled = False  # did this dispatch trigger an XLA compile
+        # DevicePool bookkeeping (None = staged the legacy, pool-less
+        # way): resident lanes composed device-side, miss lanes to
+        # adopt at collect, and the bytes that actually crossed the
+        # link (what transport_staged_bytes_total must count — pool
+        # hits move zero)
+        self.pool_resident: Optional[list] = None
+        self.pool_adopt: Optional[list] = None
+        self.staged_payload: Optional[int] = None
+        self.prefetch = False
 
 
 class DeviceTransport:
@@ -248,13 +279,18 @@ class DeviceTransport:
     _PROBE_LANE_BYTES = 128 << 10  # probe splits into 128 KiB lanes
 
     def __init__(self, device, params, fallback=None, observer=None,
-                 metrics=None, clock: Callable[[], float] = time.monotonic):
+                 metrics=None, clock: Callable[[], float] = time.monotonic,
+                 pool=None):
         """device: the array-level device codec (TpuCodec / synthetic).
         params: CodecParams (staging budget + transport tunables).
-        fallback: a CPU BlockCodec absorbing failed batches inline."""
+        fallback: a CPU BlockCodec absorbing failed batches inline.
+        pool: an ops.device_pool.DevicePool consulted while staging
+        scrub batches (None = legacy staging, byte-identical to the
+        pre-pool transport)."""
         self.device = device
         self.params = params
         self.fallback = fallback
+        self.pool = pool
         self.clock = clock
         if observer is None:
             from .observer import CodecObserver
@@ -525,6 +561,7 @@ class DeviceTransport:
 
         for it in items:
             cls = getattr(it, "cls", "fg") or "fg"
+            pf = bool(getattr(it, "prefetch", False))
             wp = bool(getattr(it, "want_parity", want_parity))
             pieces = self._cut_points(kind, it, k)
             sink = _Assembler(it, len(pieces))
@@ -539,6 +576,11 @@ class DeviceTransport:
                 est = est_with(pl, ml) if kind != "decode" else nb
                 if cur is not None and (
                         cur.cls != cls
+                        # a prefetch item must not coalesce with real
+                        # scrub work: the batch-level flag routes byte
+                        # attribution (pool_prefetch_bytes vs hit/miss),
+                        # and a mixed batch would misattribute one side
+                        or cur.prefetch != pf
                         or (kind == "decode"
                             and cur.staged_est + est > self.chunk_bytes)
                         or (kind != "decode"
@@ -548,6 +590,7 @@ class DeviceTransport:
                     est = est_with(pl, ml) if kind != "decode" else nb
                 if cur is None:
                     cur = _Batch(kind, cls)
+                    cur.prefetch = pf
                 cur.parts.append(
                     _Part(it, lo, hi, idx, len(pieces), sink))
                 cur.nbytes += nb
@@ -723,7 +766,11 @@ class DeviceTransport:
             track = f"slot{slot}"
             tl.event(f"stage {batch.kind}", track, batch.t_stage0,
                      batch.t_stage1, cat="transport", cls=batch.cls,
-                     blocks=batch.blocks, staged_est=batch.staged_est)
+                     blocks=batch.blocks, staged_est=batch.staged_est,
+                     prefetch=batch.prefetch,
+                     pool_hits=(len(batch.pool_resident)
+                                if batch.pool_resident is not None
+                                else None))
             tl.event(f"adopt {batch.kind}", track, batch.t_stage1,
                      batch.t_adopt1, cat="transport")
             tl.event(f"submit {batch.kind}", track, batch.t_adopt1,
@@ -742,7 +789,12 @@ class DeviceTransport:
                        batches=len(self._inflight))
             self.dispatches += 1
             if self.m_staged is not None:
-                self.m_staged.inc(batch.nbytes, copies="1")
+                # pool-aware staging reports the bytes that actually
+                # crossed the link (miss lanes only) — a full pool hit
+                # keeps transport_staged_bytes_total flat by contract
+                self.m_staged.inc(
+                    batch.nbytes if batch.staged_payload is None
+                    else batch.staged_payload, copies="1")
         except BaseException as e:  # noqa: BLE001 — device down ≠ caller down
             self._device_failed("submit", e)
             # absorb BEFORE releasing the slot: the hash fallback reads
@@ -795,7 +847,8 @@ class DeviceTransport:
             # device-busy window: dispatch return → results ready (the
             # block_until_ready delta, observed inside _collect)
             tl.event(f"compute {batch.kind}", track, batch.t_submit1,
-                     batch.t_ready, cat="transport")
+                     batch.t_ready, cat="transport",
+                     prefetch=batch.prefetch)
         tl.event(f"collect {batch.kind}", track, batch.t_ready or t_c0,
                  t_c1, cat="transport", blocks=batch.blocks)
         if batch.t_stage0:
@@ -958,6 +1011,8 @@ class DeviceTransport:
             if lanes > len(flat):
                 arr[len(flat):] = 0
             return arr, lengths, spans
+        if kind == "scrub" and self.pool is not None:
+            return self._stage_scrub_pooled(batch, slot, k)
         if kind in ("scrub", "encode"):
             # entries lane-pad to k so every part starts a fresh
             # codeword (pad lanes: zero data — and, for scrub, the
@@ -1026,6 +1081,75 @@ class DeviceTransport:
                           list(rws) if rws is not None else None, spans))
         return plans
 
+    def _stage_scrub_pooled(self, batch: _Batch, slot: int, k: int):
+        """Pool-aware scrub staging: the batch keeps its FULL lane
+        geometry (k-aligned parts, lane-indexed spans/lengths/expected
+        — so parity grouping and collect-side slicing are unchanged),
+        but only MISS lanes pay the host copy, written compactly into
+        the slot's first rows; resident lanes ship as device page
+        references (zero link bytes).  Returns
+        (miss_arr, miss_rows, lengths, expected, spans) for
+        scrub_encode_submit_resident."""
+        pool = self.pool
+        lane = 0
+        spans = []
+        entries = []  # (lane, block, hash) in batch order
+        for p in batch.parts:
+            b, h = p.item.payload
+            hs = h[p.lo:p.hi]
+            bs = b[p.lo:p.hi]
+            spans.append((lane, len(bs)))
+            for i in range(len(bs)):
+                entries.append((lane + i, bs[i], hs[i]))
+            lane += len(bs) + ((-len(bs)) % k)
+        maxlen = max((len(b) for _r, b, _h in entries), default=0)
+        lanes, cols = self._geometry(lane, maxlen, "scrub")
+        arr = self._slot_view(slot, lanes, cols)
+        lengths = np.zeros((lanes,), dtype=np.int32)
+        expected = np.broadcast_to(
+            _empty_digest_words(), (lanes, 8)).astype(np.uint32)
+        resident: list = []   # (lane, pages, length) composed on device
+        adopt: list = []      # (lane, key, length) adopted at collect
+        miss_rows: List[int] = []
+        hit_bytes = miss_bytes = 0
+        ci = 0
+        for r, blk, hh in entries:
+            n = len(blk)
+            lengths[r] = n
+            expected[r] = np.frombuffer(bytes(hh), dtype="<u4")
+            entry = pool.lookup(bytes(hh), n)
+            if entry is not None:
+                resident.append((r, entry.pages, n))
+                hit_bytes += n
+                continue
+            # THE host copy, miss lanes only (tail zeroed: the slot
+            # buffer is reused and the device pads pages from it)
+            if n:
+                arr[ci, :n] = np.frombuffer(blk, dtype=np.uint8)
+            if n < cols:
+                arr[ci, n:] = 0
+            miss_rows.append(r)
+            adopt.append((r, bytes(hh), n))
+            miss_bytes += n
+            ci += 1
+        self.staged_copies += ci
+        self.staged_blocks += ci
+        self.staged_bytes += miss_bytes
+        # byte attribution: every scrubbed byte is a hit or a miss; a
+        # prefetch batch's staging lands in its own family so the
+        # hit+miss sum stays exactly the bytes the scrub asked for
+        if not batch.prefetch:
+            if hit_bytes:
+                pool.note_hit(hit_bytes)
+            if miss_bytes:
+                pool.note_miss(miss_bytes)
+        elif miss_bytes:
+            pool.note_miss(miss_bytes, prefetch=True)
+        batch.pool_resident = resident
+        batch.pool_adopt = adopt
+        batch.staged_payload = miss_bytes
+        return arr[:ci], miss_rows, lengths, expected, spans
+
     # --- device dispatch / collect ------------------------------------------
 
     def _submit(self, batch: _Batch, staged):
@@ -1035,6 +1159,11 @@ class DeviceTransport:
             arr, lengths, spans = staged
             return dev.hash_submit(arr, lengths), spans
         if kind == "scrub":
+            if batch.pool_adopt is not None:
+                miss_arr, miss_rows, lengths, expected, spans = staged
+                return dev.scrub_encode_submit_resident(
+                    miss_arr, miss_rows, lengths, expected,
+                    batch.pool_resident), spans
             arr, lengths, expected, spans = staged
             return dev.scrub_encode_submit(arr, lengths, expected), spans
         if kind == "encode":
@@ -1053,7 +1182,28 @@ class DeviceTransport:
             return [digs[o:o + n] for o, n in spans]
         if kind == "scrub":
             out, spans = handle
+            input_ref = None
+            if batch.pool_adopt is not None:
+                # resident submissions return (handle, composed device
+                # input) — the input ref is the adoption source
+                out, input_ref = out
             ok, parity = dev.scrub_collect(out, batch.want_parity)
+            pool = self.pool
+            if (pool is not None and batch.pool_adopt
+                    and input_ref is not None):
+                # adopt VERIFIED miss lanes only: a lane that failed
+                # its hash check must never become a servable page
+                page = pool.page_bytes
+                for r, key, n in batch.pool_adopt:
+                    if not bool(ok[r]):
+                        continue
+                    try:
+                        pages = dev.pool_adopt(input_ref, r, n, page)
+                    except Exception:  # noqa: BLE001 — adoption is best-effort
+                        logger.warning("pool adoption failed",
+                                       exc_info=True)
+                        break
+                    pool.adopt(key, pages, n)
             k = max(1, self.params.rs_data)
             results = []
             for part, (o, n) in zip(batch.parts, spans):
@@ -1173,6 +1323,61 @@ class DeviceTransport:
                            exc_info=True)
             return False
 
+    # --- pool prefetch / residency ------------------------------------------
+
+    def prefetch(self, blocks: Sequence[bytes],
+                 hashes: Sequence[Hash]) -> int:
+        """Stage the upcoming scrub range into the device pool as
+        BACKGROUND-class work: the scrub worker hints the next read-
+        ahead batch and its non-resident blocks ride the staging
+        double buffer (under the governor's demotion slack) while the
+        current batch computes — so by the time the real scrub batch
+        arrives, its lanes are pool hits.  Results are discarded; the
+        staging is attributed to pool_prefetch_bytes_total.  Returns
+        the bytes enqueued (0 = already resident / pool or prefetch
+        disabled / transport closed)."""
+        pool = self.pool
+        if (pool is None or not pool.prefetch_enabled or self._closed
+                or not self.supports("scrub")):
+            return 0
+        todo_b: List[bytes] = []
+        todo_h: List[Hash] = []
+        for b, h in zip(blocks, hashes):
+            if not pool.contains(bytes(h)):
+                todo_b.append(b)
+                todo_h.append(h)
+        if not todo_b:
+            return 0
+        nbytes = int(sum(len(b) for b in todo_b))
+        it = TransportItem("scrub", (todo_b, todo_h), len(todo_b),
+                           nbytes, cls="bg", want_parity=False,
+                           prefetch=True)
+        it.future.add_done_callback(_swallow_result)
+        try:
+            self.submit_items("scrub", [it], want_parity=False)
+        except TransportClosed:
+            return 0
+        self.obs.timeline.event(
+            "pool_prefetch", "edf", time.monotonic_ns(),
+            cat="transport", blocks=len(todo_b), nbytes=nbytes)
+        return nbytes
+
+    def pool_covers(self, items: Sequence) -> bool:
+        """Would the pool serve every block of these scrub items with
+        zero link bytes?  The feeder's gate-refresh short-circuit: a
+        fully-resident background batch needs no link probe because it
+        will not touch the link."""
+        pool = self.pool
+        if pool is None:
+            return False
+        keys: List[bytes] = []
+        for it in items:
+            if getattr(it, "kind", None) != "scrub":
+                return False
+            _b, hs = it.payload
+            keys.extend(bytes(h) for h in hs)
+        return pool.contains_all(keys)
+
     # --- the gate's probe ---------------------------------------------------
 
     def probe_link(self, nbytes: int) -> float:
@@ -1275,6 +1480,8 @@ class DeviceTransport:
                     / self.budget_bytes, 6),
                 "stages": self.profiler.summary(),
                 "probe_stages": self.last_probe_stages,
+                "pool": (self.pool.stats() if self.pool is not None
+                         else None),
             }
 
     def shutdown(self, timeout: float = 15.0) -> None:

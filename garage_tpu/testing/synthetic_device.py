@@ -265,12 +265,12 @@ class SyntheticLinkCodec:
         self._mark_ready(ready)
         return digs[:n]
 
-    def scrub_encode_submit(self, arr: np.ndarray, lengths: np.ndarray,
-                            expected: np.ndarray):
-        self.array_submissions += 1
-        self.bytes_submitted += int(lengths.sum())
-        self._mark_adopt("scrub", arr.shape)
-        ready = self._link_ready_at(int(lengths.sum()))
+    def _scrub_math(self, arr: np.ndarray, lengths: np.ndarray,
+                    expected: np.ndarray, ready: float):
+        """The fused scrub kernel body (real CpuCodec math): verify
+        EVERY lane against its expected digest — pool-served lanes
+        included, which is what makes every pool read hash-verified —
+        plus RS parity per k-lane codeword."""
         codec = self._codec()
         digs = codec.batch_hash(self._rows_bytes(arr, lengths))
         ok = np.array(
@@ -285,11 +285,70 @@ class SyntheticLinkCodec:
         return None, _Lazy(ok, ready), int((~ok).sum()), \
             (_Lazy(parity, ready) if parity is not None else None)
 
+    def scrub_encode_submit(self, arr: np.ndarray, lengths: np.ndarray,
+                            expected: np.ndarray):
+        self.array_submissions += 1
+        self.bytes_submitted += int(lengths.sum())
+        self._mark_adopt("scrub", arr.shape)
+        ready = self._link_ready_at(int(lengths.sum()))
+        return self._scrub_math(arr, lengths, expected, ready)
+
     def scrub_collect(self, out, fetch_parity: bool):
         _h, ok, _bad, parity = out
         self._mark_ready(ok.ready)
         return np.asarray(ok), (np.asarray(parity) if fetch_parity
                                 and parity is not None else None)
+
+    # --- the DevicePool API (ops/device_pool.py) ---
+    #
+    # Pool-aware scrub: only MISS lanes cross the modeled link (the
+    # link sleep charges their lengths alone — a warm batch of all
+    # hits pays zero link time, which is exactly the speedup the A/B
+    # bench measures); resident lanes are composed from pool pages
+    # device-side.  The full composed batch then runs the SAME fused
+    # kernel as the plain path, so pool-served lanes are re-verified
+    # against their expected digests on every read.
+
+    def scrub_encode_submit_resident(self, miss_arr: np.ndarray,
+                                     miss_rows, lengths: np.ndarray,
+                                     expected: np.ndarray, resident):
+        lanes = int(lengths.shape[0])
+        cols = int(miss_arr.shape[1])
+        miss_bytes = int(sum(int(lengths[r]) for r in miss_rows))
+        self.array_submissions += 1
+        self.bytes_submitted += miss_bytes
+        self._mark_adopt("scrub", (lanes, cols))
+        ready = self._link_ready_at(miss_bytes)
+        # device-side composition: zeros (gap/pad lanes verify against
+        # the empty digest), scattered miss uploads, pool-page lanes
+        full = np.zeros((lanes, cols), dtype=np.uint8)
+        for ci, r in enumerate(miss_rows):
+            full[r] = miss_arr[ci]
+        for r, pages, length in resident:
+            row = np.concatenate([np.asarray(p) for p in pages])[:length]
+            full[int(r), :int(length)] = row
+        return self._scrub_math(full, lengths, expected, ready), full
+
+    def pool_adopt(self, input_ref, lane: int, length: int,
+                   page_bytes: int):
+        """Slice one verified lane of a resident-submitted batch into
+        fixed-size device pages (tail zero-padded) — a device-side
+        copy, ZERO link bytes, so adoption never shows up on the
+        transport's staging meter."""
+        full = input_ref
+        assert full is not None, "adoption needs a resident-path input"
+        npages = max(1, -(-int(length) // int(page_bytes)))
+        buf = np.zeros((npages * int(page_bytes),), dtype=np.uint8)
+        buf[:int(length)] = full[int(lane), :int(length)]
+        return [buf[i * int(page_bytes):(i + 1) * int(page_bytes)].copy()
+                for i in range(npages)]
+
+    def pool_read(self, pages, length: int) -> bytes:
+        """D2H readback of a pooled block (tests/smoke only — the data
+        path never reads pages back to the host), trimmed to the
+        ragged tail."""
+        return np.concatenate(
+            [np.asarray(p) for p in pages])[:int(length)].tobytes()
 
     def encode_submit(self, groups: np.ndarray):
         self.array_submissions += 1

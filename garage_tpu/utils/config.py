@@ -143,6 +143,17 @@ class CodecConfig:
     transport: bool = _CODEC_DEFAULTS.transport
     transport_staging_slots: int = _CODEC_DEFAULTS.transport_staging_slots
     transport_bg_slack_ms: float = _CODEC_DEFAULTS.transport_bg_slack_ms
+    # --- device-resident block pool (ops/device_pool.py): bounded
+    # fixed-size device pages keyed by block hash, consulted by the
+    # transport before staging — a warm re-scrub of a resident working
+    # set moves zero link bytes.  Budgeted SEPARATELY from
+    # max_device_staging_mib (staging bounds bytes in flight; the pool
+    # bounds bytes at rest).  pool_mib=0 disables (staging then
+    # behaves byte-identically to the pre-pool transport);
+    # pool_prefetch gates the scrub worker's next-range hint.
+    pool_mib: int = _CODEC_DEFAULTS.pool_mib
+    pool_page_kib: int = _CODEC_DEFAULTS.pool_page_kib
+    pool_prefetch: bool = _CODEC_DEFAULTS.pool_prefetch
     # --- repair-bandwidth-optimal degraded reads (block/repair_plan.py):
     # exact-k survivor selection ranked by RTT EWMA / breaker state /
     # zone locality, hedged ranked replacements, and partial-parallel
@@ -186,6 +197,9 @@ class CodecConfig:
             transport=self.transport,
             transport_staging_slots=self.transport_staging_slots,
             transport_bg_slack_ms=self.transport_bg_slack_ms,
+            pool_mib=self.pool_mib,
+            pool_page_kib=self.pool_page_kib,
+            pool_prefetch=self.pool_prefetch,
         )
 
 
@@ -616,6 +630,10 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         raise ConfigError("codec.transport_staging_slots must be >= 1")
     if cfg.codec.transport_bg_slack_ms < 0:
         raise ConfigError("codec.transport_bg_slack_ms must be >= 0")
+    if cfg.codec.pool_mib < 0:
+        raise ConfigError("codec.pool_mib must be >= 0 (0 disables the pool)")
+    if cfg.codec.pool_page_kib < 1:
+        raise ConfigError("codec.pool_page_kib must be >= 1")
 
     # secrets: env overrides > `<key>_file` in TOML > inline value
     for key, env in _SECRET_ENV.items():

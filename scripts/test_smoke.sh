@@ -26,6 +26,12 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/metadata_smoke.py
 # scrub and foreground verifies must share one feeder queue, and the
 # live transport_* metric families must pass the strict lint
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/transport_smoke.py
+# device-resident block pool smoke (ISSUE 18): scrubbing the SAME range
+# twice through the feeder+transport must move (near-)zero link bytes on
+# the warm pass (transport_staged_bytes_total delta == 0), attribute
+# every scrubbed byte across pool_hit/pool_miss, stay bit-identical to
+# the serial CPU path, and render the pool_* families lint-clean
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/pool_smoke.py
 # link microprofiler smoke (ISSUE 16): the controlled sweep on the
 # synthetic backend must emit a well-formed attribution block whose
 # per-cell stage breakdowns hold the exact-sum invariant LIVE, and the
