@@ -864,9 +864,18 @@ def _phase_critical_path(garages, prefix: str) -> dict:
 class _S3:
     """Minimal SigV4 client against the in-process server."""
 
-    def __init__(self, session, port, kid, secret):
+    def __init__(self, session, port, kid, secret,
+                 honor_retry_after=False, retry_after_cap=2.0):
         self.session, self.port, self.kid, self.secret = (
             session, port, kid, secret)
+        # opt-in 503 Retry-After honoring (clamped): a production-shaped
+        # client pauses before its NEXT request instead of hammering a
+        # shedding gateway.  Off by default — the overload/noisy drills
+        # calibrate their offered load with a fixed post-shed backoff
+        # and must keep it, or "4x capacity" stops meaning 4x.
+        self.honor_retry_after = honor_retry_after
+        self.retry_after_cap = retry_after_cap
+        self._backoff_until = 0.0
 
     async def req(self, method, path, body=b"", query=()):
         import aiohttp  # noqa: F401
@@ -874,6 +883,10 @@ class _S3:
 
         from garage_tpu.api.signature import sign_request, uri_encode
 
+        if self.honor_retry_after:
+            wait = self._backoff_until - time.monotonic()
+            if wait > 0:
+                await asyncio.sleep(min(wait, self.retry_after_cap))
         headers = {"host": f"127.0.0.1:{self.port}"}
         headers.update(sign_request(
             self.kid, self.secret, "garage", method, path, list(query),
@@ -888,7 +901,15 @@ class _S3:
         async with self.session.request(
             method, url, data=body, headers=headers,
         ) as r:
-            return r.status, await r.read(), r.headers
+            rb = await r.read()
+            if r.status == 503 and self.honor_retry_after:
+                try:
+                    ra = float(r.headers.get("Retry-After", 1))
+                except (TypeError, ValueError):
+                    ra = 1.0
+                self._backoff_until = time.monotonic() + min(
+                    max(ra, 0.0), self.retry_after_cap)
+            return r.status, rb, r.headers
 
 
 async def _put_phase_async(n=3, repl="3", prefix="put") -> dict:
@@ -2525,6 +2546,251 @@ async def _metadata_phase_async() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# --- trace-driven workload replay over the geo-WAN matrix (ISSUE 19) ------
+
+REPLAY_SECS = 12.0
+
+
+async def _replay_phase_async() -> dict:
+    """Production-shaped survival: a seeded deterministic workload
+    trace (Zipf keys, size mixture, diurnal pacing — testing/replay.py)
+    replayed through a 2-gateway GatewayPool over the WAN_3ZONE_RTT
+    latency matrix, with one gateway KILLED mid-window.  Asserts the
+    trace is reproducible (same seed ⇒ same signature), zero client
+    errors / zero acked-data loss through the kill (pool failover), and
+    embeds the merged SLO report with availability budgets intact on
+    the survivors."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    from garage_tpu.testing.faults import FAST_CHAOS_HEALTH
+    from garage_tpu.testing.gateway_pool import GatewayPool
+    from garage_tpu.testing.replay import (
+        ReplayConfig,
+        Replayer,
+        generate_ops,
+        trace_signature,
+    )
+    from garage_tpu.testing.sim_cluster import SimCluster
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="garage_tpu_bench_rply_"))
+    cluster = SimCluster(tmp, n_storage=6, n_zones=3, repl="3",
+                         zone_redundancy="maximum", n_gateways=2,
+                         extra_cfg={"health": dict(FAST_CHAOS_HEALTH)})
+    try:
+        await cluster.start()
+        cluster.apply_wan()
+        await cluster.tick(rounds=3)
+        cfg = ReplayConfig(seed=19, n_keys=64, base_ops_per_s=12.0,
+                           duration_s=REPLAY_SECS, size_preset="small")
+        sig = trace_signature(generate_ops(cfg))
+        out = {
+            "replay_trace_signature": sig,
+            "replay_deterministic": sig == trace_signature(
+                generate_ops(cfg)),
+        }
+        async with aiohttp.ClientSession() as session:
+            pool = GatewayPool(
+                session, cluster.gateway_endpoints(), cluster.key_id,
+                cluster.secret,
+                metrics=cluster.garages[0].system.metrics)
+            st, _b, _h = await pool.request("PUT", f"/{cfg.bucket}")
+            assert st == 200, st
+            rp = Replayer(cfg, pool)
+            kill_at = len(rp.ops) // 2
+            killed = [False]
+
+            async def on_op(i: int, _at: float) -> None:
+                if i == kill_at and not killed[0]:
+                    killed[0] = True
+                    await cluster.kill_gateway(1)
+
+            stats = await rp.run(on_op=on_op)
+            bad = await rp.verify_all()
+        out.update({
+            "replay_ops": len(rp.ops),
+            "replay_kill_index": kill_at,
+            "replay_gateway_killed": killed[0],
+            "replay_stats": stats.summary(),
+            "replay_verify_mismatches": bad,
+            "replay_pool": dict(pool.counters),
+            # the kill must INTERSECT live traffic (round-robin spread),
+            # not merely remove an idle sibling
+            "replay_failover_exercised": pool.counters["failovers"] >= 1,
+        })
+        slo = _phase_slo_report(cluster.garages, "replay")
+        out.update(slo)
+        spent = [ep["availability"]["budget_spent"] for ep in
+                 slo.get("replay_slo_report", {})
+                 .get("endpoints", {}).values()]
+        out["replay_availability_budget_ok"] = all(
+            s < 1.0 for s in spent)
+        assert out["replay_deterministic"], out
+        assert killed[0], "the mid-window kill never fired"
+        assert out["replay_failover_exercised"], dict(pool.counters)
+        assert stats.errors == 0, stats.error_notes
+        assert bad == 0, f"{bad} acked objects lost"
+        assert out["replay_availability_budget_ok"], out
+        await cluster.stop()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# --- rebalance-throughput sweep vs the client-latency budget ---------------
+
+# the low rate sits BELOW the mover's effective per-push throughput
+# ceiling (background-priority pushes on a loaded wire run ~2 MiB/s
+# here), so pacing visibly binds at one end of the sweep and the knob's
+# effect on drain time + client p99 is measurable, not theoretical
+REBALANCE_RATES_MIB = (1.0, 64.0)
+REBALANCE_BUDGET_P99_MS = 500.0
+REBALANCE_OBJS = 64
+REBALANCE_OBJ_KIB = 512
+
+
+async def _rebalance_one(rate: float) -> dict:
+    """One sweep point: drain a whole zone at `rate` MiB/s mover budget
+    while sampling client GET latency; report mover throughput, the
+    governor's minimum background ratio, and whether the client p99
+    held the fixed budget."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    from garage_tpu.testing.sim_cluster import SimCluster, p99
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="garage_tpu_bench_rbl_"))
+    # default zone redundancy on purpose: "maximum" sends the
+    # assignment solver into minutes of negative-cycle canceling for a
+    # drain of this shape, and the sweep measures the MOVER, not the
+    # solver
+    cluster = SimCluster(tmp, n_storage=6, n_zones=3, repl="3",
+                         rebalance_rate_mib=rate)
+    try:
+        await cluster.start(faults=False)
+        rng = np.random.default_rng(int(rate))
+        out: dict = {"rate_mib": rate, "errors": 0}
+        async with aiohttp.ClientSession() as session:
+            s3 = _S3(session, cluster.port, cluster.key_id,
+                     cluster.secret, honor_retry_after=True,
+                     retry_after_cap=0.5)
+            # solve the post-drain layout NOW, while the cluster is
+            # idle: the assignment solve holds the GIL for tens of
+            # seconds, and run mid-traffic it stalls every node in
+            # this single-process sim — conns drop, breakers trip, and
+            # the movers' first pushes fail into the resync queue
+            # before sampling even starts.  Real drains work the same
+            # way: the operator solves offline, the cluster only ever
+            # sees the committed result.
+            drained = cluster.injector.nodes_in_zone("z3")
+
+            def mutate(lay):
+                for i in drained:
+                    lay.stage_role(
+                        bytes(cluster.garages[i].system.id), None)
+
+            enc = await cluster.precompute_layout_change(mutate)
+
+            st, _b, _h = await s3.req("PUT", "/rbl")
+            assert st == 200, st
+            bodies = {}
+            for i in range(REBALANCE_OBJS):
+                body = rng.integers(0, 256, REBALANCE_OBJ_KIB << 10,
+                                    dtype=np.uint8).tobytes()
+                st, _b, _h = await s3.req("PUT", f"/rbl/o{i:04d}", body)
+                assert st == 200, st
+                bodies[f"o{i:04d}"] = body
+
+            # quiet the UNPACED resync queue (the refs-only layout sweep
+            # feeds it): left at default tranquility it races the mover
+            # for the same hashes and the rate knob washes out of the
+            # sweep — here the paced mover must carry the drain
+            for i in cluster.storage_indices():
+                cluster.garages[i].block_resync.set_tranquility(30)
+            # ALL storage movers: the drained zone's movers PUSH what
+            # they lose, the remaining zones' movers FETCH what they gain
+            movers = [cluster.garages[i].rebalance_mover
+                      for i in cluster.storage_indices()]
+            lats: list = []
+            ratio_min = 1.0
+            t0 = time.perf_counter()
+            # the pre-solved layout lands instantly — sampling starts
+            # with the mesh healthy and the movers freshly fed
+            await cluster.apply_encoded_layout(enc)
+            deadline = t0 + 120.0
+            names = sorted(bodies)
+            k = 0
+            while time.perf_counter() < deadline:
+                name = names[k % len(names)]
+                k += 1
+                tg = time.perf_counter()
+                st, got, _h = await s3.req("GET", f"/rbl/{name}")
+                lats.append(time.perf_counter() - tg)
+                if st != 200 or got != bodies[name]:
+                    out["errors"] += 1
+                ratio_min = min(ratio_min, min(
+                    cluster.garages[i].governor.ratio()
+                    for i in cluster.storage_indices()
+                    if i not in drained))
+                if all(m.idle() for m in movers):
+                    break
+                await asyncio.sleep(0.05)
+            drain_s = time.perf_counter() - t0
+            moved = sum(m.bytes_moved for m in movers)
+            out.update({
+                "drain_s": round(drain_s, 2),
+                "moved_mib": round(moved / 2**20, 1),
+                "mover_mib_s": round(moved / drain_s / 2**20, 1),
+                "governor_ratio_min": round(ratio_min, 3),
+                "get_p99_ms": round(p99(lats) * 1000, 2),
+                "get_ops": len(lats),
+                "rebalance_complete": all(
+                    m.idle() and m.partitions_done == m.partitions_total
+                    for m in movers),
+            })
+            out["budget_ok"] = (
+                out["get_p99_ms"] <= REBALANCE_BUDGET_P99_MS)
+            # every seeded object still bit-identical post-drain
+            bad = 0
+            for name, body in sorted(bodies.items()):
+                st, got, _h = await s3.req("GET", f"/rbl/{name}")
+                if st != 200 or got != body:
+                    bad += 1
+            out["verify_mismatches"] = bad
+        await cluster.stop()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+async def _rebalance_phase_async() -> dict:
+    """Sweep rebalance_rate_mib against the governor under a fixed
+    client-latency budget: for each rate, a fresh 6-node/3-zone cluster
+    drains one zone under live GET sampling.  The sweep names which
+    mover budgets respect the client p99 budget — the operator's
+    rebalance-rate picking table (docs/ROBUSTNESS.md)."""
+    sweep = []
+    for rate in REBALANCE_RATES_MIB:
+        sweep.append(await _rebalance_one(rate))
+    out = {
+        "rebalance_budget_p99_ms": REBALANCE_BUDGET_P99_MS,
+        "rebalance_sweep": sweep,
+        "rebalance_budget_rates": [
+            s["rate_mib"] for s in sweep if s["budget_ok"]],
+    }
+    for s in sweep:
+        assert s["rebalance_complete"], s
+        assert s["moved_mib"] > 0, s  # a zero-byte sweep measured nothing
+        assert s["errors"] == 0 and s["verify_mismatches"] == 0, s
+    return out
+
+
 _PHASES = {
     "--put-phase": _put_phase_async,
     "--put-solo-phase": _put_solo_phase_async,
@@ -2538,6 +2804,8 @@ _PHASES = {
     "--tenants-phase": _tenants_phase_async,
     "--transport-phase": _transport_phase_async,
     "--pool-phase": _pool_phase_async,
+    "--replay-phase": _replay_phase_async,
+    "--rebalance-phase": _rebalance_phase_async,
     "--metadata-phase": _metadata_phase_async,
 }
 
@@ -3186,6 +3454,13 @@ def main() -> None:
     out.update(run_phase_subprocess("--pool-phase"))
     emit()
     out.update(run_phase_subprocess("--wan-phase"))
+    emit()
+    # production-shaped survival (ISSUE 19): deterministic trace replay
+    # over the geo-WAN matrix with a mid-window gateway kill, then the
+    # rebalance-rate sweep against the client-latency budget
+    out.update(run_phase_subprocess("--replay-phase", timeout=900))
+    emit()
+    out.update(run_phase_subprocess("--rebalance-phase", timeout=900))
     emit()
     # metadata plane at 1M objects: load + live batched-Merkle drain +
     # listing/sync A/B — the longest cluster phase, so it runs after

@@ -31,6 +31,7 @@ from ..common import (
     BucketAlreadyExistsError,
     BucketNotEmptyError,
     NoSuchBucketError,
+    SlowDownError,
     admit_request,
     client_deadline_budget,
     error_response,
@@ -72,6 +73,16 @@ class S3ApiServer:
         self.slo = getattr(garage, "slo", None)
         self.deadline_s = request_deadline_budget(garage.config)
         self._runner: Optional[web.AppRunner] = None
+        # graceful drain (docs/ROBUSTNESS.md "Geo-WAN & gateway
+        # failover"): once draining, NEW requests are shed with a typed
+        # 503 while the in-flight set runs to completion inside a
+        # bounded window; the state rides NodeStatus gossip
+        # (system.drain_state) so sibling gateways absorb load before
+        # this socket closes
+        self._draining = False
+        self._inflight = 0
+        self._idle = asyncio.Event()
+        self._idle.set()
         # metrics (ref generic_server.rs:63-95)
         self.request_counter = 0
         self.error_counter = 0
@@ -96,7 +107,11 @@ class S3ApiServer:
     async def start(self, bind_addr: str) -> None:
         app = web.Application(client_max_size=1024**4)
         app.router.add_route("*", "/{tail:.*}", self.handle_request)
-        self._runner = web.AppRunner(app, access_log=None)
+        # short shutdown_timeout: drain() already waited for the
+        # in-flight set, so cleanup only has idle keep-alives (and an
+        # abrupt kill_gateway must not hang 60 s on aborted conns)
+        self._runner = web.AppRunner(app, access_log=None,
+                                     shutdown_timeout=1.0)
         await self._runner.setup()
         self._site = await start_site(self._runner, bind_addr)
         logger.info("S3 API listening on %s", bind_addr)
@@ -107,11 +122,86 @@ class S3ApiServer:
 
     async def stop(self) -> None:
         if self._runner is not None:
-            await self._runner.cleanup()
+            runner, self._runner = self._runner, None  # drain() then
+            # Server.stop() may both come through here — clean up once
+            await runner.cleanup()
+
+    async def drain(self, timeout: Optional[float] = None) -> float:
+        """Graceful drain: stop admitting (typed 503 shed), publish
+        "draining" via NodeStatus gossip, wait up to `timeout` for the
+        in-flight set to finish, then close the socket and publish
+        "drained".  Returns the observed drain window in seconds.  The
+        SIGTERM path (server.py) and the gateway_failover drill both
+        come through here."""
+        import time as _time
+
+        if timeout is None:
+            timeout = self.garage.config.api.drain_timeout
+        t0 = _time.monotonic()
+        self._draining = True
+        system = self.garage.system
+        system.drain_state = "draining"
+        try:
+            # push the state to siblings NOW — the whole point is that
+            # they learn before the socket goes away
+            await system.advertise_status()
+        except Exception:  # noqa: BLE001 — drain must finish regardless
+            logger.exception("drain: status advertisement failed")
+        try:
+            await asyncio.wait_for(self._idle.wait(), timeout)
+        except asyncio.TimeoutError:
+            logger.warning(
+                "drain: %d requests still in flight after %.1fs window; "
+                "closing anyway", self._inflight, timeout)
+        # handlers are done, but a client-paced download's final bytes
+        # may still sit in user-space transport buffers (the handler
+        # returns as soon as the kernel accepts the writes): closing
+        # now would truncate an already-acked response.  Flush inside
+        # the same window — kernel-buffered bytes survive the graceful
+        # close (FIN sequences after data), user-space ones do not.
+        runner = self._runner
+        if runner is not None and runner.server is not None:
+            deadline = t0 + timeout
+            while _time.monotonic() < deadline:
+                if not any(c.transport is not None
+                           and c.transport.get_write_buffer_size() > 0
+                           for c in runner.server.connections):
+                    break
+                await asyncio.sleep(0.05)
+        await self.stop()
+        system.drain_state = "drained"
+        try:
+            await system.advertise_status()
+        except Exception:  # noqa: BLE001
+            logger.exception("drain: final status advertisement failed")
+        return _time.monotonic() - t0
 
     # --- request handling (ref generic_server.rs:165-266) ---
 
     async def handle_request(self, request: web.Request) -> web.StreamResponse:
+        if self._draining:
+            # typed shed, same surface as an admission reject: XML 503
+            # SlowDown with RequestId + Retry-After, so pool clients
+            # back off and fail over without special-casing drain
+            self.request_counter += 1
+            self.error_counter += 1
+            if self._m is not None:
+                self._m["requests"].inc(api="s3")
+                self._m["errors"].inc(api="s3", status="503")
+            return error_response(
+                SlowDownError("gateway is draining; retry against a "
+                              "sibling", retry_after=1),
+                request.path)
+        self._inflight += 1
+        self._idle.clear()
+        try:
+            return await self._serve(request)
+        finally:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.set()
+
+    async def _serve(self, request: web.Request) -> web.StreamResponse:
         self.request_counter += 1
         if self._m is not None:
             self._m["requests"].inc(api="s3")

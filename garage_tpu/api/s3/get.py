@@ -331,7 +331,16 @@ async def _stream_blocks_range(
         # partially-written response so aiohttp closes out quietly
         logger.debug("client disconnected mid-download: %s", e)
     finally:
+        # runs on clean EOF, client ConnectionError, AND the
+        # CancelledError aiohttp raises into the handler when the
+        # transport dies abruptly (client killed mid-stream, gateway
+        # failover): upstream block pumps stop pumping now, and the
+        # admission slot frees the moment the stream stops — it must
+        # not linger behind a dead connection until outer cleanup
+        # (release is idempotent; api_server's finally releases again)
         for p in all_pumps:
             if not p.task.done():
                 p.task.cancel()
+        if token is not None:
+            token.release()
     return resp

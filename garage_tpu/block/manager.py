@@ -1111,7 +1111,7 @@ class BlockManager:
             f"(delivered {delivered} bytes): {errors}"
         )
 
-    async def need_block(self, h: Hash) -> bool:
+    async def need_block(self, h: Hash, drain: bool = False) -> bool:
         """Do we need a copy of this block? (ring-assigned + rc>0 but no
         local file; the assignment check keeps rc holders outside the
         data ring — possible when data_replication_mode differs — from
@@ -1120,8 +1120,16 @@ class BlockManager:
         with StorageFull only wastes the offerer's bandwidth.  A root
         whose breaker cooldown has elapsed (half-open) answers True —
         the solicited push doubles as the probe write that walks the
-        root back to ok."""
-        return (self.rc.get(h).is_needed()
+        root back to ok.
+
+        ``drain``: the prober is a freshly un-assigned holder whose OWN
+        rc is still live — right after a layout change our refs are as
+        stale as its assignment, so accept on ring assignment alone
+        (the prober's refs vouch for the block; ours arrive with table
+        sync, and a push that outlives its object is ordinary stray GC).
+        Without this, a zone drain's data motion waits on metadata
+        migration instead of riding the paced rebalance mover."""
+        return ((self.rc.get(h).is_needed() or drain)
                 and not self.is_block_present(h)
                 and self.is_assigned(h)
                 and self.health.writable(self.data_layout.primary_dir(h)))
@@ -1276,7 +1284,8 @@ class BlockManager:
             # "present" lets a departing holder learn when every assigned
             # node has a copy, unlocking prompt stray deletion (see
             # resync._resync_block_inner migration branch)
-            return {"needed": await self.need_block(h),
+            return {"needed": await self.need_block(
+                        h, drain=bool(msg.get("drain"))),
                     "present": self.is_block_present(h)}, None
         if t == "ppr":
             # partial-parallel repair: multiply the LOCAL shard by the

@@ -252,7 +252,14 @@ class BlockResyncManager:
 
         unassigned = not mgr.is_assigned(h)
         migrating = rc.is_zero() and present and unassigned
-        if (rc.is_deletable() and present) or migrating:
+        # draining: a layout change un-assigned us but our refs have NOT
+        # migrated off yet (rc still nonzero).  Waiting for the refs
+        # means the drain's data motion rides table-sync timing instead
+        # of the paced mover — push proactively NOW; the local copy
+        # stays until the refs migrate (the migrating/deletable branches
+        # handle deletion later).
+        draining = rc.is_needed() and present and unassigned
+        if (rc.is_deletable() and present) or migrating or draining:
             # we hold a block nobody references: offer to under-replicated
             # peers, then delete (ref resync.rs:376-455).  The migrating
             # case (rc just hit zero because a layout change moved the
@@ -260,6 +267,13 @@ class BlockResyncManager:
             # with data replication "none" this node may hold the ONLY
             # copy, and its new owner cannot serve reads until it lands.
             who = [n for n in mgr.replication.write_nodes(h) if n != mgr.system.id]
+            probe = {"t": "need_block", "h": bytes(h)}
+            if draining:
+                # the new owner's refs are as stale as ours — it would
+                # answer "not needed" on rc alone.  Our live rc vouches
+                # for the block, so the probe asks it to accept on ring
+                # assignment instead.
+                probe["drain"] = True
             needy, remote_present = [], 0
             for node in who:
                 # need_block is a pure probe (idempotent): route it
@@ -270,7 +284,7 @@ class BlockResyncManager:
                 resp = await mgr.system.rpc.call(
                     mgr.endpoint,
                     node,
-                    {"t": "need_block", "h": bytes(h)},
+                    probe,
                     prio=PRIO_BACKGROUND,
                     timeout=mgr.block_rpc_timeout,
                     idempotent=True,
@@ -306,7 +320,14 @@ class BlockResyncManager:
                     "offloaded block %s to %d nodes", bytes(h).hex()[:16], len(needy)
                 )
             confirmed = bool(who) and remote_present + len(needy) >= len(who)
-            if unassigned and not confirmed:
+            if draining:
+                # bytes are safe on the new owners, but local refs are
+                # still live: keep the copy until they migrate (rc hits
+                # zero → the migrating branch finishes the job).  Only
+                # requeue if an owner could not take its copy yet.
+                if not confirmed:
+                    self.put_to_resync(h, 30.0, source="migration_retry")
+            elif unassigned and not confirmed:
                 # owners' refs (rc) haven't migrated yet, so they
                 # answered neither needed nor present.  Hold the only
                 # copy and retry soon — NEVER delete unconfirmed, even

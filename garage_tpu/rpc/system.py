@@ -89,6 +89,13 @@ class NodeStatus:
     # read ranking.  None = peer predates the fields / scorer dark
     health_scores: Optional[Dict[str, float]] = None
     fail_slow: Optional[List[str]] = None
+    # graceful-drain state of the node's API front door ("draining"
+    # while a SIGTERM'd gateway sheds new requests and finishes its
+    # in-flight set, "drained" once it stopped serving).  Riding gossip
+    # means sibling gateways and pool clients learn to absorb the load
+    # BEFORE the socket closes.  None = not draining / peer predates
+    # the field
+    drain: Optional[str] = None
 
     def pack(self):
         return dataclasses.asdict(self)
@@ -100,6 +107,7 @@ class NodeStatus:
             "layout_staging_hash", "data_avail", "data_total",
             "meta_avail", "meta_total", "disk_state", "version",
             "governor_pressure", "health_scores", "fail_slow",
+            "drain",
         )})
 
 
@@ -271,6 +279,10 @@ class System:
         # zone-aware request ordering and the write-quorum zone check
         self._zone_map: Dict[bytes, str] = self.layout.zone_map()
         self.rpc.set_zone_source(self.zone_of, self.our_zone)
+        # zone-aware fail-slow baseline: a peer is judged against its
+        # same-zone siblings when enough exist, so WAN distance (a
+        # healthy zone two hops away) never reads as gray failure
+        self.health_scorer.zone_of = lambda p: self._zone_map.get(bytes(p))
         # info-style join metric: peer → zone per the committed layout
         # (value always 1), so Grafana can aggregate peer_up /
         # peer_breaker_state by failure domain.  labeled_fn renders the
@@ -301,6 +313,17 @@ class System:
         # in NodeStatus so gateways can shed at the front door on behalf
         # of a saturated storage node (cluster-aware admission)
         self.governor_pressure_fn: Optional[Callable[[], float]] = None
+        # set by the API front door while gracefully draining (ISSUE 19):
+        # None (serving) -> "draining" (shedding new, finishing
+        # in-flight) -> "drained" (stopped).  Gossiped in NodeStatus so
+        # sibling gateways absorb load before this node's socket closes
+        self.drain_state: Optional[str] = None
+        self.metrics.gauge(
+            "gateway_drain_state",
+            "Graceful-drain state of this node's API front door "
+            "(0 serving, 1 draining, 2 drained)",
+            fn=lambda: {None: 0.0, "draining": 1.0,
+                        "drained": 2.0}.get(self.drain_state, 0.0))
 
         self.node_status: Dict[FixedBytes32, NodeStatus] = {}
         # when each peer's status last arrived (monotonic): gossiped
@@ -454,6 +477,7 @@ class System:
                 ]
         except Exception:  # noqa: BLE001 — gossip must never break
             logger.exception("health scorer snapshot failed")
+        st.drain = self.drain_state
         return st
 
     def _disk_stats(self) -> dict:
@@ -816,6 +840,7 @@ class System:
             "disk_state": status.disk_state if status else None,
             "version": (self.netapp.peer_versions.get(nid)
                         or (status.version if status else None)),
+            "drain": status.drain if status else None,
         }
 
     def peer_health_score(self, nid) -> Optional[float]:

@@ -63,7 +63,16 @@ class Server:
         )
 
     async def stop(self) -> None:
-        # reverse order of start (ref server.rs:135-171)
+        # reverse order of start (ref server.rs:135-171).  The S3 front
+        # door drains first: new requests shed typed while the in-flight
+        # set finishes inside api.drain_timeout, with the drain state
+        # gossiped so sibling gateways absorb load before the socket
+        # closes (docs/ROBUSTNESS.md "Geo-WAN & gateway failover")
+        if self.s3 is not None:
+            try:
+                await self.s3.drain()
+            except Exception:
+                logger.exception("S3 drain failed; closing hard")
         for srv in (self.k2v, self.web, self.admin, self.s3):
             if srv is not None:
                 await srv.stop()

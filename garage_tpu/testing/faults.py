@@ -82,6 +82,17 @@ FAST_CHAOS_HEALTH = {
     "sample_ttl_s": 60.0,
 }
 
+# The canonical geo-WAN profile (ISSUE 19): a symmetric 3-zone RTT
+# matrix — z1↔z2 a metro pair, z1↔z3 cross-country, z2↔z3 the long
+# diagonal.  Values are full round trips in SECONDS; the injector
+# applies rtt/2 one-way per boundary link.  SHARED by the wan chaos
+# phase, bench --replay-phase, and the WAN-matrix unit tests.
+WAN_3ZONE_RTT = {
+    ("z1", "z2"): 0.020,
+    ("z1", "z3"): 0.080,
+    ("z2", "z3"): 0.150,
+}
+
 
 class FaultyLink(LatencyProxy):
     """One directed network path with live-tunable faults.  All knobs are
@@ -285,6 +296,8 @@ class FaultInjector:
         self.dead: set = set()
         self.links: Dict[Tuple[int, int], FaultyLink] = {}
         self.disks: Dict[int, FaultyDisk] = {}
+        # the RTT matrix currently applied (apply_wan_matrix), or None
+        self.wan_matrix: Optional[Dict[Tuple[str, str], float]] = None
         # node index -> zone (for the zone-grained fault helpers); when
         # not given, read from the committed layout
         self._zones = list(zones) if zones else None
@@ -434,6 +447,47 @@ class FaultInjector:
         """Clear every fault on the zone's boundary links."""
         for link in self._boundary_links(zone):
             link.clear()
+
+    # --- geo-WAN latency domains (ISSUE 19) ----------------------------
+
+    def apply_wan_matrix(self, matrix: Dict[Tuple[str, str], float],
+                         zones: Optional[List[Optional[str]]] = None,
+                         jitter: float = 0.0) -> None:
+        """Turn the flat loopback mesh into a geography: `matrix` maps an
+        (orderless) zone pair to its full RTT in seconds, and every link
+        CROSSING that pair's boundary gets rtt/2 one-way delay.  Links
+        inside a zone stay untouched — a DC's LAN does not pay WAN tolls.
+
+        `zones` overrides the per-index zone lookup (same length as the
+        node list); pass it when some indices — gateways — carry no
+        layout role but still live somewhere: the injector's own zone
+        table deliberately reports None for them so zone-kill drills
+        never crash a gateway, yet their WAN links must still stretch.
+        Pairs absent from the matrix keep their current delay."""
+
+        def _zone(i: int) -> Optional[str]:
+            if zones is not None and zones[i] is not None:
+                return zones[i]
+            return self.zone_of_index(i)
+
+        for (a, b), link in self.links.items():
+            za, zb = _zone(a), _zone(b)
+            if za is None or zb is None or za == zb:
+                continue
+            rtt = matrix.get((za, zb), matrix.get((zb, za)))
+            if rtt is None:
+                continue
+            link.delay = rtt / 2.0
+            link.jitter = jitter / 2.0
+        self.wan_matrix = dict(matrix)
+
+    def clear_wan_matrix(self) -> None:
+        """Back to a flat zero-RTT mesh (only latency/jitter are reset —
+        other live faults on the links are left alone)."""
+        for link in self.links.values():
+            link.delay = 0.0
+            link.jitter = 0.0
+        self.wan_matrix = None
 
     async def kill_zone(self, zone: str) -> None:
         """Abruptly crash every node in the zone (correlated failure —

@@ -106,18 +106,22 @@ def _zone_plan(n_nodes: int, n_zones: int) -> List[str]:
 
 
 class SimCluster:
-    """n_storage nodes spread over n_zones, plus one gateway node (index
-    0, capacity None) that fronts the S3 API — so storage zones can be
-    killed/restarted without taking the client's endpoint down."""
+    """n_storage nodes spread over n_zones, plus n_gateways gateway
+    nodes (capacity None) that front the S3 API — so storage zones can
+    be killed/restarted without taking the client's endpoint down, and
+    (with n_gateways > 1) a GatewayPool client can fail requests over
+    between siblings when one gateway dies or drains."""
 
     def __init__(self, tmp, n_storage: int = 24, n_zones: int = 4,
                  repl: str = "3", zone_redundancy="maximum",
                  db: str = "memory", rpc_cfg: Optional[dict] = None,
                  rebalance_rate_mib: float = 512.0,
-                 extra_cfg: Optional[dict] = None):
+                 extra_cfg: Optional[dict] = None,
+                 n_gateways: int = 1):
         self.tmp = Path(tmp)
         self.n_storage = n_storage
         self.n_zones = n_zones
+        self.n_gateways = n_gateways
         self.repl = repl
         self.zone_redundancy = zone_redundancy
         self.db = db
@@ -127,12 +131,19 @@ class SimCluster:
         # extra top-level config keys merged into EVERY node's config
         # (e.g. {"api": {"max_inflight": 2}} for the overload drill)
         self.extra_cfg = dict(extra_cfg or {})
-        # index 0 = gateway; storage nodes are 1..n_storage
-        self.zones: List[Optional[str]] = [None] + _zone_plan(
-            n_storage, n_zones)
+        # index 0 = first gateway; storage nodes are 1..n_storage; extra
+        # gateways ride at the tail (n_storage+1..) so every existing
+        # storage_indices()/zone-drill invariant keeps holding.  Gateway
+        # zone entries are None ON PURPOSE: zone-kill/rolling drills
+        # enumerate zones through the injector and must never crash the
+        # client's endpoint (their layout role still names a zone).
+        self.zones: List[Optional[str]] = ([None] + _zone_plan(
+            n_storage, n_zones) + [None] * (n_gateways - 1))
         self.garages: List = []
         self.injector: Optional[FaultInjector] = None
-        self.server = None
+        self.servers: List = []   # one S3ApiServer per gateway
+        self.ports: List[int] = []
+        self.server = None        # first gateway's server (compat)
         self.port = self.key_id = self.secret = None
 
     # --- construction ---------------------------------------------------
@@ -161,7 +172,7 @@ class SimCluster:
         from ..utils.config import config_from_dict
 
         t0 = time.monotonic()
-        n = self.n_storage + 1
+        n = self.n_storage + self.n_gateways
         self.garages = [
             Garage(config_from_dict(self._node_config(i))) for i in range(n)
         ]
@@ -186,12 +197,14 @@ class SimCluster:
                                  for i, j in pairs[lo:lo + 64]]),
                 timeout=max(5.0, startup_timeout - (time.monotonic() - t0)))
 
-        # zone-aware layout: gateway (capacity None) + storage roles
+        # zone-aware layout: gateways (capacity None) + storage roles
         lay = self.garages[0].system.layout
         lay.stage_parameters(LayoutParameters(self.zone_redundancy))
-        lay.stage_role(bytes(self.garages[0].system.id),
-                       NodeRole(self.zones[1] or "z1", None, ["gateway"]))
-        for i in range(1, n):
+        for gi in self.gateway_indices():
+            lay.stage_role(bytes(self.garages[gi].system.id),
+                           NodeRole(self.zones[1] or "z1", None,
+                                    ["gateway"]))
+        for i in self.storage_indices():
             lay.stage_role(bytes(self.garages[i].system.id),
                            NodeRole(self.zones[i], 1000))
         lay.apply_staged_changes()
@@ -228,13 +241,18 @@ class SimCluster:
         key = await helper.create_key("sim")
         key.params().allow_create_bucket.update(True)
         await self.garages[0].key_table.insert(key)
-        self.server = S3ApiServer(self.garages[0])
-        await self.server.start("127.0.0.1:0")
-        self.port = self.server.port
+        self.servers, self.ports = [], []
+        for gi in self.gateway_indices():
+            srv = S3ApiServer(self.garages[gi])
+            await srv.start("127.0.0.1:0")
+            self.servers.append(srv)
+            self.ports.append(srv.port)
+        self.server, self.port = self.servers[0], self.ports[0]
         self.key_id = key.key_id
         self.secret = key.params().secret_key
-        logger.info("SimCluster up: %d nodes / %d zones in %.1fs",
-                    n, self.n_zones, time.monotonic() - t0)
+        logger.info("SimCluster up: %d nodes / %d zones / %d gateways "
+                    "in %.1fs", n, self.n_zones, self.n_gateways,
+                    time.monotonic() - t0)
 
     async def tick(self, rounds: int = 2) -> None:
         """Drive every live node's peering tick (pings → RTT EWMAs,
@@ -249,8 +267,9 @@ class SimCluster:
             await asyncio.sleep(0.05)
 
     async def stop(self) -> None:
-        if self.server is not None:
-            await self.server.stop()
+        for srv in (self.servers or
+                    ([self.server] if self.server else [])):
+            await srv.stop()  # idempotent: killed gateways are no-ops
         if self.injector is not None:
             await self.injector.stop_network()
         for i, g in enumerate(self.garages):
@@ -272,25 +291,96 @@ class SimCluster:
     def metrics_value(self, i: int, needle: str) -> bool:
         return needle in self.garages[i].system.metrics.render()
 
-    async def apply_layout_change(self, mutate) -> None:
-        """Stage + apply a layout change on the gateway and push it to
-        every node (the CRDT merge path a CLI `layout apply` takes).
-        `mutate(layout)` stages roles/parameters on a decoded copy."""
+    async def precompute_layout_change(self, mutate) -> bytes:
+        """Stage `mutate` on a decoded copy of the current layout, run
+        the assignment solve, and return the committed layout encoded
+        — WITHOUT delivering it.  The solve is pure CPU and can hold
+        the GIL for tens of seconds on a big change; real deployments
+        run it on the operator's machine and the cluster only ever
+        sees the finished result.  Drills that sample latency across a
+        layout change must split the same way: solve while idle, then
+        `apply_encoded_layout` instantly — a mid-traffic solve stalls
+        every node in this single-process sim, RPC timeouts fire in a
+        burst, breakers trip, and the movers' first pushes all fail
+        before the measurement even starts."""
         from ..rpc.layout import ClusterLayout
 
-        g0 = self.garages[0]
-        lay = ClusterLayout.decode(g0.system.layout.encode())
+        lay = ClusterLayout.decode(self.garages[0].system.layout.encode())
         mutate(lay)
-        lay.apply_staged_changes()
-        await g0.system.update_cluster_layout(lay)
-        # deliver to every live node even if the gossip broadcast raced
-        # a fault: the drills must not depend on broadcast timing
-        enc = lay.encode()
+        await asyncio.to_thread(lay.apply_staged_changes)
+        return lay.encode()
+
+    async def apply_encoded_layout(self, enc: bytes) -> None:
+        """Deliver an already-solved layout to every live node (the
+        CRDT merge path a CLI `layout apply` takes) — broadcast-timing
+        independent, so drills never race the gossip."""
+        from ..rpc.layout import ClusterLayout
+
         dead = self.injector.dead if self.injector else set()
         for i, g in enumerate(self.garages):
             if i not in dead:
                 await g.system.update_cluster_layout(
                     ClusterLayout.decode(enc))
+
+    async def apply_layout_change(self, mutate) -> None:
+        """Stage + solve + deliver in one call, for drills that do not
+        sample during the solve."""
+        await self.apply_encoded_layout(
+            await self.precompute_layout_change(mutate))
+
+    # --- gateway pool helpers (ISSUE 19) --------------------------------
+
+    def gateway_indices(self) -> List[int]:
+        return [0] + list(range(self.n_storage + 1,
+                                self.n_storage + self.n_gateways))
+
+    def gateway_endpoints(self) -> List:
+        """[(name, port), ...] for a GatewayPool client."""
+        return [(f"g{p}", self.ports[p]) for p in range(len(self.ports))]
+
+    def apply_wan(self, matrix=None, jitter: float = 0.0) -> None:
+        """Stretch the mesh into the 3-zone geography (WAN_3ZONE_RTT by
+        default).  Gateways sit in the FIRST zone for WAN purposes:
+        their injector zone entry stays None (zone-kill drills must
+        never crash them) but their boundary links stretch like any z1
+        resident's — matching their layout role's zone."""
+        from .faults import WAN_3ZONE_RTT
+
+        zones = list(self.zones)
+        for gi in self.gateway_indices():
+            zones[gi] = self.zones[1] or "z1"
+        self.injector.apply_wan_matrix(
+            WAN_3ZONE_RTT if matrix is None else matrix,
+            zones=zones, jitter=jitter)
+
+    async def kill_gateway(self, pos: int) -> None:
+        """Abrupt gateway death (pool position `pos`): every live HTTP
+        connection is aborted mid-byte — clients see resets, exactly
+        like a kill -9 — then the listener closes.  The node's Garage
+        stays up (it holds no data; the RPC mesh is untouched)."""
+        srv = self.servers[pos]
+        runner = getattr(srv, "_runner", None)
+        if runner is not None and runner.server is not None:
+            for proto in list(runner.server.connections):
+                tr = getattr(proto, "transport", None)
+                if tr is not None:
+                    tr.abort()
+        await srv.stop()
+
+    async def restart_gateway(self, pos: int) -> int:
+        """Bring a killed/drained gateway back on a fresh port; returns
+        the new port (callers re-point their GatewayPool member)."""
+        from ..api.s3.api_server import S3ApiServer
+
+        g = self.garages[self.gateway_indices()[pos]]
+        g.system.drain_state = None
+        srv = S3ApiServer(g)
+        await srv.start("127.0.0.1:0")
+        self.servers[pos] = srv
+        self.ports[pos] = srv.port
+        if pos == 0:
+            self.server, self.port = srv, srv.port
+        return srv.port
 
 
 class TrafficStats:
@@ -333,8 +423,11 @@ class TrafficDriver:
         import bench
 
         self.cluster = cluster
+        # honor (clamped) Retry-After on 503s: the drills' sustained
+        # traffic is production-shaped, not a shed-hammering loop
         self.s3 = bench._S3(session, cluster.port, cluster.key_id,
-                            cluster.secret)
+                            cluster.secret, honor_retry_after=True,
+                            retry_after_cap=0.5)
         self.bucket = bucket
         self.rng = random.Random(seed)
         self.acked: Dict[str, bytes] = {}
@@ -1013,4 +1106,407 @@ async def rolling_restart_drill(cluster: SimCluster,
     bad = await traffic.verify_all()
     out["verify_mismatches"] = bad
     out.update(traffic.stats.summary())
+    return out
+
+
+async def wan_drill(cluster: SimCluster, session, secs: float,
+                    bucket: str = "wan-drill") -> dict:
+    """The ISSUE-19 geo-WAN acceptance drill, on a 6-node/3-zone
+    cluster with the WAN_3ZONE_RTT matrix applied:
+
+      - local-zone-first GETs hold: gateway (a z1 resident) serves
+        GET p50 near the LOCAL quorum cost (z1@0 + z2@20ms), nowhere
+        near the cross-country z3 RTT
+      - fail-slow scoring does NOT flag healthy-but-distant zones (the
+        zone-aware baseline: a z3 peer is judged against z3 siblings,
+        not against loopback neighbors) — and a GENUINELY slow peer
+        still flags through the same scorer
+      - cross-zone reads pay exactly the matrix: with the gateway cut
+        off from z1 storage, GET quorum needs z2+z3 → p50 ≥ ~z1z3 RTT
+        and ≥ 3× the local p50; write re-quorums pay the same toll
+
+    Bodies are 2 KiB (< INLINE_THRESHOLD) so a GET is a pure metadata
+    quorum read — latency IS the RPC geography, no streaming noise."""
+    import bench
+
+    inj = cluster.injector
+    g0 = cluster.garages[0]
+    out: dict = {"errors": 0, "error_notes": [],
+                 "matrix_ms": {f"{a}-{b}": rtt * 1000 for (a, b), rtt
+                               in (inj.wan_matrix or {}).items()}}
+
+    cluster.apply_wan()
+    out["matrix_ms"] = {f"{a}-{b}": rtt * 1000
+                        for (a, b), rtt in inj.wan_matrix.items()}
+    # prime the RTT EWMAs under WAN delays (adaptive timeouts must
+    # learn the new geography before anything is measured against it)
+    await cluster.tick(rounds=3)
+
+    s3 = bench._S3(session, cluster.port, cluster.key_id, cluster.secret)
+    st, _b, _h = await s3.req("PUT", f"/{bucket}")
+    assert st == 200, f"bucket create: {st}"
+
+    def body_for(i: int) -> bytes:
+        return bytes(((i * 53 + j) & 0xFF) for j in range(256)) * 8  # 2 KiB
+
+    # --- phase 1: local-zone traffic under the WAN matrix ---
+    n_ops = max(8, min(16, int(4 * secs)))
+    put_lats, get_lats = [], []
+    acked: Dict[str, bytes] = {}
+    for i in range(n_ops):
+        name, body = f"wan-{i:04d}", body_for(i)
+        t0 = time.perf_counter()
+        st, _b, _h = await s3.req("PUT", f"/{bucket}/{name}", body)
+        put_lats.append(time.perf_counter() - t0)
+        if st != 200:
+            out["errors"] += 1
+            out["error_notes"].append(f"PUT {name}: HTTP {st}")
+            continue
+        acked[name] = body
+        t0 = time.perf_counter()
+        st, got, _h = await s3.req("GET", f"/{bucket}/{name}")
+        get_lats.append(time.perf_counter() - t0)
+        if st != 200 or got != body:
+            out["errors"] += 1
+            out["error_notes"].append(f"GET {name}: HTTP {st}")
+    local_rtt = min(v for (a, b), v in inj.wan_matrix.items()
+                    if "z1" in (a, b))
+    local_p50 = sorted(get_lats)[len(get_lats) // 2]
+    out["local_get_p50_ms"] = round(local_p50 * 1000, 2)
+    out["local_put_p50_ms"] = round(
+        sorted(put_lats)[len(put_lats) // 2] * 1000, 2)
+    # local quorum = z1 (free) + metro z2: the GET must cost ~one metro
+    # RTT per metadata read, generous slack for the in-process sim
+    out["local_p50_ok"] = local_p50 <= local_rtt + 0.075
+
+    # --- phase 2: healthy-but-distant zones must NOT read fail-slow ---
+    # feed the scorers (peering pings pay the WAN tolls now), spanning
+    # more than the sustained-flag window
+    for _ in range(6):
+        await cluster.tick(rounds=1)
+        await asyncio.sleep(0.12)
+    flagged = []
+    scored_peers = 0
+    for i, g in enumerate(cluster.garages):
+        if inj and i in inj.dead:
+            continue
+        sc = g.system.health_scorer.scores()
+        scored_peers += len(sc)
+        flagged += [f"node{i}->{p}" for p, v in sc.items()
+                    if v["fail_slow"]]
+    out["wan_false_positives"] = flagged[:8]
+    out["wan_scored_peers"] = scored_peers
+    out["no_wan_false_positives"] = scored_peers > 0 and not flagged
+
+    # ...and a GENUINELY slow peer (in the far zone, judged against its
+    # own sibling) must still flag through the very same scorer
+    victim = inj.nodes_in_zone("z3")[0]
+    victim_hex = bytes(cluster.garages[victim].system.id).hex()[:16]
+    inj.slow_peer(victim, 0.35)
+    flag_by = time.monotonic() + 12.0
+    genuine = False
+    while not genuine and time.monotonic() < flag_by:
+        await cluster.tick(rounds=1)
+        await asyncio.sleep(0.1)
+        for i, g in enumerate(cluster.garages):
+            if i == victim:
+                continue
+            v = g.system.health_scorer.scores().get(victim_hex)
+            if v is not None and v["fail_slow"]:
+                genuine = True
+                break
+    out["genuine_slow_flagged"] = genuine
+    # slow_peer overwrote the victim's WAN delays too: rebuild the
+    # geography from scratch rather than guessing what it clobbered
+    inj.clear_wan_matrix()
+    cluster.apply_wan()
+
+    # --- phase 3: cross-zone reads + write re-quorum pay the matrix ---
+    # cut the gateway off from its OWN zone's storage (gateway-only
+    # partition: the storage mesh keeps its full quorums) so every
+    # metadata read must assemble quorum from z2 (metro) + z3 (far)
+    z1_members = inj.nodes_in_zone("z1")
+    for i in z1_members:
+        inj.partition(0, i)
+    for _ in range(3):  # open the gateway's z1 breakers (fail fast)
+        await cluster.tick(rounds=1)
+    for name in list(acked)[:2]:  # warm: absorb breaker-opening costs
+        await s3.req("GET", f"/{bucket}/{name}")
+    cross_get, cross_put = [], []
+    probe_names = sorted(acked)[:8]
+    for name in probe_names:
+        t0 = time.perf_counter()
+        st, got, _h = await s3.req("GET", f"/{bucket}/{name}")
+        cross_get.append(time.perf_counter() - t0)
+        if st != 200 or got != acked[name]:
+            out["errors"] += 1
+            out["error_notes"].append(f"cross GET {name}: HTTP {st}")
+    for i in range(6):
+        name, body = f"requorum-{i:03d}", body_for(100 + i)
+        t0 = time.perf_counter()
+        st, _b, _h = await s3.req("PUT", f"/{bucket}/{name}", body)
+        cross_put.append(time.perf_counter() - t0)
+        if st == 200:
+            acked[name] = body
+        else:
+            out["errors"] += 1
+            out["error_notes"].append(f"requorum PUT {name}: HTTP {st}")
+    far_rtt = max(v for (a, b), v in inj.wan_matrix.items()
+                  if "z1" in (a, b))
+    cross_p50 = sorted(cross_get)[len(cross_get) // 2]
+    out["cross_get_p50_ms"] = round(cross_p50 * 1000, 2)
+    out["requorum_put_p50_ms"] = round(
+        sorted(cross_put)[len(cross_put) // 2] * 1000, 2)
+    # quorum 2-of-{z2@20, z3@80} waits on the far zone: the drill's
+    # teeth — cross-zone pays the MATRIX, not some flat timeout
+    out["cross_pays_matrix"] = cross_p50 >= 0.8 * far_rtt
+    out["cross_vs_local_3x"] = cross_p50 >= 3.0 * max(local_p50, 1e-4)
+    out["requorum_pays_matrix"] = (
+        sorted(cross_put)[len(cross_put) // 2] >= 0.8 * far_rtt)
+
+    # --- heal: flat mesh again, everything still bit-identical ---
+    for i in z1_members:
+        inj.heal_link(0, i)
+    inj.clear_wan_matrix()
+    await inj.reconnect(rounds=8)
+    bad = 0
+    for name, body in sorted(acked.items()):
+        st, got, _h = await s3.req("GET", f"/{bucket}/{name}")
+        if st != 200 or got != body:
+            bad += 1
+    out["verify_mismatches"] = bad
+    out["acked"] = len(acked)
+    out["error_notes"] = out["error_notes"][:8]
+    if not out["error_notes"]:
+        del out["error_notes"]
+    return out
+
+
+async def gateway_failover_drill(cluster: SimCluster, session,
+                                 secs: float,
+                                 bucket: str = "pool-drill") -> dict:
+    """The ISSUE-19 zero-loss gateway failover drill (needs a cluster
+    built with n_gateways >= 2):
+
+      - a GatewayPool client drives live PUT/GET traffic across both
+        gateways while g1 is killed mid-PUT-body and mid-streaming-GET:
+        zero acked-data loss (bit-identical reads via the sibling),
+        the interrupted unacked PUT retried to success on g0, the
+        interrupted GET RESUMED on g0 via Range (no refetch)
+      - graceful drain: a SIGTERM'd gateway sheds new requests typed
+        (503 SlowDown + RequestId + Retry-After), finishes its
+        in-flight streaming GET inside the bounded drain window, and
+        its draining/drained state rides NodeStatus gossip
+      - the new gateway_pool_* / gateway_drain_state families render,
+        pass promlint, and are documented in docs/OBSERVABILITY.md"""
+    from pathlib import Path as _Path
+
+    from ..utils.metricsdoc import undocumented_families
+    from ..utils.promlint import lint_exposition
+    from .gateway_pool import GatewayPool
+
+    assert cluster.n_gateways >= 2, "drill needs a gateway sibling"
+    out: dict = {"errors": 0, "error_notes": [],
+                 "gateways": cluster.n_gateways}
+    pool = GatewayPool(session, cluster.gateway_endpoints(),
+                       cluster.key_id, cluster.secret,
+                       metrics=cluster.garages[0].system.metrics)
+    st, _b, _h = await pool.request("PUT", f"/{bucket}")
+    assert st == 200, f"bucket create: {st}"
+    out["probe_initial"] = await pool.probe()
+
+    # --- live background traffic through the pool, for the whole run ---
+    acked: Dict[str, bytes] = {}
+    stop_bg = asyncio.Event()
+
+    async def bg_loop() -> None:
+        i = 0
+        rng = random.Random(77)
+        while not stop_bg.is_set():
+            i += 1
+            name = f"bg-{i:05d}"
+            body = bytes(((i * 31 + j) & 0xFF) for j in range(512)) * 4
+            try:
+                st, rb, hdrs = await pool.request(
+                    "PUT", f"/{bucket}/{name}", body, prefer=i % 2)
+            except Exception as e:  # noqa: BLE001
+                out["errors"] += 1
+                out["error_notes"].append(f"bg PUT {name}: {e!r}")
+                continue
+            if st == 200:
+                acked[name] = body
+            elif st == 503:
+                bad = check_typed_shed(rb, hdrs)
+                if bad is not None:
+                    out["errors"] += 1
+                    out["error_notes"].append(f"bg PUT {name}: {bad}")
+            else:
+                out["errors"] += 1
+                out["error_notes"].append(f"bg PUT {name}: HTTP {st}")
+            if acked and rng.random() < 0.5:
+                probe = rng.choice(sorted(acked))
+                try:
+                    st, got, _h = await pool.request(
+                        "GET", f"/{bucket}/{probe}")
+                except Exception as e:  # noqa: BLE001
+                    out["errors"] += 1
+                    out["error_notes"].append(f"bg GET {probe}: {e!r}")
+                    continue
+                if st != 200 or got != acked[probe]:
+                    out["errors"] += 1
+                    out["error_notes"].append(
+                        f"bg GET {probe}: HTTP {st}"
+                        + (" bad body" if st == 200 else ""))
+            await asyncio.sleep(0.01)
+
+    bg = asyncio.ensure_future(bg_loop())
+    pattern = bytes(range(256)) * (4 << 10)  # 1 MiB
+
+    # --- scenario A: gateway dies mid-PUT-body ---
+    big1 = pattern * 3
+    killed = asyncio.Event()
+
+    def trickle():
+        async def gen():
+            chunk = 64 << 10
+            for off in range(0, len(big1), chunk):
+                if off >= len(big1) // 2 and not killed.is_set():
+                    killed.set()
+                    await cluster.kill_gateway(1)
+                yield big1[off:off + chunk]
+        return gen()
+
+    st, _b, _h = await pool.request(
+        "PUT", f"/{bucket}/big-1", big1, prefer=1, body_factory=trickle)
+    out["mid_put_status"] = st
+    out["mid_put_killed"] = killed.is_set()
+    out["mid_put_recovered"] = st == 200
+    if st == 200:
+        acked["big-1"] = big1
+    st, got, _h = await pool.request("GET", f"/{bucket}/big-1")
+    out["mid_put_bit_identical"] = st == 200 and got == big1
+
+    # --- scenario B: gateway dies mid-streaming-GET → Range resume ---
+    pool.set_port("g1", await cluster.restart_gateway(1))
+    big2 = bytes(reversed(pattern)) * 8
+    st, _b, _h = await pool.request(
+        "PUT", f"/{bucket}/big-2", big2, prefer=0)
+    assert st == 200, f"PUT big-2: {st}"
+    acked["big-2"] = big2
+    killed2 = [False]
+
+    async def on_chunk(total: int) -> None:
+        if total >= (256 << 10) and not killed2[0]:
+            killed2[0] = True
+            await cluster.kill_gateway(1)
+
+    st, got, resumed = await pool.get_resumable(
+        f"/{bucket}/big-2", prefer=1, on_chunk=on_chunk)
+    out["get_resume_status"] = st
+    out["get_resumed_via_range"] = resumed
+    out["get_resume_bit_identical"] = got == big2
+
+    stop_bg.set()
+    await bg
+
+    # --- scenario C: graceful drain under in-flight traffic ---
+    pool.set_port("g1", await cluster.restart_gateway(1))
+    g1i = cluster.gateway_indices()[1]
+    g1_id = bytes(cluster.garages[g1i].system.id)
+    got_slow = bytearray()
+
+    async def slow_consumer() -> None:
+        # client-paced DOWNLOAD: the handler may finish long before the
+        # client (loopback kernel buffers swallow the body) — the bytes
+        # must still arrive bit-identical across the drain close
+        async with pool.stream_request(1, "GET", f"/{bucket}/big-2") as r:
+            out["drain_slow_get_status"] = r.status
+            async for chunk in r.content.iter_chunked(512 << 10):
+                got_slow.extend(chunk)
+                await asyncio.sleep(0.05)
+
+    # ...while a client-paced UPLOAD holds a handler genuinely in
+    # flight for the whole window (the server cannot finish reading
+    # bytes the client hasn't sent): the drain MUST wait this one out
+    slow_body = bytes(((j * 7) & 0xFF) for j in range(256 << 10)) * 8
+
+    def drip():
+        async def gen():
+            chunk = 256 << 10
+            for off in range(0, len(slow_body), chunk):
+                yield slow_body[off:off + chunk]
+                await asyncio.sleep(0.12)
+        return gen()
+
+    slow_task = asyncio.ensure_future(slow_consumer())
+    put_task = asyncio.ensure_future(pool.raw(
+        1, "PUT", f"/{bucket}/drain-slow", slow_body, body_factory=drip))
+    await asyncio.sleep(0.25)  # both are in flight on g1
+    drain_task = asyncio.ensure_future(
+        cluster.servers[1].drain(timeout=8.0))
+    await asyncio.sleep(0.05)
+    # while draining: a NEW request to g1 sheds typed, never hangs —
+    # and the listener must still be UP (the in-flight PUT pins the
+    # window open), so a refused connection here is a drain bug
+    try:
+        st, rb, hdrs = await pool.raw(1, "GET", f"/{bucket}/big-2")
+        out["drain_shed_status"] = st
+        out["drain_shed_typed"] = (
+            st == 503
+            and check_typed_shed(rb, hdrs, codes=("SlowDown",)) is None)
+    except Exception as e:  # noqa: BLE001 — evidence, not a stack trace
+        out["drain_shed_status"] = f"unreachable: {e!r}"
+        out["drain_shed_typed"] = False
+    await asyncio.sleep(0.1)  # let the "draining" advertisement land
+    # ...and the draining state is visible in a STORAGE node's gossip
+    def _gossiped_drain() -> Optional[str]:
+        sys1 = cluster.garages[1].system
+        row = next((s for nid, s in sys1.node_status.items()
+                    if bytes(nid) == g1_id), None)
+        return getattr(row, "drain", None)
+
+    out["drain_gossiped"] = _gossiped_drain() == "draining"
+    window = await drain_task
+    await slow_task
+    st_put, _b, _h = await put_task
+    if st_put == 200:
+        acked["drain-slow"] = slow_body
+    out["drain_window_s"] = round(window, 2)
+    out["drain_bounded"] = window < 8.0
+    out["drain_inflight_completed"] = (st_put == 200
+                                       and bytes(got_slow) == big2)
+    out["drained_gossiped"] = _gossiped_drain() == "drained"
+    try:  # post-drain the socket is CLOSED, not wedged
+        await pool.raw(1, "GET", "/")
+        out["drain_socket_closed"] = False
+    except Exception:  # noqa: BLE001 — refused/reset is the pass
+        out["drain_socket_closed"] = True
+
+    # --- zero acked-data loss, bit-identical, via the surviving pool ---
+    bad = 0
+    for name, body in sorted(acked.items()):
+        st, got, _h = await pool.request("GET", f"/{bucket}/{name}")
+        if st != 200 or got != body:
+            bad += 1
+            out["error_notes"].append(f"verify {name}: HTTP {st}")
+    out["verify_mismatches"] = bad
+    out["acked"] = len(acked)
+    out["pool_counters"] = dict(pool.counters)
+    out["failover_exercised"] = pool.counters["failovers"] >= 2
+    out["resume_exercised"] = pool.counters["resumes"] >= 1
+
+    # --- the new families render, lint clean, and are documented ---
+    expo0 = cluster.garages[0].system.metrics.render()
+    expo1 = cluster.garages[g1i].system.metrics.render()
+    out["drain_gauge_rendered"] = "gateway_drain_state" in expo1
+    out["pool_counters_rendered"] = "gateway_pool_failover_total" in expo0
+    out["promlint_errors"] = (lint_exposition(expo0)
+                              + lint_exposition(expo1))[:4]
+    doc = (_Path(__file__).resolve().parents[2]
+           / "docs" / "OBSERVABILITY.md").read_text()
+    out["metricsdoc_missing"] = sorted(
+        undocumented_families(expo0 + "\n" + expo1, doc))[:8]
+    out["error_notes"] = out["error_notes"][:8]
+    if not out["error_notes"]:
+        del out["error_notes"]
     return out

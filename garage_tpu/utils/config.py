@@ -497,6 +497,8 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         raise ConfigError("api.codel_interval must be > 0")
     if cfg.api.streaming_body_estimate < 0:
         raise ConfigError("api.streaming_body_estimate must be >= 0")
+    if cfg.api.drain_timeout < 0:
+        raise ConfigError("api.drain_timeout must be >= 0")
     if cfg.api.longpoll_max_parked < 0:
         raise ConfigError(
             "api.longpoll_max_parked must be >= 0 (0 = 4x max_inflight)")

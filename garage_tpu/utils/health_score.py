@@ -137,6 +137,14 @@ class FailSlowScorer:
         self.tun = tun or HealthTunables()
         self.clock = clock
         self.on_change = on_change
+        # zone lookup (peer_bytes -> zone name or None), wired by the
+        # owning System from the cluster layout.  On a geo-distributed
+        # cluster a healthy peer two WAN hops away is a FACTOR slower
+        # than a loopback sibling on every class — that is distance, not
+        # gray failure.  When the peer's zone holds enough baseline
+        # peers, comparison is restricted to same-zone siblings (who pay
+        # the same RTTs); otherwise it falls back to the full set.
+        self.zone_of: Optional[Callable[[bytes], Optional[str]]] = None
         # (peer_bytes, class) -> digest
         self._digests: Dict[Tuple[bytes, str], _Digest] = {}
         self._verdicts: Dict[bytes, _PeerVerdict] = {}
@@ -199,11 +207,19 @@ class FailSlowScorer:
         for (p, cls), d in digests.items():
             if d.count >= tun.min_samples:
                 by_class.setdefault(cls, []).append((p, d.ewma))
+        zone = self.zone_of(peer) if self.zone_of is not None else None
         for cls, rows in by_class.items():
             mine = next((e for p, e in rows if p == peer), None)
             if mine is None:
                 continue
             others = [e for p, e in rows if p != peer]
+            if zone is not None:
+                # prefer same-zone siblings as the baseline: a WAN-far
+                # zone must not look fail-slow against loopback peers
+                same_zone = [e for p, e in rows
+                             if p != peer and self.zone_of(p) == zone]
+                if len(same_zone) >= tun.min_baseline_peers:
+                    others = same_zone
             if len(others) < tun.min_baseline_peers:
                 continue
             ratio = mine / max(_lower_median(others), MEDIAN_FLOOR_S)

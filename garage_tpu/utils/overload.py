@@ -92,6 +92,12 @@ class OverloadTunables:
     # total poll concurrency stays bounded by the gate as before this
     # pool existed.  0 = derive 4 x max_inflight.
     longpoll_max_parked: int = 0
+    # --- graceful drain (docs/ROBUSTNESS.md "Geo-WAN & gateway
+    # failover") ---
+    # max seconds a SIGTERM'd API server waits for its in-flight set
+    # to finish while shedding new requests typed, before closing the
+    # socket regardless
+    drain_timeout: float = 10.0
     # --- load governor ---
     # pressure <= governor_low → background at full rate (ratio 1.0);
     # pressure >= governor_high → background at governor_min_ratio;

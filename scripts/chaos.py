@@ -53,6 +53,18 @@ admission watermark:
                   the at-capacity baseline, background_throttle_ratio
                   drops then recovers, zero acked-data loss
 
+Production-shaped survival phases (ISSUE 19), each on its own cluster:
+
+  wan             the 3-zone geo-WAN RTT matrix (20/80/150 ms boundary
+                  links): local-zone GETs hold p50 near the local RTT,
+                  cross-zone reads and write re-quorums pay exactly the
+                  matrix, and the zone-aware fail-slow baseline never
+                  flags a healthy-but-distant zone
+  gateway_failover  2 gateways behind the health-checked GatewayPool:
+                  one killed mid-PUT-body and mid-streaming-GET (zero
+                  acked loss, Range resume), then gracefully drained —
+                  typed sheds, gossiped drain state, bounded window
+
 Every phase must complete with ZERO client-visible errors; the exit
 code says so, and a JSON summary (per-phase op counts + p50/p99/max
 latency + breaker/disk/rebalance states) goes to stdout for bench
@@ -101,6 +113,17 @@ OVERLOAD_PHASES = ("overload",)
 # the abuser's excess sheds typed per-tenant, and a gossiped-hot storage
 # node triggers a remote_pressure shed at a locally-idle gateway
 QOS_PHASES = ("noisy_neighbor",)
+# ISSUE 19 geo-WAN drill: the 3-zone RTT matrix (20/80/150 ms) on its
+# own 6-node/3-zone SimCluster — local-zone GET p50 holds near the
+# local RTT, cross-zone reads/write-re-quorums pay exactly the matrix,
+# and the zone-aware fail-slow baseline never flags a healthy-but-
+# distant zone (while a genuinely slow far peer still flags)
+WAN_PHASES = ("wan",)
+# ISSUE 19 gateway-pool drill: 2 gateways behind the health-checked
+# GatewayPool client; one is killed mid-PUT-body and mid-streaming-GET
+# (zero acked loss, Range resume) and then gracefully drained under an
+# in-flight slow GET (typed sheds, gossiped drain state, bounded window)
+GATEWAY_PHASES = ("gateway_failover",)
 
 
 def _apply(inj, phase):
@@ -576,6 +599,84 @@ async def run_noisy(secs, n_storage=3, n_zones=3):
     return summary
 
 
+async def run_wan(secs, n_storage=6, n_zones=3):
+    """ISSUE-19 acceptance: a 6-node/3-zone SimCluster under the
+    symmetric WAN_3ZONE_RTT matrix (z1-z2 20 ms, z1-z3 80 ms, z2-z3
+    150 ms on boundary links only).  The wan_drill asserts local-zone
+    GET p50 near the local RTT, zero fail-slow flags on healthy distant
+    zones (plus a genuinely slow far peer still flagging), and
+    cross-zone reads / write re-quorums paying exactly the matrix."""
+    import aiohttp
+
+    from garage_tpu.testing.faults import FAST_CHAOS_HEALTH
+    from garage_tpu.testing.sim_cluster import SimCluster, wan_drill
+
+    summary = {"phases": {}, "ok": True}
+    with tempfile.TemporaryDirectory(prefix="garage_wan_") as tmp:
+        cluster = SimCluster(
+            tmp, n_storage=n_storage, n_zones=n_zones,
+            extra_cfg={"health": dict(FAST_CHAOS_HEALTH)})
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                st = await wan_drill(cluster, session, secs)
+                summary["phases"]["wan"] = st
+                for key in ("local_p50_ok", "no_wan_false_positives",
+                            "genuine_slow_flagged", "cross_pays_matrix",
+                            "cross_vs_local_3x", "requorum_pays_matrix"):
+                    summary["ok"] &= bool(st.get(key))
+                summary["ok"] &= st.get("errors") == 0
+                summary["ok"] &= st.get("verify_mismatches") == 0
+                print(f"phase wan: {st}", file=sys.stderr)
+        finally:
+            await cluster.stop()
+    return summary
+
+
+async def run_gateway_failover(secs, n_storage=6, n_zones=3):
+    """ISSUE-19 acceptance: 2 gateways in front of a 6-node/3-zone
+    SimCluster, traffic through the health-checked GatewayPool.  The
+    drill kills g1 mid-PUT-body and mid-streaming-GET (zero acked-data
+    loss: sibling retry + Range resume, everything bit-identical), then
+    drains it gracefully under an in-flight slow GET — new requests
+    shed typed SlowDown, the draining/drained state rides NodeStatus
+    gossip, and the in-flight GET completes inside the bounded
+    window.  The new metric families must lint and be documented."""
+    import aiohttp
+
+    from garage_tpu.testing.sim_cluster import (
+        SimCluster,
+        gateway_failover_drill,
+    )
+
+    summary = {"phases": {}, "ok": True}
+    with tempfile.TemporaryDirectory(prefix="garage_gwpool_") as tmp:
+        cluster = SimCluster(
+            tmp, n_storage=n_storage, n_zones=n_zones, n_gateways=2)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                st = await gateway_failover_drill(cluster, session, secs)
+                summary["phases"]["gateway_failover"] = st
+                for key in ("mid_put_killed", "mid_put_recovered",
+                            "mid_put_bit_identical",
+                            "get_resumed_via_range",
+                            "get_resume_bit_identical",
+                            "drain_shed_typed", "drain_gossiped",
+                            "drain_bounded", "drain_inflight_completed",
+                            "drained_gossiped", "drain_socket_closed",
+                            "failover_exercised", "resume_exercised"):
+                    summary["ok"] &= bool(st.get(key))
+                summary["ok"] &= st.get("errors") == 0
+                summary["ok"] &= st.get("verify_mismatches") == 0
+                summary["ok"] &= st.get("promlint_errors") == []
+                summary["ok"] &= st.get("metricsdoc_missing") == []
+                print(f"phase gateway_failover: {st}", file=sys.stderr)
+        finally:
+            await cluster.stop()
+    return summary
+
+
 async def run_zone(phases, secs, n_storage, n_zones):
     """The zone-scale drills on one SimCluster (built once, phases run
     in order — blackhole heals before drain, drain precedes rolling)."""
@@ -641,7 +742,7 @@ async def run_zone(phases, secs, n_storage, n_zones):
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     all_phases = (PHASES + ZONE_PHASES + STORM_PHASES + OVERLOAD_PHASES
-                  + QOS_PHASES)
+                  + QOS_PHASES + WAN_PHASES + GATEWAY_PHASES)
     ap.add_argument("--phases", default=",".join(PHASES),
                     help="comma-separated subset of " + ",".join(all_phases))
     ap.add_argument("--secs", type=float, default=8.0,
@@ -664,6 +765,8 @@ def main():
     storm_phases = [p for p in phases if p in STORM_PHASES]
     overload_phases = [p for p in phases if p in OVERLOAD_PHASES]
     qos_phases = [p for p in phases if p in QOS_PHASES]
+    wan_phases = [p for p in phases if p in WAN_PHASES]
+    gateway_phases = [p for p in phases if p in GATEWAY_PHASES]
     if zone_phases:
         # the drills name zones z2/z{n} and a rolling restart only stays
         # client-invisible when every partition keeps ≥2 live zones
@@ -693,6 +796,16 @@ def main():
         summary["ok"] &= s["ok"]
     if qos_phases:
         s = asyncio.run(run_noisy(secs))
+        summary["phases"].update(s["phases"])
+        summary["ok"] &= s["ok"]
+    if wan_phases:
+        # fixed acceptance shape (6 nodes / 3 zones — the matrix names
+        # z1..z3), like the overload/QoS drills run their own clusters
+        s = asyncio.run(run_wan(secs))
+        summary["phases"].update(s["phases"])
+        summary["ok"] &= s["ok"]
+    if gateway_phases:
+        s = asyncio.run(run_gateway_failover(secs))
         summary["phases"].update(s["phases"])
         summary["ok"] &= s["ok"]
     print("CHAOS " + json.dumps(summary))

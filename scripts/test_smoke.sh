@@ -88,4 +88,20 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
 # metric families render and pass the strict exposition lint
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
     --phases noisy_neighbor
+# geo-WAN smoke (ISSUE-19 acceptance, 6-node/3-zone shape): the 3-zone
+# RTT matrix (20/80/150 ms boundary links) — local-zone GET p50 holds
+# near the local RTT, cross-zone reads and write re-quorums pay exactly
+# the matrix, and the zone-aware fail-slow baseline never flags a
+# healthy-but-distant zone (while a genuinely slow far peer still flags)
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases wan
+# gateway-failover smoke (ISSUE-19 acceptance, 2-gateway shape): one
+# gateway killed mid-PUT-body and mid-streaming-GET under live pool
+# traffic — zero acked-data loss (sibling retry + Range resume,
+# bit-identical), then a graceful drain under an in-flight slow GET:
+# typed SlowDown sheds, draining/drained state in NodeStatus gossip,
+# in-flight GET completes inside the bounded window, and the new
+# gateway_pool_* / gateway_drain_state families lint + are documented
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases gateway_failover
 echo "SMOKE+CHAOS OK"

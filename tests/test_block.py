@@ -227,6 +227,38 @@ async def test_resync_offloads_and_deletes_unneeded(tmp_path):
     await shutdown(systems)
 
 
+async def test_drain_push_moves_block_before_refs_migrate(tmp_path):
+    """A layout change can un-assign a node while its refs are still
+    live (table sync lags the ring).  The draining holder must push to
+    the new owners immediately — need_block's drain flag lets them
+    accept on ring assignment alone — and must NOT drop its local copy
+    while rc is nonzero (deletion belongs to the migrating branch once
+    the refs leave)."""
+    systems, managers = await make_block_cluster(tmp_path, n=4)
+    data = os.urandom(70_000)
+    h = blake2s_sum(data)
+    owners = [bytes(x) for x in managers[0].replication.write_nodes(h)]
+    victim = next(m for m in managers if bytes(m.system.id) not in owners)
+    # the un-assigned node holds the block and still references it:
+    # exactly the post-drain state before table sync migrates the refs
+    await victim.write_block(h, DataBlock.plain(data))
+    victim.db.transaction(lambda tx: victim.rc.block_incref(tx, h))
+    assert not victim.is_assigned(h)
+    holders = [m for m in managers if bytes(m.system.id) in owners]
+    for m in holders:
+        # their rc is as stale as the victim's assignment: without the
+        # drain flag nobody would accept and the drain's bytes would
+        # wait on metadata migration
+        assert not await m.need_block(h)
+        assert await m.need_block(h, drain=True)
+    await victim.resync.resync_block(h)
+    for m in holders:
+        assert m.is_block_present(h)
+    # refs still live → the local copy survives the push
+    assert victim.is_block_present(h)
+    await shutdown(systems)
+
+
 # --- scrub ---
 
 

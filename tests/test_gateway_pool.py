@@ -1,0 +1,254 @@
+"""Geo-WAN domains + health-checked gateway pool (ISSUE 19).
+
+Unit layers of the production-shaped survival PR, deterministic where
+possible:
+
+  - the WAN matrix stretches BOUNDARY links only (intra-zone links pay
+    no toll, the zones-override reaches gateway indices, clear resets);
+  - the fail-slow scorer's zone-aware baseline: a healthy-but-distant
+    zone never flags against loopback siblings, while a genuinely slow
+    peer still flags against its own zone (injected clock, no sleeps);
+  - a streaming-GET consumer that abandons mid-body releases its
+    admission slot promptly (the satellite regression fix);
+  - the GatewayPool fails over to a sibling when a gateway dies and
+    re-points after a restart (small faultless SimCluster).
+
+The full kill-mid-PUT / Range-resume / graceful-drain choreography
+lives in scripts/chaos.py --phases gateway_failover (sim_cluster's
+gateway_failover_drill), and the WAN latency assertions in --phases
+wan — this file keeps the tier-1 teeth fast.
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+import aiohttp
+import pytest
+
+from garage_tpu.testing.faults import WAN_3ZONE_RTT, FaultInjector
+from garage_tpu.testing.gateway_pool import GatewayPool
+from garage_tpu.utils.health_score import FailSlowScorer, HealthTunables
+
+pytestmark = pytest.mark.asyncio
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --- WAN matrix: boundary links only -----------------------------------
+
+
+def _bare_injector(zones):
+    """A FaultInjector over fake links — pure matrix arithmetic, no
+    cluster: links[(i, j)] carries only delay/jitter."""
+    inj = FaultInjector([], configs=[], zones=list(zones))
+    n = len(zones)
+    for i in range(n):
+        for j in range(n):
+            if i != j:
+                inj.links[(i, j)] = SimpleNamespace(delay=0.0, jitter=0.0)
+    return inj
+
+
+def test_wan_matrix_stretches_boundary_links_only():
+    inj = _bare_injector(["z1", "z1", "z2", "z3"])
+    inj.apply_wan_matrix(WAN_3ZONE_RTT)
+    assert inj.links[(0, 1)].delay == 0.0          # intra-zone: free
+    assert inj.links[(0, 2)].delay == pytest.approx(0.020 / 2)
+    assert inj.links[(2, 0)].delay == pytest.approx(0.020 / 2)
+    assert inj.links[(0, 3)].delay == pytest.approx(0.080 / 2)
+    assert inj.links[(2, 3)].delay == pytest.approx(0.150 / 2)
+    assert inj.wan_matrix == WAN_3ZONE_RTT
+    inj.clear_wan_matrix()
+    assert all(l.delay == 0.0 for l in inj.links.values())
+    assert inj.wan_matrix is None
+
+
+def test_wan_matrix_orderless_pairs_and_absent_pairs_kept():
+    inj = _bare_injector(["z1", "z2", "z3"])
+    inj.links[(1, 2)].delay = 0.123                # pre-existing fault
+    inj.apply_wan_matrix({("z2", "z1"): 0.040})    # reversed pair order
+    assert inj.links[(0, 1)].delay == pytest.approx(0.020)
+    assert inj.links[(1, 0)].delay == pytest.approx(0.020)
+    # the z2-z3 pair is absent from the matrix: current delay untouched
+    assert inj.links[(1, 2)].delay == 0.123
+
+
+def test_wan_matrix_zones_override_reaches_gateways():
+    """A gateway's injector zone is deliberately None (zone-kill drills
+    must never crash the client's endpoint) — the zones override is how
+    its WAN links still stretch."""
+    inj = _bare_injector([None, "z2", "z3"])
+    inj.apply_wan_matrix(WAN_3ZONE_RTT)
+    assert inj.links[(0, 1)].delay == 0.0          # None zone: skipped
+    inj.apply_wan_matrix(WAN_3ZONE_RTT, zones=["z1", None, None])
+    assert inj.links[(0, 1)].delay == pytest.approx(0.020 / 2)
+    assert inj.links[(0, 2)].delay == pytest.approx(0.080 / 2)
+    assert inj.links[(1, 2)].delay == pytest.approx(0.150 / 2)
+
+
+# --- zone-aware fail-slow baseline --------------------------------------
+
+
+TUN = HealthTunables(fail_slow_factor=3.0, clear_factor=1.5,
+                     window_s=1.0, min_samples=4, min_baseline_peers=1)
+
+PEERS = {  # peer id -> (zone, per-call seconds)
+    b"a" * 32: ("z1", 0.001), b"b" * 32: ("z1", 0.001),
+    b"c" * 32: ("z2", 0.020), b"d" * 32: ("z2", 0.020),
+    b"e" * 32: ("z3", 0.080), b"f" * 32: ("z3", 0.080),
+}
+
+
+def _feed(scorer, latencies=None):
+    for peer, (_zone, secs) in PEERS.items():
+        secs = (latencies or {}).get(peer, secs)
+        for _ in range(TUN.min_samples):
+            scorer.note(peer, "ping", secs)
+
+
+def test_distant_zone_not_fail_slow_with_zone_baseline():
+    """The geo-WAN fix: z3 at 80× the loopback zone's latency is
+    DISTANCE — judged against its own zone sibling, score ~1."""
+    clock = FakeClock()
+    scorer = FailSlowScorer(TUN, clock=clock)
+    scorer.zone_of = lambda p: PEERS[bytes(p)][0]
+    _feed(scorer)
+    scorer.update()
+    clock.advance(TUN.window_s + 0.1)
+    scorer.update()
+    scores = scorer.scores(update=False)
+    assert scores, "every peer judgeable"
+    assert not any(v["fail_slow"] for v in scores.values()), scores
+    for v in scores.values():
+        assert v["score"] == pytest.approx(1.0)
+
+
+def test_distant_zone_would_flag_without_zone_baseline():
+    """The bug the fix exists for: against the flat all-peer median the
+    healthy z3 pair scores 4× and flags."""
+    clock = FakeClock()
+    scorer = FailSlowScorer(TUN, clock=clock)       # no zone_of wired
+    _feed(scorer)
+    scorer.update()
+    clock.advance(TUN.window_s + 0.1)
+    scorer.update()
+    far = scorer.scores(update=False)[(b"e" * 32).hex()[:16]]
+    assert far["score"] >= 3.0
+    assert far["fail_slow"]
+
+
+def test_genuinely_slow_peer_still_flags_through_zone_baseline():
+    """A z3 peer 3.75× its OWN zone sibling is sickness, not distance —
+    the zone-aware scorer must still catch it (and only it)."""
+    clock = FakeClock()
+    scorer = FailSlowScorer(TUN, clock=clock)
+    scorer.zone_of = lambda p: PEERS[bytes(p)][0]
+    _feed(scorer, latencies={b"e" * 32: 0.300})
+    scorer.update()
+    clock.advance(TUN.window_s + 0.1)
+    scorer.update()
+    scores = scorer.scores(update=False)
+    flagged = [p for p, v in scores.items() if v["fail_slow"]]
+    assert flagged == [(b"e" * 32).hex()[:16]], scores
+
+
+def test_zone_baseline_falls_back_when_zone_too_small():
+    """A zone with no judgeable sibling falls back to the all-peer
+    median — a lone-peer zone is never unjudgeable."""
+    clock = FakeClock()
+    scorer = FailSlowScorer(TUN, clock=clock)
+    zones = {b"a" * 32: "z1", b"b" * 32: "z1", b"x" * 32: "z9"}
+    scorer.zone_of = lambda p: zones[bytes(p)]
+    for peer, secs in ((b"a" * 32, 0.001), (b"b" * 32, 0.001),
+                       (b"x" * 32, 0.001)):
+        for _ in range(TUN.min_samples):
+            scorer.note(peer, "ping", secs)
+    s = scorer.score(b"x" * 32)
+    assert s is not None and s == pytest.approx(1.0)
+
+
+# --- streaming-GET consumer abandonment releases admission --------------
+
+
+async def test_streaming_abandon_releases_admission_slot(tmp_path):
+    """The satellite regression: a client that walks away mid-body must
+    not leak its admission slot (or keep upstream block fetches alive).
+    Observable: gate occupancy back to 0 promptly after the abort."""
+    from test_s3_api import make_api_cluster, stop_all
+
+    garages, server, client, _key = await make_api_cluster(tmp_path)
+    gate = garages[0].admission
+    try:
+        await client.req("PUT", "/abn")
+        body = bytes(range(256)) * (24 << 10)          # 6 MiB, 6 blocks
+        st, _h, _b = await client.req("PUT", "/abn/big", body=body)
+        assert st == 200
+
+        from garage_tpu.api.signature import sign_request
+
+        headers = {"host": f"127.0.0.1:{server.port}"}
+        headers.update(sign_request(
+            client.key_id, client.secret, client.region, "GET",
+            "/abn/big", [], headers, b"", path_is_raw=True))
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.port)
+        writer.write(("GET /abn/big HTTP/1.1\r\n"
+                      + "".join(f"{k}: {v}\r\n"
+                                for k, v in headers.items())
+                      + "\r\n").encode())
+        await writer.drain()
+        await reader.readexactly(64 << 10)             # headers + start
+        assert gate.inflight >= 1                      # mid-response
+        writer.transport.abort()                       # walk away
+
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while gate.inflight > 0:
+            assert asyncio.get_event_loop().time() < deadline, \
+                f"admission slot leaked: inflight={gate.inflight}"
+            await asyncio.sleep(0.05)
+    finally:
+        await stop_all(garages, server)
+
+
+# --- pool failover on a live (small) cluster ----------------------------
+
+
+async def test_pool_fails_over_and_repoints_after_restart(tmp_path):
+    from garage_tpu.testing.sim_cluster import SimCluster
+
+    c = SimCluster(tmp_path, n_storage=3, n_zones=3, n_gateways=2)
+    await c.start(faults=False)
+    try:
+        async with aiohttp.ClientSession() as session:
+            pool = GatewayPool(session, c.gateway_endpoints(),
+                               c.key_id, c.secret)
+            st, _b, _h = await pool.request("PUT", "/fob")
+            assert st == 200
+            body = b"payload-" * 512
+            st, _b, _h = await pool.request("PUT", "/fob/obj", body,
+                                            prefer=1)
+            assert st == 200
+
+            await c.kill_gateway(1)
+            # preferring the dead member: transport error -> sibling
+            st, got, _h = await pool.request("GET", "/fob/obj", prefer=1)
+            assert st == 200 and got == body
+            assert pool.counters["failovers"] >= 1
+            probes = await pool.probe()
+            assert probes["g0"] is True and probes["g1"] is False
+
+            pool.set_port("g1", await c.restart_gateway(1))
+            st, got, _h = await pool.request("GET", "/fob/obj", prefer=1)
+            assert st == 200 and got == body
+            assert (await pool.probe())["g1"] is True
+    finally:
+        await c.stop()
