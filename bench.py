@@ -1534,6 +1534,174 @@ async def _repair_storm_phase_async() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+REBUILD_PHASE_SHAPES = ((2, 6), (4, 8))   # (rs_data k, cluster nodes)
+REBUILD_PHASE_OBJS = 12
+REBUILD_PHASE_OBJ_MIN = 256 << 10
+REBUILD_PHASE_OBJ_MAX = 1 << 20
+REBUILD_PHASE_SAMPLES = 6
+
+
+async def _rebuild_phase_async() -> dict:
+    """ISSUE 20 acceptance phase: full-node-loss rebuild at k=2 vs k=4.
+
+    Two measurements per shape: (1) coordinator repair ingress per
+    repaired byte through the TREE-aggregated PPR path for the same
+    sampled codewords — the root stream is ONE row-sized aggregate
+    regardless of k, so the ratio must stay near 1 (≤ 1.25) at BOTH
+    k=2 and k=4, where flat PPR pays ~k row-sized partials;
+    (2) client GET p99 during the node-loss rebuild storm vs quiet,
+    with every object healing bit-identically (zero unhealed)."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    from garage_tpu.testing.faults import (
+        FaultInjector,
+        crash_heaviest_and_drop,
+    )
+    from garage_tpu.utils.data import Hash
+
+    def _p99_ms(lats):
+        if not lats:
+            return 0.0
+        lats = sorted(lats)
+        return round(lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+                     * 1000, 1)
+
+    out: dict = {}
+    ratios: dict = {}
+    for k, n in REBUILD_PHASE_SHAPES:
+        tmp = pathlib.Path(
+            tempfile.mkdtemp(prefix=f"garage_tpu_bench_rbd{k}_"))
+        try:
+            garages, server, port, kid, secret = await _mk_cluster(
+                tmp, n=n, repl="3", data_repl="none", db="sqlite",
+                codec_cfg={
+                    "rs_data": k, "rs_parity": 2,
+                    "store_parity": True, "parity_on_write": True,
+                    "parity_distribute": True, "backend": "cpu",
+                })
+            rng = np.random.default_rng(20 + k)
+            bodies = {}
+            inj = None
+            async with aiohttp.ClientSession() as session:
+                s3 = _S3(session, port, kid, secret)
+                st, _b, _h = await s3.req("PUT", "/rbdbkt")
+                assert st == 200, st
+                for i in range(REBUILD_PHASE_OBJS):
+                    size = int(rng.integers(REBUILD_PHASE_OBJ_MIN,
+                                            REBUILD_PHASE_OBJ_MAX))
+                    body = rng.integers(0, 256, size,
+                                        dtype=np.uint8).tobytes()
+                    st, _b, _h = await s3.req(
+                        "PUT", f"/rbdbkt/o{i:03d}", body)
+                    assert st == 200, st
+                    bodies[f"o{i:03d}"] = body
+                for g in garages:
+                    if g.block_manager.ec_accumulator is not None:
+                        await g.block_manager.ec_accumulator.drain()
+                await asyncio.sleep(3.0)  # distributor indexing
+
+                quiet = []
+                for name, body in bodies.items():
+                    tq = time.perf_counter()
+                    st, got, _h = await s3.req("GET", f"/rbdbkt/{name}")
+                    quiet.append(time.perf_counter() - tq)
+                    assert st == 200 and got == body, name
+
+                # --- coordinator ingress through the aggregation tree ---
+                coord = garages[0]
+                mgr = coord.block_manager
+                data = coord.parity_index_table.data
+                samples, seen = [], set()
+                for _kby, raw in data.store.items(b"", None):
+                    try:
+                        ent = data.decode_entry(raw)
+                    except Exception:
+                        continue
+                    if (ent.is_tombstone() or bytes(ent.member) in seen
+                            or ent.member_index >= len(ent.members)):
+                        continue
+                    seen.add(bytes(ent.member))
+                    samples.append(ent)
+                    if len(samples) >= REBUILD_PHASE_SAMPLES:
+                        break
+                assert samples, "no parity-index entries on coordinator"
+                planner = mgr.repair_planner
+                assert planner is not None and planner.use_tree
+                t0b = mgr.repair_fetch_bytes.get("tree", 0)
+                repaired = 0
+                for ent in samples:
+                    got = await planner.reconstruct(
+                        Hash(bytes(ent.member)), ent)
+                    assert got is not None, "tree reconstruction failed"
+                    repaired += len(got)
+                tree_bytes = mgr.repair_fetch_bytes.get("tree", 0) - t0b
+                ratios[k] = tree_bytes / max(1, repaired)
+                out[f"rebuild_tree_plans_k{k}"] = planner.tree_plans
+                out[f"rebuild_coord_ingress_per_byte_k{k}"] = round(
+                    ratios[k], 3)
+
+                # --- the storm: heaviest node crashed + dropped ---------
+                inj = FaultInjector(garages)
+                _victim, lost, survivors = await crash_heaviest_and_drop(
+                    inj)
+                storm, client_errors = [], 0
+                pending = dict(bodies)
+                deadline = time.perf_counter() + 420
+                while pending and time.perf_counter() < deadline:
+                    for name in list(pending):
+                        tq = time.perf_counter()
+                        try:
+                            st, got, _h = await asyncio.wait_for(
+                                s3.req("GET", f"/rbdbkt/{name}"), 60)
+                        except Exception:
+                            client_errors += 1
+                            continue
+                        storm.append(time.perf_counter() - tq)
+                        if st == 200 and got == bodies[name]:
+                            del pending[name]
+                        else:
+                            client_errors += 1
+                    if pending:
+                        await asyncio.sleep(1.0)
+                # bounded wait: every survivor's rebuild scheduler done
+                scheds = [g.rebuild_scheduler for g in survivors]
+                sched_by = time.monotonic() + 120
+                while time.monotonic() < sched_by:
+                    if all(s.idle() for s in scheds):
+                        break
+                    await asyncio.sleep(0.5)
+                episodes = [s for s in scheds if s.partitions_total]
+                out[f"rebuild_get_p99_quiet_ms_k{k}"] = _p99_ms(quiet)
+                out[f"rebuild_get_p99_storm_ms_k{k}"] = _p99_ms(storm)
+                out[f"rebuild_unhealed_k{k}"] = len(pending)
+                out[f"rebuild_client_errors_k{k}"] = client_errors
+                out[f"rebuild_lost_mib_k{k}"] = round(lost / 2**20, 1)
+                out[f"rebuild_sched_partitions_k{k}"] = (
+                    f"{sum(s.partitions_done for s in episodes)}"
+                    f"/{sum(s.partitions_total for s in episodes)}")
+                out[f"rebuild_sched_blocks_k{k}"] = sum(
+                    s.blocks_healed for s in episodes)
+                out[f"rebuild_sched_paced_k{k}"] = sum(
+                    s.paced_sleeps for s in episodes)
+            await server.stop()
+            for i, g in enumerate(inj.garages if inj else garages):
+                if inj is None or i not in inj.dead:
+                    await g.shutdown()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    # the acceptance claim: coordinator ingress per repaired byte is
+    # FLAT in k — ONE row-sized aggregated stream (ratio ~1, less when
+    # the coordinator holds a piece locally; small slack for framing)
+    # at EVERY k, where flat PPR pays ~k row-sized partials
+    out["rebuild_ingress_flat_in_k"] = bool(
+        all(r <= 1.25 for r in ratios.values()))
+    return out
+
+
 def _put_solo_phase_async():
     return _put_phase_async(n=1, repl="none", prefix="put_solo")
 
@@ -2799,6 +2967,7 @@ _PHASES = {
     "--mp-phase": _mp_phase_async,
     "--degraded-phase": _degraded_phase_async,
     "--repair-storm-phase": _repair_storm_phase_async,
+    "--rebuild-phase": _rebuild_phase_async,
     "--wan-phase": _wan_phase_async,
     "--overload-phase": _overload_phase_async,
     "--tenants-phase": _tenants_phase_async,
@@ -3444,6 +3613,8 @@ def main() -> None:
     out.update(run_phase_subprocess("--degraded-phase", timeout=900))
     emit()
     out.update(run_phase_subprocess("--repair-storm-phase", timeout=900))
+    emit()
+    out.update(run_phase_subprocess("--rebuild-phase", timeout=1200))
     emit()
     out.update(run_phase_subprocess("--overload-phase"))
     emit()

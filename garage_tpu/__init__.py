@@ -25,12 +25,12 @@ Layer map (mirrors reference SURVEY.md §1):
   cli/       L7 daemon + CLI (ref: src/garage)
 """
 
-__version__ = "0.9.0"
+__version__ = "0.9.5"
 
 # feature registry (ref util/version.rs garage_features): what this build
 # ships, surfaced by `garage_tpu --version` and node stats
 FEATURES = [
     "k2v", "lmdb-equivalent-logdb", "sqlite", "consul-discovery",
     "kubernetes-discovery", "metrics", "telemetry-otlp",
-    "codec-cpu", "codec-tpu", "codec-hybrid",
+    "codec-cpu", "codec-tpu", "codec-hybrid", "repair-tree",
 ]

@@ -30,7 +30,7 @@ from ..db import Db
 from ..net.frame import PRIO_BACKGROUND, PRIO_NORMAL
 from ..rpc.system import System
 from ..utils.crdt import now_msec
-from ..utils.data import Hash, block_hash
+from ..utils.data import FixedBytes32, Hash, block_hash
 from ..utils.error import (
     CorruptData,
     GarageError,
@@ -199,6 +199,8 @@ class BlockManager:
                 self,
                 use_ppr=getattr(config.codec, "repair_ppr", True),
                 hedge_delay=(hedge_ms / 1000.0) if hedge_ms > 0 else None,
+                use_tree=getattr(config.codec, "repair_tree", True),
+                tree_fanout=getattr(config.codec, "repair_tree_fanout", 4),
             )
 
         # metrics counters (ref block/metrics.rs:7-127)
@@ -218,11 +220,17 @@ class BlockManager:
         # PPR requests that fell back to whole-shard (mixed-version /
         # missing-piece peers).  Plain attributes so bench/chaos read
         # them without a metrics registry.
-        self.repair_fetch_bytes: dict = {"ppr": 0, "shard": 0, "gather": 0}
+        self.repair_fetch_bytes: dict = {
+            "ppr": 0, "shard": 0, "gather": 0, "tree": 0}
         self.repair_repaired_bytes = 0
         self.repair_overfetch_bytes = 0
         self.repair_hedges = 0
         self.repair_ppr_fallbacks = 0
+        # re-plans by reason (survivor_died / mid_tree / version_demote /
+        # tree_abort) and the depth of the last aggregation tree served
+        # or planned here — chaos/bench read the plain attrs.
+        self.repair_replans: dict = {}
+        self.repair_tree_depth_last = 0
         m = getattr(system, "metrics", None)
         if m is not None:
             m.gauge("block_compression_level", "Configured zstd level",
@@ -285,9 +293,10 @@ class BlockManager:
                 "repair_fetch_bytes_total",
                 "Bytes fetched for degraded reads / reconstruction, by "
                 "mode (ppr = partial-sum products, shard = whole-shard "
-                "exact-k — both wire bytes; gather = legacy "
-                "sweep-everything fallback, counted as verified plain "
-                "bytes, an upper bound on its wire cost)")
+                "exact-k — both wire bytes; tree = coordinator ingress "
+                "of the aggregated repair-tree root stream, flat in k; "
+                "gather = legacy sweep-everything fallback, counted as "
+                "verified plain bytes, an upper bound on its wire cost)")
             self.m_repair_repaired = m.counter(
                 "repair_repaired_bytes_total",
                 "Bytes of reconstructed codeword rows produced by "
@@ -304,12 +313,26 @@ class BlockManager:
                 "repair_ppr_fallback_total",
                 "PPR partial-product requests that fell back to a "
                 "whole-shard fetch (old-version or piece-less peers)")
+            self.m_repair_replan = m.counter(
+                "repair_replan_total",
+                "Repair plans re-planned mid-flight, by reason "
+                "(survivor_died = survivor failed after acking the plan; "
+                "mid_tree = subtree loss re-fetched flat under the same "
+                "survivor set; version_demote = tree edge demoted to "
+                "flat PPR for a mixed-version peer; tree_abort = "
+                "aggregation tree abandoned for the flat planner)")
+            m.gauge(
+                "repair_tree_depth",
+                "Depth of the most recent PPR aggregation tree planned "
+                "or served by this node (0 = no tree yet)",
+                fn=lambda: float(self.repair_tree_depth_last))
             self.m_heal = m.counter(
                 "block_heal_total",
                 "Blocks re-materialized, by heal source (writeback = "
                 "read-path post-decode write-back; resync_fetch / "
                 "peer_sweep / distributed_decode = resync chain; "
-                "local_sidecar = local RS parity rebuild)")
+                "local_sidecar = local RS parity rebuild; rebuild = "
+                "fleet rebuild scheduler after a full-node loss)")
             # gate-state gauges read THROUGH self.codec so a codec swap
             # (tests, future runtime rebuild) keeps /metrics truthful —
             # fn= observers on the codec itself would both pin the old
@@ -338,6 +361,7 @@ class BlockManager:
             self.m_repair_fetch = self.m_repair_repaired = None
             self.m_repair_overfetch = None
             self.m_repair_hedge = self.m_repair_ppr_fb = None
+            self.m_repair_replan = None
 
     # --- paths ---
 
@@ -538,6 +562,14 @@ class BlockManager:
         self.repair_ppr_fallbacks += 1
         if self.m_repair_ppr_fb is not None:
             self.m_repair_ppr_fb.inc()
+
+    def note_repair_replan(self, reason: str) -> None:
+        self.repair_replans[reason] = self.repair_replans.get(reason, 0) + 1
+        if self.m_repair_replan is not None:
+            self.m_repair_replan.inc(reason=reason)
+
+    def note_repair_tree(self, depth: int) -> None:
+        self.repair_tree_depth_last = int(depth)
 
     def is_parity_block(self, h: Hash) -> bool:
         """Was this hash ever stored here as a distributed-parity shard?"""
@@ -744,8 +776,16 @@ class BlockManager:
         if self.rc.block_incref(tx, h):
             # 0→1: we might not have the block yet — check after commit
             if self.resync is not None:
-                tx.on_commit(lambda: self.resync.put_to_resync(
-                    h, 2.0, source="incref"))
+                def _after_commit():
+                    # a ref landing after a node loss (table sync lags
+                    # the ring change) re-arms the rebuild walk for its
+                    # partition, so the planned flow — not a one-off
+                    # resync — heals it
+                    rb = getattr(self.resync, "rebuild", None)
+                    if rb is not None:
+                        rb.note_ref(h)
+                    self.resync.put_to_resync(h, 2.0, source="incref")
+                tx.on_commit(_after_commit)
 
     def block_decref(self, tx, h: Hash) -> None:
         if self.rc.block_decref(tx, h):
@@ -1328,12 +1368,148 @@ class BlockManager:
             if part is None:
                 return {"err": "not a parity shard"}, None
             return {"n": len(part)}, _chunks(part)
+        if t == "ppr_tree":
+            # tree-aggregated PPR: serve OWN pieces as GF(256) partial
+            # products, recursively collect the children's aggregated
+            # streams, XOR everything into one accumulator per target
+            # row, and forward a single stream upward — so the
+            # coordinator's ingress stays flat in k (repair_plan.py
+            # `_run_tree`; docs/ROBUSTNESS.md "Full-node rebuild")
+            wants = [max(0, int(w)) for w in msg.get("want") or []]
+            plan = msg.get("plan") or {}
+            if not wants:
+                return {"err": "empty want list"}, None
+            self.note_repair_tree(_tree_depth(plan))
+            buf, got, miss = await self._serve_ppr_tree(plan, wants)
+            return {"n": len(buf), "got": got, "miss": miss}, _chunks(buf)
         raise GarageError(f"unknown block rpc {t!r}")
+
+    async def _serve_ppr_tree(self, plan: dict, wants: list):
+        """One level of the repair aggregation tree.  Returns
+        (concatenated per-target accumulator rows, contributed piece
+        indexes, missing piece indexes).  A dead child is NOT fatal:
+        its whole subtree lands on the miss list and the coordinator
+        re-fetches those pieces flat (subtree re-plan, never a
+        codeword abort)."""
+        import numpy as np
+
+        accs = [np.zeros(w, dtype=np.uint8) for w in wants]
+        got: list = []
+        miss: list = []
+
+        def _xor(payload: bytes, coeffs) -> None:
+            for a, w, c in zip(accs, wants, coeffs):
+                c = int(c) & 0xFF
+                if not c or not w:
+                    continue
+                data = self.codec.gf_scale(c, payload, w)
+                if data:
+                    arr = np.frombuffer(data, dtype=np.uint8)
+                    a[: len(arr)] ^= arr
+
+        for ent in plan.get("p") or []:
+            hb, is_par, coeffs, idx = ent[0], ent[1], ent[2], int(ent[3])
+            h = Hash(bytes(hb))
+            try:
+                block = await self.read_block(h)
+            except (NoSuchBlock, CorruptData):
+                # same serve-miss repair signal as get_block/ppr
+                if (self.resync is not None
+                        and self.rc.get(h).is_needed()
+                        and self.is_assigned(h)
+                        and not self.is_block_present(h)):
+                    self.resync.put_to_resync(h, 0.0, source="serve_miss")
+                miss.append(idx)
+                continue
+
+            def _shard(block=block, is_par=is_par):
+                raw = block.decompressed()
+                if is_par:
+                    from .parity import unpack_parity_shard
+
+                    return unpack_parity_shard(raw)
+                return raw
+
+            shard = await asyncio.to_thread(_shard)
+            if shard is None:
+                miss.append(idx)
+                continue
+            await asyncio.to_thread(_xor, shard, coeffs)
+            got.append(idx)
+
+        async def _child(cnode, sub):
+            node = FixedBytes32(bytes(cnode))
+            depth = _tree_depth(sub)
+            try:
+                resp, stream = await self.endpoint.call_streaming(
+                    node, {"t": "ppr_tree", "plan": sub,
+                           "want": [int(w) for w in wants]},
+                    prio=PRIO_NORMAL,
+                    timeout=self.block_rpc_timeout * max(1, depth))
+                if resp.get("err") or stream is None:
+                    raise GarageError(
+                        resp.get("err") or "empty ppr_tree answer")
+                try:
+                    body = await asyncio.wait_for(
+                        stream.read_all(),
+                        self.block_rpc_timeout * max(1, depth))
+                except BaseException:
+                    await stream.aclose()
+                    raise
+                if len(body) != sum(wants):
+                    raise GarageError("short ppr_tree aggregate")
+                return (list(resp.get("got") or []),
+                        list(resp.get("miss") or []), body)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — subtree → miss list
+                logger.debug("ppr_tree child %s failed: %s",
+                             bytes(cnode).hex()[:8], e)
+                return None
+
+        children = plan.get("c") or []
+        if children:
+            answers = await asyncio.gather(
+                *[_child(cnode, sub) for cnode, sub in children])
+            for (cnode, sub), ans in zip(children, answers):
+                if ans is None:
+                    miss.extend(_tree_piece_indexes(sub))
+                    continue
+                cgot, cmiss, body = ans
+                # relay ingress: counted as ppr on THIS node, so the
+                # cluster-wide wire total still sums to ≈ k partials
+                # while the coordinator's "tree" ingress stays one
+                # stream
+                self.note_repair_fetch("ppr", len(body))
+                off = 0
+                for a, w in zip(accs, wants):
+                    if w:
+                        a ^= np.frombuffer(body[off:off + w],
+                                           dtype=np.uint8)
+                    off += w
+                got.extend(int(i) for i in cgot)
+                miss.extend(int(i) for i in cmiss)
+        buf = b"".join(a.tobytes() for a in accs)
+        return buf, got, miss
 
     # --- introspection ---
 
     def rc_len(self) -> int:
         return self.rc.rc_len()
+
+
+def _tree_piece_indexes(plan: dict) -> list:
+    """Every piece index carried anywhere in a (sub)tree plan — the
+    miss set when a whole child subtree is unreachable."""
+    out = [int(p[3]) for p in plan.get("p") or []]
+    for _cnode, sub in plan.get("c") or []:
+        out.extend(_tree_piece_indexes(sub))
+    return out
+
+
+def _tree_depth(plan: dict) -> int:
+    kids = plan.get("c") or []
+    return 1 + max((_tree_depth(s) for _n, s in kids), default=0)
 
 
 async def _chunks(data: bytes) -> AsyncIterator[bytes]:

@@ -93,6 +93,13 @@ class BlockResyncManager:
         self.busy_set: Set[bytes] = set()
         self.notify = asyncio.Event()
         self.persister = persister
+        # fleet rebuild scheduler (block/rebuild.py), wired by the model
+        # layer: hashes whose codewords it currently OWNS are skipped by
+        # the queue workers and the rebalance mover so a full-node-loss
+        # storm never repairs the same block twice (the double-fetch
+        # used to surface as overfetch)
+        self.rebuild = None
+        self.rebuild_skips = 0
         # enqueue attribution: WHO put work on the resync queue.  The
         # round-5 heal non-repro was exactly this blind spot — the
         # bench's fallback kick (a refs-only RepairWorker, source
@@ -139,7 +146,9 @@ class BlockResyncManager:
         """`source` labels the originating path (incref, corrupt_read,
         degraded_read, serve_miss, scrub_corrupt, layout_sweep,
         disk_error = read-path EIO failover, janitor = boot-time
-        quarantine requeue, …) for the enqueue-attribution counter;
+        quarantine requeue, rebuild = hashes the fleet rebuild
+        scheduler parked after exhausting its own attempts, …) for the
+        enqueue-attribution counter;
         internal requeues/backoffs use put_to_resync_at directly and are
         deliberately not counted."""
         self.enqueue_counts[source] = self.enqueue_counts.get(source, 0) + 1
@@ -182,6 +191,14 @@ class BlockResyncManager:
             # another worker is on it; drop this queue entry (it will be
             # requeued if needed)
             self.queue.remove(key)
+            return WorkerState.BUSY
+        if self.rebuild is not None and self.rebuild.owns(hb):
+            # the rebuild scheduler will reach this hash in its own
+            # partition walk — drop the queue entry instead of paying a
+            # duplicate k-fetch (the scheduler re-parks anything it
+            # ultimately fails onto this queue)
+            self.queue.remove(key)
+            self.rebuild_skips += 1
             return WorkerState.BUSY
         # error backoff check (ref resync.rs:317-343)
         ev = self.errors.get(hb)
@@ -231,6 +248,11 @@ class BlockResyncManager:
         walking and the retry inherits resync's backoff machinery."""
         hb = bytes(h)
         if hb in self.busy_set:
+            return 0
+        if self.rebuild is not None and self.rebuild.owns(hb):
+            # rebalance_hash bypasses resync_iter, so the scheduler
+            # dedupe must sit here too
+            self.rebuild_skips += 1
             return 0
         self.busy_set.add(hb)
         try:

@@ -595,6 +595,58 @@ class Garage:
                 self.rebalance_mover.enqueue(changed)
 
         self.system.on_ring_change(_feed_mover)
+        # Fleet rebuild scheduler: when a ring change REMOVES a node
+        # from the cluster (full-node loss, not a mere reshuffle), the
+        # partitions that lost it are planned as one paced, checkpointed
+        # rebuild flow (block/rebuild.py) — chain repair per codeword,
+        # rotated tree roots, resync dedupe via `owns`.  The mover and
+        # layout sweep still run for the same partitions; the owns()
+        # seam keeps the three from double-repairing a block.
+        from ..block.rebuild import RebuildCheckpoint, RebuildScheduler
+        from .parity_repair import lookup_index_entries, try_codeword
+
+        self.rebuild_scheduler = RebuildScheduler(
+            self.block_manager, self.block_resync,
+            rate_mib_s=self.config.rebuild_rate_mib,
+            persister=Persister(
+                self.config.metadata_dir, "rebuild_sched",
+                RebuildCheckpoint),
+            metrics=self.system.metrics,
+            governor=self.governor,
+            lookup=lambda h: lookup_index_entries(self, h, sweep=True),
+            decode_fallback=lambda h, ent: try_codeword(self, h, ent),
+        )
+        self.bg.spawn(self.rebuild_scheduler)
+        self.block_resync.rebuild = self.rebuild_scheduler
+        self._prev_ring_nodes = frozenset(
+            n for s in self._prev_partitions for n in s)
+
+        self._rebuild_prev_sets = list(self._prev_partitions)
+
+        def _feed_rebuild(ring):
+            prev_sets = self._rebuild_prev_sets
+            new = _part_sets(ring)
+            self._rebuild_prev_sets = new
+            new_nodes = frozenset(n for s in new for n in s)
+            lost = self._prev_ring_nodes - new_nodes
+            self._prev_ring_nodes = new_nodes
+            if not lost:
+                return  # reshuffle, not a node loss: mover's job alone
+            me = bytes(self.system.id)
+            # partitions that LOST one of the dead nodes and still
+            # assign this node — the rows we are now responsible for
+            mine = [p for p in range(N_PARTITIONS)
+                    if me in new[p] and prev_sets[p] & lost]
+            if mine:
+                self.rebuild_scheduler.node_lost(mine, ring.digest())
+
+        self.system.on_ring_change(_feed_rebuild)
+        self.rebuild_scheduler.maybe_resume(self.system.ring.digest())
+        self.bg_vars.register_ro(
+            "rebuild-progress",
+            lambda: (f"{self.rebuild_scheduler.partitions_done}/"
+                     f"{self.rebuild_scheduler.partitions_total}"),
+        )
         self.bg_vars.register_rw(
             "resync-tranquility",
             lambda: self.block_resync.tranquility,

@@ -375,12 +375,32 @@ def make_parity_reconstructor(garage):
             if not live:
                 sweep_empty[bytes(h)] = time.monotonic()
         for ent in live:
-            data = await _try_codeword(garage, h, ent)
+            data = await try_codeword(garage, h, ent)
             if data is not None:
                 return data
         return None
 
     return reconstruct
+
+
+async def lookup_index_entries(garage, h: Hash, *, sweep: bool = False
+                               ) -> list:
+    """Live parity-index rows for member `h` — the quorum read the
+    decode ladder and the fleet rebuild scheduler (block/rebuild.py)
+    share.  sweep=True falls back to the alive-peer sweep when the read
+    returns zero rows (a full-node loss IS a recent ring change, so the
+    blind-read window applies)."""
+    try:
+        entries = await garage.parity_index_table.get_range(
+            bytes(h), None, limit=INDEX_SCAN_LIMIT)
+    except Exception:
+        logger.warning("parity index unreachable for %s",
+                       bytes(h).hex()[:16], exc_info=True)
+        entries = []
+    live = [e for e in entries if not e.is_tombstone()]
+    if not entries and sweep:
+        live = await _sweep_index_entries(garage, h)
+    return live
 
 
 async def _sweep_index_entries(garage, h: Hash) -> list:
@@ -441,7 +461,11 @@ async def _fetch_verified(garage, mh: bytes) -> Optional[bytes]:
     return await garage.block_manager.sweep_get_block(Hash(mh))
 
 
-async def _try_codeword(garage, h: Hash, ent) -> Optional[bytes]:
+async def try_codeword(garage, h: Hash, ent) -> Optional[bytes]:
+    """Decode member `h` of codeword `ent`: planner (tree/chain/flat
+    PPR) first, legacy sweep-everything gather as the completeness
+    backstop.  Shared by the resync decode ladder and the rebuild
+    scheduler's per-codeword fallback."""
     k, m = ent.k, ent.m
     target_i = ent.member_index
     lengths = ent.lengths

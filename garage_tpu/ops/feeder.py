@@ -387,11 +387,16 @@ class CodecFeeder:
 
     async def decode_async(self, shards: np.ndarray,
                            present: Sequence[int],
-                           rows: Optional[Sequence[int]] = None):
+                           rows: Optional[Sequence[int]] = None,
+                           cls: str = "fg"):
+        """`cls="bg"` puts the decode behind foreground work in the
+        queue — the repair planner submits rebuild-storm decodes there
+        so a full-node heal coalesces into ragged batches without
+        cutting ahead of client reads."""
         import asyncio
 
         try:
-            fut = self.submit_decode(shards, present, rows)
+            fut = self.submit_decode(shards, present, rows, cls=cls)
         except FeederClosed:
             return await asyncio.to_thread(
                 self.codec.rs_reconstruct, shards, present, rows)

@@ -166,6 +166,13 @@ class CodecConfig:
     repair_planner: bool = True
     repair_ppr: bool = True
     repair_hedge_ms: float = 0.0
+    # tree-aggregated PPR (`ppr_tree` block RPC): survivors forward
+    # GF-scaled partials along a fanout-shaped aggregation tree so the
+    # coordinator ingests ONE stream regardless of k.  repair_tree=False
+    # keeps flat PPR; repair_tree_fanout bounds each interior node's
+    # child count.
+    repair_tree: bool = True
+    repair_tree_fanout: int = 4
 
     def make(self, compression_level: Optional[int] = 1,
              metrics=None, tracer=None, block_size: Optional[int] = None):
@@ -301,6 +308,10 @@ class Config:
     # layout-change rebalance mover: data streamed per second ceiling
     # (MiB/s) so a zone drain cannot starve foreground traffic
     rebalance_rate_mib: float = 64.0
+    # fleet rebuild scheduler (block/rebuild.py): repaired bytes per
+    # second ceiling for a full-node-loss storm, further scaled by the
+    # LoadGovernor throttle ratio
+    rebuild_rate_mib: float = 256.0
     s3_api_bind_addr: Optional[str] = "0.0.0.0:3900"
     s3_region: str = "garage"
     root_domain: Optional[str] = None
@@ -369,7 +380,7 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         "rpc_bind_addr", "rpc_public_addr", "rpc_secret", "bootstrap_peers",
         "db_engine", "metadata_fsync", "data_fsync", "root_domain",
         "disk_error_threshold", "disk_error_cooldown",
-        "node_version", "rebalance_rate_mib",
+        "node_version", "rebalance_rate_mib", "rebuild_rate_mib",
     ):
         if key in raw:
             setattr(cfg, key, raw[key])
@@ -391,6 +402,8 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         raise ConfigError("disk_error_threshold must be >= 1")
     if cfg.rebalance_rate_mib <= 0:
         raise ConfigError("rebalance_rate_mib must be > 0")
+    if cfg.rebuild_rate_mib <= 0:
+        raise ConfigError("rebuild_rate_mib must be > 0")
     cfg.replication_mode = str(cfg.replication_mode)
 
     dd = raw.get("data_dir", "./data")
@@ -628,6 +641,8 @@ def config_from_dict(raw: Dict[str, Any]) -> Config:
         raise ConfigError("codec.feeder_max_batch_blocks must be >= 1")
     if cfg.codec.repair_hedge_ms < 0:
         raise ConfigError("codec.repair_hedge_ms must be >= 0")
+    if cfg.codec.repair_tree_fanout < 1:
+        raise ConfigError("codec.repair_tree_fanout must be >= 1")
     if cfg.codec.transport_staging_slots < 1:
         raise ConfigError("codec.transport_staging_slots must be >= 1")
     if cfg.codec.transport_bg_slack_ms < 0:

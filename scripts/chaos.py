@@ -124,6 +124,13 @@ WAN_PHASES = ("wan",)
 # (zero acked loss, Range resume) and then gracefully drained under an
 # in-flight slow GET (typed sheds, gossiped drain state, bounded window)
 GATEWAY_PHASES = ("gateway_failover",)
+# ISSUE 20 full-node-loss drill: a storage node of an EC SimCluster is
+# crashed AND dropped from the layout under live PUT/GET traffic — zero
+# client errors, zero acked-data loss, every survivor's fleet rebuild
+# scheduler walks its lost partitions to done == total paced under the
+# governor, and repair ingress stays partial-product attributed
+# (tree/ppr modes — never whole-block over-fetch)
+REBUILD_PHASES = ("node_rebuild",)
 
 
 def _apply(inj, phase):
@@ -525,6 +532,52 @@ async def run_repair_storm(secs):
     return summary
 
 
+async def run_node_rebuild(secs, n_storage=6, n_zones=3):
+    """ISSUE 20 full-node-loss drill (quick: 6 nodes / 3 zones; the
+    acceptance shape is 24 / 4).  The cluster stores data EC-only
+    (RS(2,2), no whole-block replicas), so a full node loss can ONLY
+    heal through codeword decode — the tree/chain repair planner and
+    the fleet rebuild scheduler, not replica copies."""
+    import aiohttp
+
+    from garage_tpu.testing.sim_cluster import (
+        SimCluster,
+        TrafficDriver,
+        node_rebuild_drill,
+    )
+
+    summary = {"phases": {}, "ok": True,
+               "cluster": {"storage_nodes": n_storage, "zones": n_zones}}
+    ec_cfg = {
+        "data_replication_mode": "none",
+        "codec": {"rs_data": 2, "rs_parity": 2, "store_parity": True,
+                  "parity_on_write": True, "parity_distribute": True,
+                  "backend": "cpu"},
+    }
+    with tempfile.TemporaryDirectory(prefix="garage_rebuild_") as tmp:
+        cluster = SimCluster(tmp, n_storage=n_storage, n_zones=n_zones,
+                             extra_cfg=ec_cfg)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                traffic = TrafficDriver(cluster, session,
+                                        bucket="drill-node-rebuild")
+                await traffic.make_bucket()
+                st = await node_rebuild_drill(
+                    cluster, traffic, secs,
+                    seed_objects=max(24, 2 * n_storage))
+                summary["phases"]["node_rebuild"] = st
+                summary["ok"] &= bool(st.get("rebuild_complete"))
+                summary["ok"] &= st.get("blocks_healed", 0) > 0
+                summary["ok"] &= st.get("paced_sleeps", 0) > 0
+                summary["ok"] &= st.get("verify_mismatches") == 0
+                summary["ok"] &= st.get("errors") == 0
+                print(f"phase node_rebuild: {st}", file=sys.stderr)
+        finally:
+            await cluster.stop()
+    return summary
+
+
 async def run_overload(secs, n_storage=3, n_zones=3):
     """ISSUE-10 acceptance: a SimCluster whose gateway admits at most 2
     concurrent requests is driven at 1× then 4× offered load; the
@@ -742,7 +795,8 @@ async def run_zone(phases, secs, n_storage, n_zones):
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     all_phases = (PHASES + ZONE_PHASES + STORM_PHASES + OVERLOAD_PHASES
-                  + QOS_PHASES + WAN_PHASES + GATEWAY_PHASES)
+                  + QOS_PHASES + WAN_PHASES + GATEWAY_PHASES
+                  + REBUILD_PHASES)
     ap.add_argument("--phases", default=",".join(PHASES),
                     help="comma-separated subset of " + ",".join(all_phases))
     ap.add_argument("--secs", type=float, default=8.0,
@@ -767,6 +821,7 @@ def main():
     qos_phases = [p for p in phases if p in QOS_PHASES]
     wan_phases = [p for p in phases if p in WAN_PHASES]
     gateway_phases = [p for p in phases if p in GATEWAY_PHASES]
+    rebuild_phases = [p for p in phases if p in REBUILD_PHASES]
     if zone_phases:
         # the drills name zones z2/z{n} and a rolling restart only stays
         # client-invisible when every partition keeps ≥2 live zones
@@ -806,6 +861,13 @@ def main():
         summary["ok"] &= s["ok"]
     if gateway_phases:
         s = asyncio.run(run_gateway_failover(secs))
+        summary["phases"].update(s["phases"])
+        summary["ok"] &= s["ok"]
+    if rebuild_phases:
+        # acceptance shape 24/4 (the --nodes/--zones defaults); --quick
+        # shrinks to 6/3 so the smoke lane finishes in CI time
+        rn, rz = (6, 3) if args.quick else (args.nodes, args.zones)
+        s = asyncio.run(run_node_rebuild(secs, rn, rz))
         summary["phases"].update(s["phases"])
         summary["ok"] &= s["ok"]
     print("CHAOS " + json.dumps(summary))

@@ -104,4 +104,12 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
 # gateway_pool_* / gateway_drain_state families lint + are documented
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
     --phases gateway_failover
+# full-node-loss smoke (ISSUE-20 acceptance, 6-node/3-zone EC-only
+# shape): a storage node crashed AND dropped from the layout under live
+# PUT/GET traffic — zero client errors, zero acked-data loss, every
+# survivor's fleet rebuild scheduler walks its lost partitions to
+# done == total paced under the governor, and repair ingress stays
+# partial-product attributed (tree/ppr — never whole-block over-fetch)
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python scripts/chaos.py --quick \
+    --phases node_rebuild
 echo "SMOKE+CHAOS OK"
